@@ -1,0 +1,27 @@
+"""draco_tpu — a TPU-native framework for Byzantine-resilient coded distributed training.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of DRACO
+(hwang595/Draco; "DRACO: Byzantine-resilient Distributed Training via
+Redundant Gradients", ICML 2018): synchronous data-parallel training where
+workers evaluate redundant gradients, send linear combinations, and an
+algebraic decode removes the influence of up to s Byzantine workers.
+
+Architecture (TPU-first, not a port):
+  * The reference's parameter-server *process* (rank 0 over MPI) becomes a
+    *program phase*: one pjit-compiled SPMD step over a device mesh axis
+    ``w`` of n logical workers. Per-worker gradients are a vmap axis;
+    encode/decode/aggregation are linear algebra on the stacked (n, d)
+    gradient matrix; XLA inserts the ICI collectives the reference did by
+    hand with MPI Isend/Irecv (reference: src/master/baseline_master.py,
+    src/worker/baseline_worker.py).
+  * The reference's native C++ decoder (src/c_coding.cpp) becomes
+    fixed-shape jittable linear algebra (draco_tpu.coding.cyclic), with an
+    optional C++ host reference used for testing.
+  * The hand-rolled per-layer gradient streaming models
+    (src/model_ops/*_split.py) are unnecessary under XLA async collectives;
+    models are plain Flax modules.
+"""
+
+__version__ = "0.1.0"
+
+from draco_tpu.config import TrainConfig  # noqa: F401

@@ -1,0 +1,69 @@
+"""Byzantine attack simulation as pure, branch-free functions.
+
+Reference semantics (src/model_ops/utils.py:6-23, constants ADVERSARY_=-100,
+CONST_=-100):
+
+  * plain paths (baseline / repetition):
+      rev_grad : g -> -100 * g
+      constant : g -> -100 * ones
+      random   : passthrough (a TODO in the reference, kept for parity)
+  * cyclic path (``cyclic=True``) the attack is *additive* on top of the
+    honest encoded value:
+      rev_grad : g -> g + (-100 * g)      (i.e. -99 * g)
+      constant : g -> g + (-100 * ones)   (adds to the real part only, since
+                  the reference adds a float array to a complex one)
+
+Attacks are applied inside the jitted step with jnp.where over a per-step
+per-worker boolean mask (the schedule from draco_tpu.rng.adversary_schedule),
+so the computation is identical on every device and bit-reproducible —
+the reference achieves the same with agreed seeds (util.py:100-103).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+ADVERSARY = -100.0
+CONST = -100.0
+
+
+def attack_plain(grads: jnp.ndarray, err_mode: str, magnitude: float = ADVERSARY) -> jnp.ndarray:
+    """Adversarial transform of raw per-worker gradients, shape (n, d).
+
+    ``magnitude`` is the reference's --adversarial knob (distributed_nn.py:66;
+    there parsed but hardcoded to -100 at the call sites — here it is real)."""
+    if err_mode == "rev_grad":
+        return magnitude * grads
+    if err_mode == "constant":
+        return jnp.full_like(grads, magnitude)
+    if err_mode == "random":
+        return grads
+    raise ValueError(f"unknown err_mode: {err_mode}")
+
+
+def attack_cyclic(enc_re: jnp.ndarray, enc_im: jnp.ndarray, err_mode: str, magnitude: float = ADVERSARY):
+    """Adversarial transform of encoded rows, real/imag parts, shape (n, d)."""
+    if err_mode == "rev_grad":
+        return enc_re + magnitude * enc_re, enc_im + magnitude * enc_im
+    if err_mode == "constant":
+        # complex + real array: only the real part shifts
+        return enc_re + magnitude, enc_im
+    if err_mode == "random":
+        return enc_re, enc_im
+    raise ValueError(f"unknown err_mode: {err_mode}")
+
+
+def inject_plain(
+    grads: jnp.ndarray, mask: jnp.ndarray, err_mode: str, magnitude: float = ADVERSARY
+) -> jnp.ndarray:
+    """grads: (n, d); mask: (n,) bool — True rows are Byzantine."""
+    return jnp.where(mask[:, None], attack_plain(grads, err_mode, magnitude), grads)
+
+
+def inject_cyclic(
+    enc_re: jnp.ndarray, enc_im: jnp.ndarray, mask: jnp.ndarray, err_mode: str,
+    magnitude: float = ADVERSARY,
+):
+    bad_re, bad_im = attack_cyclic(enc_re, enc_im, err_mode, magnitude)
+    m = mask[:, None]
+    return jnp.where(m, bad_re, enc_re), jnp.where(m, bad_im, enc_im)

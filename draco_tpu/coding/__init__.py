@@ -1,0 +1,2 @@
+from draco_tpu.coding.cyclic import CyclicCode, build_cyclic_code, encode, decode  # noqa: F401
+from draco_tpu.coding.repetition import RepetitionCode, build_repetition_code, majority_vote  # noqa: F401

@@ -1,0 +1,268 @@
+"""Cyclic (DFT) gradient code — construction, encode, decode.
+
+Re-derivation of the reference's cyclic code (src/coding.py, decode in
+src/master/cyclic_master.py:146-197 with the native error-locator solve in
+src/c_coding.cpp:15-84), designed for XLA: fixed shapes, no data-dependent
+control flow, complex arithmetic carried as (real, imag) pairs because the
+heavy products run on the MXU as real matmuls.
+
+The math (n workers, s Byzantine, ŝ = 2s+1):
+
+  * C = DFT(n)/√n, symmetric unitary. C1 = first n−2s columns, C2 = last 2s.
+  * Encoding matrix W (n×n): column k lies in span(C1) and row i is supported
+    on the cyclic window {i, …, i+ŝ−1 (mod n)}; W = C1·Q with Q[0,:] = 1.
+    Worker i evaluates the ŝ batch-gradients in its window and ships the
+    complex combination Σ_k W[i,k]·g_k.
+  * Received matrix R (n×d) = W·G + ε where ε has ≤ s nonzero rows.
+  * Decode: project R to a vector with a random factor (catch corruption in
+    any coordinate), form the syndrome E2 = C2ᴴ·(R·f) — zero iff ε = 0,
+    since C2ᴴC1 = 0 — solve the s×s Hankel system for the error-locator
+    polynomial, evaluate it on the DFT grid to locate honest rows, then find
+    v supported on honest rows with vᵀC1 = e1ᵀ, which gives
+    vᵀW = 1ᵀ  ⇒  vᵀR = Σ_k g_k exactly.
+
+Everything below the construction is jit-compatible and shape-static: the
+data-dependent "err_indices" selection of the reference
+(cyclic_master.py:162-169) becomes `jnp.nonzero(..., size=n-2s)`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+PREC = jax.lax.Precision.HIGHEST
+
+
+# --------------------------------------------------------------------------
+# Construction (host-side numpy, run identically by every participant at
+# setup — reference: search_w called on all ranks, util.py:185)
+# --------------------------------------------------------------------------
+
+def _dft_c(n: int) -> np.ndarray:
+    """Symmetric scaled DFT matrix C[p,q] = exp(-2πi·pq/n)/√n."""
+    p, q = np.meshgrid(np.arange(n), np.arange(n), indexing="ij")
+    return np.exp(-2j * np.pi * p * q / n) / np.sqrt(n)
+
+
+def _cyclic_support(n: int, hat_s: int) -> np.ndarray:
+    """0/1 mask, row i supported on the cyclic window [i, i+hat_s)."""
+    mask = np.zeros((n, n))
+    for i in range(n):
+        mask[i, (np.arange(i, i + hat_s) % n)] = 1.0
+    return mask
+
+
+def _solve_w(c1: np.ndarray, support: np.ndarray) -> np.ndarray:
+    """W with columns in span(C1), support matching ``support``, Q[0,:]=1.
+
+    For column k: W[:,k] = C1 @ q with q[0] = 1 and W[j,k] = 0 for all j
+    outside the column's support — a small complex least-squares per column.
+    """
+    n, m = c1.shape
+    w = np.zeros((n, n), dtype=complex)
+    for k in range(n):
+        zero_rows = np.where(support[:, k] == 0)[0]
+        a = c1[zero_rows, 1:]
+        b = -c1[zero_rows, 0]
+        q_tail, *_ = np.linalg.lstsq(a, b, rcond=None)
+        q = np.concatenate([[1.0 + 0j], q_tail])
+        w[:, k] = c1 @ q
+    return w
+
+
+@dataclasses.dataclass(frozen=True)
+class CyclicCode:
+    """All constants the encode/decode kernels need, as device-ready arrays."""
+
+    n: int
+    s: int
+    # encoding matrix entries gathered at each worker's support:
+    # w_sel[i, k] = W[i, batch_ids[i, k]], shape (n, hat_s), as re/im pairs
+    w_sel_re: np.ndarray
+    w_sel_im: np.ndarray
+    batch_ids: np.ndarray  # (n, hat_s) int32 — which batches worker i computes
+    # syndrome operator C2^H, shape (2s, n)
+    c2h_re: np.ndarray
+    c2h_im: np.ndarray
+    # C1, shape (n, n-2s) — decode's recombination basis
+    c1_re: np.ndarray
+    c1_im: np.ndarray
+    # locator evaluation grid: est[t, j] = exp(+2πi t/n)^j, shape (n, s+1)
+    est_re: np.ndarray
+    est_im: np.ndarray
+    # support-masked full W for the shared-compute encode path, (n, n)
+    w_masked_re: np.ndarray
+    w_masked_im: np.ndarray
+    # full matrices kept for tests / host tooling
+    w_full: np.ndarray  # complex (n, n)
+    support: np.ndarray  # (n, n) 0/1
+
+    @property
+    def hat_s(self) -> int:
+        return 2 * self.s + 1
+
+
+def build_cyclic_code(n: int, s: int) -> CyclicCode:
+    if n <= 4 * s:
+        raise ValueError(f"cyclic code needs n > 4s, got n={n}, s={s}")
+    hat_s = 2 * s + 1
+    c = _dft_c(n)
+    c1 = c[:, : n - hat_s + 1]  # n-2s columns
+    support = _cyclic_support(n, hat_s)
+    w = _solve_w(c1, support)
+    c2 = c[:, n - hat_s + 1 :]
+    c2h = c2.conj().T  # (2s, n)
+    batch_ids = np.stack([np.where(support[i] != 0)[0] for i in range(n)]).astype(np.int32)
+    w_sel = np.take_along_axis(w, batch_ids, axis=1)  # (n, hat_s)
+    t = np.arange(n)
+    z = np.exp(2j * np.pi * t / n)
+    est = np.stack([z**j for j in range(s + 1)], axis=1)  # (n, s+1)
+    f32 = lambda x: np.ascontiguousarray(x, dtype=np.float32)
+    return CyclicCode(
+        n=n,
+        s=s,
+        w_sel_re=f32(w_sel.real),
+        w_sel_im=f32(w_sel.imag),
+        batch_ids=batch_ids,
+        c2h_re=f32(c2h.real),
+        c2h_im=f32(c2h.imag),
+        c1_re=f32(c1.real),
+        c1_im=f32(c1.imag),
+        est_re=f32(est.real),
+        est_im=f32(est.imag),
+        w_masked_re=f32(w.real * support),
+        w_masked_im=f32(w.imag * support),
+        w_full=w,
+        support=support,
+    )
+
+
+# --------------------------------------------------------------------------
+# Encode (on-device, per worker-shard; reference: cyclic_worker.py:165-194)
+# --------------------------------------------------------------------------
+
+def encode(code: CyclicCode, grads: jnp.ndarray):
+    """Encode per-batch gradients into per-worker complex rows.
+
+    grads: (n, hat_s, d) — grads[i, k] is the gradient of the batch_ids[i, k]-th
+    batch, computed by worker i. Returns (enc_re, enc_im), each (n, d):
+    row i = Σ_k W[i, batch_ids[i,k]] · grads[i, k].
+    """
+    enc_re = jnp.einsum("nk,nkd->nd", jnp.asarray(code.w_sel_re), grads, precision=PREC)
+    enc_im = jnp.einsum("nk,nkd->nd", jnp.asarray(code.w_sel_im), grads, precision=PREC)
+    return enc_re, enc_im
+
+
+def encode_shared(code: CyclicCode, batch_grads: jnp.ndarray):
+    """Encode from one-copy batch gradients (TPU-native fast path).
+
+    batch_grads: (n, d) — gradient of batch k at row k, each computed once.
+    Equivalent to :func:`encode` when redundant computations of the same batch
+    agree bitwise (they do: per-batch gradients are deterministic functions of
+    (params, batch) under XLA). Uses the full masked W as a single matmul.
+    """
+    return (jnp.matmul(jnp.asarray(code.w_masked_re), batch_grads, precision=PREC),
+            jnp.matmul(jnp.asarray(code.w_masked_im), batch_grads, precision=PREC))
+
+
+# --------------------------------------------------------------------------
+# Decode (replicated phase; reference: cyclic_master.py:152-173 +
+# c_coding.cpp:15-84)
+# --------------------------------------------------------------------------
+
+def _complex_solve(a_re, a_im, b_re, b_im, ridge: float = 0.0):
+    """Solve complex A x = b via the real 2m×2m block embedding.
+
+    [[Ar, -Ai], [Ai, Ar]] [xr; xi] = [br; bi]. LU-based jnp.linalg.solve is
+    supported on TPU; the systems here are at most (n-2s) × (n-2s).
+
+    ridge > 0 switches to regularised normal equations, for systems that can
+    be genuinely rank-deficient — the error-locator Hankel system loses rank
+    when fewer than s rows are actually corrupt; the reference used an SVD
+    least-squares there for the same reason (c_coding.cpp:81).
+    """
+    m = a_re.shape[0]
+    top = jnp.concatenate([a_re, -a_im], axis=1)
+    bot = jnp.concatenate([a_im, a_re], axis=1)
+    big = jnp.concatenate([top, bot], axis=0)
+    rhs = jnp.concatenate([b_re, b_im], axis=0)
+    if ridge > 0.0:
+        gram = jnp.matmul(big.T, big, precision=PREC) + ridge * jnp.eye(2 * m, dtype=big.dtype)
+        x = jnp.linalg.solve(gram, jnp.matmul(big.T, rhs, precision=PREC))
+    else:
+        x = jnp.linalg.solve(big, rhs)
+    return x[:m], x[m:]
+
+
+def decode(code: CyclicCode, r_re: jnp.ndarray, r_im: jnp.ndarray, rand_factor: jnp.ndarray):
+    """Recover the exact sum of the n batch gradients from corrupt rows.
+
+    r_re, r_im: (n, d) received encoded rows (≤ s rows arbitrarily corrupt).
+    rand_factor: (d,) random projection (reference: cyclic_master.py:58-61).
+    Returns (n·mean-gradient, honest_mask): the (d,) real decoded sum / n and
+    the located honest-row mask (n,) for observability.
+    """
+    n, s = code.n, code.s
+    c2h_re = jnp.asarray(code.c2h_re)
+    c2h_im = jnp.asarray(code.c2h_im)
+
+    # 1. project to one column: e = R @ f  (the only O(n·d) work besides the
+    #    final recombination — MXU-friendly matvecs)
+    e_re = jnp.matmul(r_re, rand_factor, precision=PREC)
+    e_im = jnp.matmul(r_im, rand_factor, precision=PREC)
+
+    # 2. syndrome E2 = C2^H e, shape (2s,)
+    e2_re = jnp.matmul(c2h_re, e_re, precision=PREC) - jnp.matmul(c2h_im, e_im, precision=PREC)
+    e2_im = jnp.matmul(c2h_re, e_im, precision=PREC) + jnp.matmul(c2h_im, e_re, precision=PREC)
+
+    if s > 0:
+        # 3. Hankel system A α = b from syndrome entries
+        #    (c_coding.cpp:74-79: A[i,:] = E2[s-i-1 : 2s-i-1], b[i] = E2[2s-i-1])
+        rows = jnp.arange(s)
+        cols = jnp.arange(s)
+        idx = (s - rows[:, None] - 1) + cols[None, :]
+        a_re, a_im = e2_re[idx], e2_im[idx]
+        b_idx = 2 * s - rows - 1
+        b_re, b_im = e2_re[b_idx], e2_im[b_idx]
+        # α is invariant to a common scaling of (A, b); normalising by the
+        # syndrome magnitude makes the ridge scale-free
+        scale = jnp.maximum(jnp.max(e2_re**2 + e2_im**2) ** 0.5, 1e-30)
+        alpha_re, alpha_im = _complex_solve(
+            a_re / scale, a_im / scale, b_re / scale, b_im / scale, ridge=1e-8
+        )
+
+        # 4. locator polynomial p(z) = z^s - Σ α_j z^j, roots at corrupt rows
+        #    (cyclic_master.py:159-162)
+        poly_re = jnp.concatenate([-alpha_re, jnp.ones((1,), a_re.dtype)])
+        poly_im = jnp.concatenate([-alpha_im, jnp.zeros((1,), a_re.dtype)])
+        est_re = jnp.asarray(code.est_re)
+        est_im = jnp.asarray(code.est_im)
+        val_re = jnp.matmul(est_re, poly_re, precision=PREC) - jnp.matmul(est_im, poly_im, precision=PREC)
+        val_im = jnp.matmul(est_re, poly_im, precision=PREC) + jnp.matmul(est_im, poly_re, precision=PREC)
+        mag = val_re**2 + val_im**2
+        # honest rows: locator does not vanish. Relative threshold replaces the
+        # reference's absolute 1e-9 (float64 there, float32 here).
+        honest = mag > (1e-6 * jnp.max(mag))
+    else:
+        honest = jnp.ones((n,), dtype=bool)
+
+    # 5. recombination vector v: supported on the first n-2s honest rows,
+    #    v^T C1[idx] = e1^T  (fixed-shape stand-in for the reference's
+    #    dynamic err_indices + scipy lsq_linear, cyclic_master.py:164-171)
+    m = n - 2 * s
+    (idx,) = jnp.nonzero(honest, size=m, fill_value=0)
+    rec_re = jnp.asarray(code.c1_re)[idx]  # (m, m)
+    rec_im = jnp.asarray(code.c1_im)[idx]
+    e1 = jnp.zeros((m,), rec_re.dtype).at[0].set(1.0)
+    v_re, v_im = _complex_solve(rec_re.T, rec_im.T, e1, jnp.zeros_like(e1))
+
+    v_full_re = jnp.zeros((n,), rec_re.dtype).at[idx].set(v_re)
+    v_full_im = jnp.zeros((n,), rec_re.dtype).at[idx].set(v_im)
+
+    # 6. recombine: Re(v^T R) / n — the second O(n·d) matvec
+    decoded = (jnp.matmul(v_full_re, r_re, precision=PREC) - jnp.matmul(v_full_im, r_im, precision=PREC)) / n
+    return decoded, honest

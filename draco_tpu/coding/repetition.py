@@ -1,0 +1,53 @@
+"""Repetition code ("maj_vote") — grouping + on-device majority vote.
+
+Reference semantics: workers are partitioned into groups of size r; members of
+a group share a shuffle seed and therefore compute *identical* batches
+(rep_worker.py:89); the PS takes, per group, the value held by a strict
+majority of members — implemented there as a Boyer–Moore pass with bitwise
+np.array_equal (rep_master.py:154-168) — then averages the group winners.
+
+TPU-native formulation: per-worker gradients form (n, d); reshape to
+(G, r, d); the vote is an argmax over per-member "agreement counts" computed
+from the exact pairwise-equality matrix. Exact equality is sound here for the
+same reason it is in the reference: group members run the identical
+deterministic computation on identical inputs (a vmap lane under XLA), so
+honest replicas agree bitwise while an attacked row differs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class RepetitionCode:
+    n: int
+    r: int  # group size
+
+    @property
+    def num_groups(self) -> int:
+        return self.n // self.r
+
+    def group_of(self, worker: int) -> int:
+        return worker // self.r
+
+
+def build_repetition_code(n: int, r: int) -> RepetitionCode:
+    if n % r != 0:
+        raise ValueError(f"num_workers {n} must be divisible by group_size {r}")
+    return RepetitionCode(n=n, r=r)
+
+
+def majority_vote(code: RepetitionCode, grads: jnp.ndarray) -> jnp.ndarray:
+    """grads: (n, d) -> (d,) mean over groups of each group's majority row."""
+    g, r = code.num_groups, code.r
+    rows = grads.reshape(g, r, -1)
+    # pairwise exact-equality counts, (G, r): agree[g, i] = #{j : row_i == row_j}
+    eq = jnp.all(rows[:, :, None, :] == rows[:, None, :, :], axis=-1)
+    agree = jnp.sum(eq, axis=-1)
+    winner = jnp.argmax(agree, axis=-1)  # (G,)
+    picked = jnp.take_along_axis(rows, winner[:, None, None], axis=1)[:, 0, :]
+    return jnp.mean(picked, axis=0)

@@ -1,0 +1,120 @@
+"""Experiment configuration.
+
+Knob parity with the reference CLI (reference: src/distributed_nn.py:23-77 and
+src/single_machine.py:27-54), folded into one dataclass instead of per-entry
+argparse. Quirks intentionally dropped: ``--comm-type`` (admitted fake,
+reference README.md:111), ``--num-aggregate`` (unused, distributed_nn.py:60).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+# Deterministic seed shared by every participant, mirroring the reference's
+# global SEED_=428 (reference: src/util.py:17). Every device derives the
+# adversary schedule / group seeds / shuffles from this, so all agree.
+SEED = 428
+
+
+@dataclasses.dataclass
+class TrainConfig:
+    # --- model / data (reference: distributed_nn.py:27-37) ---
+    network: str = "LeNet"  # LeNet | FC | ResNet18/34/50/101/152 | VGG11/13/16/19[_bn]
+    dataset: str = "MNIST"  # MNIST | Cifar10 | synthetic variants
+    data_dir: str = "./data"
+    batch_size: int = 128  # per-worker batch size
+    test_batch_size: int = 1000
+
+    # --- optimization (reference: distributed_nn.py:31-43) ---
+    optimizer: str = "sgd"  # sgd | adam  (SGDModified / AdamModified semantics)
+    lr: float = 0.01
+    momentum: float = 0.9
+    max_steps: int = 10000
+    epochs: int = 100
+
+    # --- distributed topology ---
+    num_workers: int = 8  # n logical workers = size of mesh axis `w`
+    # Approach selects the training runtime, mirroring --approach
+    # (reference: distributed_nn.py:87-133):
+    #   baseline : plain data parallel + robust aggregation per `mode`
+    #   maj_vote : repetition code, groups of size `group_size`, majority vote
+    #   cyclic   : cyclic (DFT) code, tolerates s Byzantine workers
+    approach: str = "baseline"
+    # Aggregation mode for approach=baseline
+    # (reference: baseline_master.py:118-129): normal | geometric_median | krum
+    mode: str = "normal"
+    group_size: int = 3  # r, repetition redundancy (reference: distributed_nn.py:70)
+    worker_fail: int = 0  # s, number of Byzantine workers (distributed_nn.py:68)
+
+    # --- adversary simulation (reference: distributed_nn.py:64-67) ---
+    err_mode: str = "rev_grad"  # rev_grad | constant | random
+    adversarial: float = -100.0  # attack magnitude (model_ops/utils.py:3-4)
+
+    # --- coded-path execution strategy (TPU-native addition) ---
+    # "simulate": every worker really computes its (2s+1) redundant batches,
+    #             matching the reference's r× compute cost (cyclic_worker.py:122).
+    # "shared":   each distinct batch gradient is computed once on the mesh and
+    #             encoded rows are formed algebraically — identical semantics
+    #             (per-batch gradients are deterministic), r× less compute.
+    redundancy: str = "simulate"
+    # Decode granularity: "global" locates the corrupt-row set once on the
+    # flattened gradient (valid: corruption is per-worker, shared by layers);
+    # "layer" re-runs the locator per layer like the reference
+    # (cyclic_master.py:126-128).
+    decode_granularity: str = "global"
+
+    # --- precision ---
+    compute_dtype: str = "float32"  # forward/backward dtype (bfloat16|float32)
+    code_dtype: str = "float32"  # encode/decode arithmetic dtype
+
+    # --- eval / checkpoint (reference: distributed_nn.py:56-75) ---
+    eval_freq: int = 50
+    train_dir: str = "./train_out/"
+    checkpoint_step: int = 0  # resume from this step if >0
+
+    # --- misc ---
+    seed: int = SEED
+    geomedian_iters: int = 80  # Weiszfeld iterations (replaces hdmedians dep)
+    log_every: int = 10
+
+    @property
+    def s(self) -> int:
+        return self.worker_fail
+
+    @property
+    def hat_s(self) -> int:
+        """Batches per worker under the cyclic code (reference: cyclic_worker.py:29)."""
+        return 2 * self.worker_fail + 1
+
+    @property
+    def num_groups(self) -> int:
+        return self.num_workers // self.group_size
+
+    def validate(self) -> "TrainConfig":
+        if self.approach not in ("baseline", "maj_vote", "cyclic"):
+            raise ValueError(f"unknown approach: {self.approach}")
+        if self.approach == "baseline" and self.mode not in (
+            "normal", "geometric_median", "krum"
+        ):
+            raise ValueError(f"baseline supports mode normal|geometric_median|krum, got: {self.mode}")
+        if self.mode == "krum" and self.num_workers < self.worker_fail + 3:
+            raise ValueError("krum requires num_workers >= worker_fail + 3")
+        if self.err_mode not in ("rev_grad", "constant", "random"):
+            raise ValueError(f"unknown err_mode: {self.err_mode}")
+        if self.approach == "maj_vote":
+            if self.num_workers % self.group_size != 0:
+                raise ValueError(
+                    "maj_vote requires num_workers divisible by group_size "
+                    f"(got {self.num_workers} % {self.group_size})"
+                )
+        if self.approach == "cyclic":
+            if self.num_workers <= 4 * self.worker_fail:
+                # decode needs n-2s honest rows to span C1's n-2s columns and
+                # the locator solve needs 2s syndrome equations
+                raise ValueError(
+                    f"cyclic code needs n > 4s (got n={self.num_workers}, s={self.worker_fail})"
+                )
+        if self.worker_fail > self.num_workers:
+            raise ValueError("worker_fail cannot exceed num_workers")
+        return self

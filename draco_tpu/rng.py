@@ -1,0 +1,70 @@
+"""Deterministic randomness discipline.
+
+The reference makes every process agree on "who is adversarial at step t" and
+"which group shuffles with which seed" by seeding numpy's global RNG with
+SEED_=428 on every rank (reference: src/util.py:17,79-103). We keep the
+*property* (every participant derives the identical schedule) with
+``jax.random`` keys folded from the experiment seed — no global RNG state.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def adversary_schedule(seed: int, max_steps: int, num_workers: int, num_fail: int) -> np.ndarray:
+    """Boolean mask of shape (max_steps + 1, num_workers).
+
+    ``mask[t, i]`` is True iff logical worker i behaves Byzantine at step t.
+    Exactly ``num_fail`` workers per step, sampled without replacement, from a
+    schedule every participant can recompute (reference semantics:
+    src/util.py:100-103 pre-generates per-step adversary index lists from a
+    fixed seed so all ranks agree).
+    """
+    mask = np.zeros((max_steps + 1, num_workers), dtype=bool)
+    if num_fail == 0:
+        return mask
+    rng = np.random.RandomState(seed)
+    for t in range(max_steps + 1):
+        idx = rng.choice(num_workers, size=num_fail, replace=False)
+        mask[t, idx] = True
+    return mask
+
+
+def group_seeds(seed: int, num_groups: int) -> np.ndarray:
+    """Per-group shuffle seeds, identical on every participant.
+
+    Mirrors util.py:79-87: members of a repetition group share a shuffle seed
+    so they draw identical batches (that is what makes the bitwise majority
+    vote sound, reference: rep_worker.py:89, rep_master.py:162).
+    """
+    rng = np.random.RandomState(seed)
+    return rng.randint(0, 20000, size=num_groups)
+
+
+def epoch_permutation(seed: int, epoch: int, n: int) -> np.ndarray:
+    """Shuffle of ``n`` sample indices for a given epoch from a shared seed.
+
+    Reference re-seeds torch at every epoch with seed+factor*epoch
+    (rep_worker.py:89, cyclic_worker.py:88); we fold (seed, epoch) into one
+    stream the same agreed-upon way.
+    """
+    rng = np.random.RandomState((seed * 100003 + epoch * 23) % (2**31 - 1))
+    return rng.permutation(n)
+
+
+def fold(key: jax.Array, *data: int) -> jax.Array:
+    """Fold a sequence of ints into a key (step ids, batch ids, worker ids)."""
+    for d in data:
+        key = jax.random.fold_in(key, d)
+    return key
+
+
+def random_projection_factors(seed: int, dim: int) -> np.ndarray:
+    """The decode-side random projection vector (reference: cyclic_master.py:58-61,
+    np.random.normal(loc=1.0) per layer). One factor per gradient coordinate;
+    drawn once at setup, shared by all participants."""
+    rng = np.random.RandomState(seed + 7919)
+    return rng.normal(loc=1.0, scale=1.0, size=dim).astype(np.float32)

@@ -1,0 +1,72 @@
+"""Mesh / distributed runtime bootstrap.
+
+Replaces the reference's MPI world wiring (reference: src/distributed_nn.py:79-133,
+rank 0 = parameter server process, ranks 1..P = workers). Here there are no
+roles: one SPMD program over a ``Mesh`` with a worker axis ``w``. A logical
+worker is a shard of the worker axis; the "PS" is the replicated post-gather
+phase of the same jitted step.
+
+Multi-host: call :func:`init_distributed` once per host before any jax call;
+the mesh then spans all hosts' devices and the gradient gather rides ICI
+within a slice and DCN across slices — the same program, no code changes
+(replaces the reference's NCCL/MPI-over-TCP transport, README.md:16).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+WORKER_AXIS = "w"
+
+
+def init_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Initialise multi-host JAX if requested via args or env.
+
+    No-op on a single host. Mirrors the role of the reference's mpirun
+    bootstrap (src/README.md:10) without assigning roles to ranks.
+    """
+    addr = coordinator_address or os.environ.get("DRACO_COORDINATOR")
+    if addr is None:
+        return
+    if num_processes is None:
+        num_processes = int(os.environ["DRACO_NUM_PROCESSES"])
+    if process_id is None:
+        process_id = int(os.environ["DRACO_PROCESS_ID"])
+    jax.distributed.initialize(
+        coordinator_address=addr,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def make_mesh(num_workers: int, devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build a 1-D mesh with axis ``w``.
+
+    ``num_workers`` logical workers are laid out over the available devices;
+    if there are fewer devices than workers, each device holds a contiguous
+    block of the worker axis (num_workers % num_devices must be 0); if there
+    are more devices than workers, the extra devices are left out of the mesh.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n_dev = min(len(devices), num_workers)
+    while num_workers % n_dev != 0:
+        n_dev -= 1
+    return Mesh(np.asarray(devices[:n_dev]), (WORKER_AXIS,))
+
+
+def worker_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for arrays with a leading logical-worker axis."""
+    return NamedSharding(mesh, P(WORKER_AXIS))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

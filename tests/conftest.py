@@ -1,0 +1,27 @@
+"""Test harness: an 8-device virtual CPU mesh stands in for the multi-chip
+TPU slice (and for the reference's mpirun-oversubscribed localhost cluster,
+reference: src/README.md:8-11).
+
+The XLA_FLAGS env must be set before jax initialises; the platform choice must
+go through jax.config (this image's sitecustomize registers a remote-TPU
+plugin whose config latches before test env vars apply).
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(0)

@@ -1,0 +1,145 @@
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from draco_tpu import aggregation
+from draco_tpu.attacks import inject_plain
+from draco_tpu.coding import repetition
+
+
+class TestMajorityVote:
+    def test_recovers_honest_under_minority_corruption(self, rng):
+        n, r, d = 9, 3, 40
+        code = repetition.build_repetition_code(n, r)
+        honest = rng.randn(code.num_groups, d).astype(np.float32)
+        grads = np.repeat(honest, r, axis=0)  # identical within group
+        # corrupt one member per group (minority)
+        adv = np.zeros(n, dtype=bool)
+        adv[[0, 4, 8]] = True
+        g = inject_plain(jnp.asarray(grads), jnp.asarray(adv), "rev_grad")
+        out = repetition.majority_vote(code, g)
+        np.testing.assert_allclose(np.asarray(out), honest.mean(axis=0), rtol=1e-6)
+
+    def test_constant_attack(self, rng):
+        n, r, d = 6, 3, 8
+        code = repetition.build_repetition_code(n, r)
+        honest = rng.randn(code.num_groups, d).astype(np.float32)
+        grads = np.repeat(honest, r, axis=0)
+        adv = np.zeros(n, dtype=bool)
+        adv[[1, 5]] = True
+        g = inject_plain(jnp.asarray(grads), jnp.asarray(adv), "constant")
+        out = repetition.majority_vote(code, g)
+        np.testing.assert_allclose(np.asarray(out), honest.mean(axis=0), rtol=1e-6)
+
+    def test_rejects_bad_group_size(self):
+        with pytest.raises(ValueError):
+            repetition.build_repetition_code(7, 3)
+
+
+def krum_oracle(grad_list, n, s):
+    """Direct transcription of the reference loop semantics
+    (baseline_master.py:278-291) as a float64 oracle."""
+    score = []
+    for i, g_i in enumerate(grad_list):
+        dists = [np.linalg.norm(g_i - g_j) ** 2 for j, g_j in enumerate(grad_list) if i != j]
+        score.append(sum(np.sort(dists)[: n - s - 2]))
+    return grad_list[int(np.argmin(score))]
+
+
+class TestAggregators:
+    def test_mean(self, rng):
+        g = rng.randn(8, 10).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(aggregation.mean(jnp.asarray(g))), g.mean(axis=0), rtol=1e-6
+        )
+
+    def test_krum_matches_oracle(self, rng):
+        n, s, d = 8, 2, 30
+        g = rng.randn(n, d).astype(np.float32)
+        g[3] *= -100  # an attacked row
+        out = aggregation.krum(jnp.asarray(g), s)
+        want = krum_oracle(list(g), n, s)
+        np.testing.assert_allclose(np.asarray(out), want, rtol=1e-5)
+
+    def test_krum_discards_adversary(self, rng):
+        n, s, d = 10, 2, 16
+        base = rng.randn(d).astype(np.float32)
+        g = base[None, :] + 0.01 * rng.randn(n, d).astype(np.float32)
+        g[[2, 7]] = -100.0 * g[[2, 7]]
+        out = np.asarray(aggregation.krum(jnp.asarray(g), s))
+        assert np.linalg.norm(out - base) < 1.0
+
+    def test_geometric_median_point_cloud(self, rng):
+        # for a cloud with an extreme outlier, the geometric median stays
+        # near the honest cluster while the mean does not
+        n, d = 9, 12
+        base = rng.randn(d).astype(np.float32)
+        g = base[None, :] + 0.05 * rng.randn(n, d).astype(np.float32)
+        g[4] = 1000.0
+        gm = np.asarray(aggregation.geometric_median(jnp.asarray(g)))
+        assert np.linalg.norm(gm - base) < 1.0
+        assert np.linalg.norm(g.mean(axis=0) - base) > 50.0
+
+    def test_geometric_median_weiszfeld_fixpoint(self, rng):
+        # 1-D: geometric median == coordinate-wise median for odd count
+        g = np.array([[1.0], [2.0], [3.0], [4.0], [100.0]], dtype=np.float32)
+        gm = np.asarray(aggregation.geometric_median(jnp.asarray(g), iters=200))
+        assert abs(gm[0] - 3.0) < 1e-2
+
+    def test_aggregate_dispatch(self, rng):
+        g = jnp.asarray(rng.randn(8, 5).astype(np.float32))
+        for mode in ("normal", "geometric_median", "krum"):
+            out = aggregation.aggregate(g, mode, s=1)
+            assert out.shape == (5,)
+        with pytest.raises(ValueError):
+            aggregation.aggregate(g, "bogus")
+
+
+class TestAttacks:
+    def test_plain_modes(self, rng):
+        from draco_tpu import attacks
+
+        g = jnp.asarray(rng.randn(4, 6).astype(np.float32))
+        mask = jnp.asarray(np.array([True, False, False, True]))
+        out = np.asarray(attacks.inject_plain(g, mask, "rev_grad"))
+        np.testing.assert_allclose(out[0], -100 * np.asarray(g)[0], rtol=1e-6)
+        np.testing.assert_allclose(out[1], np.asarray(g)[1], rtol=1e-6)
+        out = np.asarray(attacks.inject_plain(g, mask, "constant"))
+        np.testing.assert_allclose(out[3], -100.0)
+        out = np.asarray(attacks.inject_plain(g, mask, "random"))
+        np.testing.assert_allclose(out, np.asarray(g))
+
+    def test_cyclic_additive(self, rng):
+        from draco_tpu import attacks
+
+        re = jnp.asarray(rng.randn(3, 4).astype(np.float32))
+        im = jnp.asarray(rng.randn(3, 4).astype(np.float32))
+        mask = jnp.asarray(np.array([False, True, False]))
+        o_re, o_im = attacks.inject_cyclic(re, im, mask, "rev_grad")
+        np.testing.assert_allclose(np.asarray(o_re)[1], -99 * np.asarray(re)[1], rtol=1e-5)
+        o_re, o_im = attacks.inject_cyclic(re, im, mask, "constant")
+        np.testing.assert_allclose(np.asarray(o_re)[1], np.asarray(re)[1] - 100.0, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(o_im)[1], np.asarray(im)[1], rtol=1e-6)
+
+
+class TestSchedules:
+    def test_adversary_schedule_deterministic(self):
+        from draco_tpu import rng as drng
+
+        a = drng.adversary_schedule(428, 50, 8, 2)
+        b = drng.adversary_schedule(428, 50, 8, 2)
+        np.testing.assert_array_equal(a, b)
+        assert (a.sum(axis=1) == 2).all()
+
+    def test_group_seeds_agree(self):
+        from draco_tpu import rng as drng
+
+        np.testing.assert_array_equal(drng.group_seeds(428, 4), drng.group_seeds(428, 4))
+
+    def test_epoch_permutation(self):
+        from draco_tpu import rng as drng
+
+        p1 = drng.epoch_permutation(5, 0, 100)
+        p2 = drng.epoch_permutation(5, 1, 100)
+        assert not np.array_equal(p1, p2)
+        assert sorted(p1) == list(range(100))
