@@ -1,0 +1,111 @@
+"""Benchmark harness — one JSON line for the driver.
+
+Headline metric (BASELINE.json north star): per-step wall-clock of the
+flagship config — ResNet-18 / CIFAR-10 shapes, n=8 coded workers, cyclic code
+s=1 under reverse-gradient attack — on the available accelerator.
+
+``vs_baseline``: the reference repo publishes no numbers (BASELINE.md), so the
+paper's headline comparison is reported instead: speedup of the cyclic-decode
+step over the geometric-median robust-aggregation step at identical model /
+batch / adversary schedule (Draco's core claim — reference README.md:2,
+baseline_master.py:271-276). Values > 1 mean decode beats geo-median.
+
+Flags: --steps N --warmup N --batch-size B --network NAME --cpu-mesh N (debug)
+"""
+
+import argparse
+import json
+import sys
+import time
+
+
+def run(cfg_kwargs, ds, mesh, steps, warmup):
+    import jax
+    import jax.numpy as jnp
+
+    from draco_tpu.config import TrainConfig
+    from draco_tpu.training.trainer import Trainer
+
+    cfg = TrainConfig(**cfg_kwargs)
+    tr = Trainer(cfg, mesh=mesh, dataset=ds, quiet=True)
+    state = tr.state
+    # warmup (compile)
+    for step in range(1, warmup + 1):
+        x, y = tr._device_batch(step)
+        state, m = tr.setup.train_step(state, x, y, jnp.asarray(tr._adv_schedule[step]))
+    jax.block_until_ready(state.params)
+    t0 = time.perf_counter()
+    for step in range(warmup + 1, warmup + steps + 1):
+        x, y = tr._device_batch(step)
+        state, m = tr.setup.train_step(state, x, y, jnp.asarray(tr._adv_schedule[step]))
+    jax.block_until_ready(state.params)
+    dt = (time.perf_counter() - t0) / steps
+    return dt, float(m["loss"])
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--warmup", type=int, default=3)
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--network", type=str, default="ResNet18")
+    p.add_argument("--num-workers", type=int, default=8)
+    p.add_argument("--cpu-mesh", type=int, default=0)
+    args = p.parse_args()
+
+    from draco_tpu.cli import maybe_force_cpu_mesh
+
+    maybe_force_cpu_mesh(args)
+
+    from draco_tpu.data.datasets import load_dataset
+    from draco_tpu.runtime import make_mesh
+
+    ds = load_dataset("Cifar10", data_dir="./data")
+    mesh = make_mesh(args.num_workers)
+
+    common = dict(
+        network=args.network,
+        dataset="Cifar10",
+        batch_size=args.batch_size,
+        lr=0.01,
+        momentum=0.9,
+        num_workers=args.num_workers,
+        worker_fail=1,
+        err_mode="rev_grad",
+        max_steps=args.warmup + args.steps + 1,
+        eval_freq=0,
+        train_dir="",
+        log_every=10**9,
+    )
+
+    # the contender: cyclic code, r=2s+1 redundant compute like the reference
+    t_cyclic, loss_c = run(
+        dict(common, approach="cyclic", redundancy="simulate"),
+        ds, mesh, args.steps, args.warmup,
+    )
+    # the baseline robust aggregator Draco positions against
+    t_geomed, loss_g = run(
+        dict(common, approach="baseline", mode="geometric_median"),
+        ds, mesh, args.steps, args.warmup,
+    )
+
+    out = {
+        "metric": f"{args.network.lower()}_cifar10_cyclic_s1_revgrad_step_wallclock",
+        "value": round(t_cyclic * 1000.0, 3),
+        "unit": "ms/step",
+        "vs_baseline": round(t_geomed / t_cyclic, 4),
+        "extra": {
+            "geomedian_step_ms": round(t_geomed * 1000.0, 3),
+            "num_workers": args.num_workers,
+            "batch_size_per_worker": args.batch_size,
+            "dataset": ds.name,
+            "loss_cyclic": round(loss_c, 4),
+            "loss_geomedian": round(loss_g, 4),
+        },
+    }
+    print(json.dumps(out))
+    return out
+
+
+if __name__ == "__main__":
+    sys.exit(0 if main() else 1)

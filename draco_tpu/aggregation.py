@@ -44,7 +44,11 @@ def krum(grads: jnp.ndarray, s: int) -> jnp.ndarray:
     if n < s + 3:
         raise ValueError(f"krum requires n >= s+3 (got n={n}, s={s})")
     k = n - s - 2
-    sq = jnp.sum((grads[:, None, :] - grads[None, :, :]) ** 2, axis=-1)
+    # ||gi-gj||^2 via the Gram identity: one (n,d)@(d,n) MXU matmul instead of
+    # an (n,n,d) broadcast intermediate
+    gram = jnp.matmul(grads, grads.T, precision=jax.lax.Precision.HIGHEST)
+    norms = jnp.diag(gram)
+    sq = jnp.maximum(norms[:, None] + norms[None, :] - 2.0 * gram, 0.0)
     sq = sq + jnp.diag(jnp.full((n,), jnp.inf, dtype=grads.dtype))
     neighbor_sorted = jnp.sort(sq, axis=1)
     scores = jnp.sum(neighbor_sorted[:, :k], axis=1)

@@ -1,0 +1,120 @@
+"""Command-line entry point — flag parity with the reference's
+``mpirun -n P+1 python distributed_nn.py`` (reference: src/distributed_nn.py:23-77),
+minus the MPI: one process drives the whole mesh (or one per host under
+multi-host jax.distributed).
+
+Usage examples:
+  python -m draco_tpu.cli --approach cyclic --network LeNet --dataset MNIST \\
+      --num-workers 8 --worker-fail 1 --err-mode rev_grad --max-steps 500
+  python -m draco_tpu.cli --approach baseline --mode geometric_median ...
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from draco_tpu.config import SEED, TrainConfig
+
+
+def add_fit_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
+    """Reference: add_fit_args, distributed_nn.py:23-77."""
+    p = parser
+    p.add_argument("--batch-size", type=int, default=128)
+    p.add_argument("--test-batch-size", type=int, default=1000)
+    p.add_argument("--lr", type=float, default=0.01)
+    p.add_argument("--momentum", type=float, default=0.9)
+    p.add_argument("--optimizer", type=str, default="sgd", choices=["sgd", "adam"])
+    p.add_argument("--max-steps", type=int, default=10000)
+    p.add_argument("--epochs", type=int, default=100)
+    p.add_argument("--network", type=str, default="LeNet")
+    p.add_argument("--dataset", type=str, default="MNIST")
+    p.add_argument("--data-dir", type=str, default="./data")
+    p.add_argument("--approach", type=str, default="baseline",
+                   choices=["baseline", "maj_vote", "cyclic"])
+    p.add_argument("--mode", type=str, default="normal",
+                   choices=["normal", "geometric_median", "krum"],
+                   help="aggregation for --approach baseline")
+    p.add_argument("--num-workers", type=int, default=8,
+                   help="logical workers n (the reference's mpirun -n minus the PS)")
+    p.add_argument("--group-size", type=int, default=3,
+                   help="repetition redundancy r for maj_vote")
+    p.add_argument("--worker-fail", type=int, default=0, help="s Byzantine workers")
+    p.add_argument("--err-mode", type=str, default="rev_grad",
+                   choices=["rev_grad", "constant", "random"])
+    p.add_argument("--adversarial", type=float, default=-100.0,
+                   help="attack magnitude (reference hardcoded -100)")
+    p.add_argument("--redundancy", type=str, default="simulate",
+                   choices=["simulate", "shared"],
+                   help="simulate: r-times redundant compute like the reference; "
+                        "shared: algebraically identical compute-once fast path")
+    p.add_argument("--eval-freq", type=int, default=50)
+    p.add_argument("--train-dir", type=str, default="./train_out/")
+    p.add_argument("--checkpoint-step", type=int, default=0)
+    p.add_argument("--seed", type=int, default=SEED)
+    p.add_argument("--log-every", type=int, default=10)
+    p.add_argument("--cpu-mesh", type=int, default=0, metavar="N",
+                   help="force an N-device virtual CPU mesh (testing without TPUs)")
+    return p
+
+
+def maybe_force_cpu_mesh(args: argparse.Namespace) -> None:
+    """Apply --cpu-mesh N: an N-device virtual CPU mesh instead of accelerators.
+    Must run before any jax computation; safe to call twice."""
+    if getattr(args, "cpu_mesh", 0):
+        import os
+
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={args.cpu_mesh}"
+        ).strip()
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+
+def config_from_args(args: argparse.Namespace) -> TrainConfig:
+    return TrainConfig(
+        network=args.network,
+        dataset=args.dataset,
+        data_dir=args.data_dir,
+        batch_size=args.batch_size,
+        test_batch_size=args.test_batch_size,
+        optimizer=args.optimizer,
+        lr=args.lr,
+        momentum=args.momentum,
+        max_steps=args.max_steps,
+        epochs=args.epochs,
+        num_workers=args.num_workers,
+        approach=args.approach,
+        mode=args.mode,
+        group_size=args.group_size,
+        worker_fail=args.worker_fail,
+        err_mode=args.err_mode,
+        adversarial=args.adversarial,
+        redundancy=args.redundancy,
+        eval_freq=args.eval_freq,
+        train_dir=args.train_dir,
+        checkpoint_step=args.checkpoint_step,
+        seed=args.seed,
+        log_every=args.log_every,
+    ).validate()
+
+
+def main(argv=None):
+    parser = add_fit_args(argparse.ArgumentParser(description="draco_tpu trainer"))
+    args = parser.parse_args(argv)
+
+    maybe_force_cpu_mesh(args)
+
+    from draco_tpu.runtime import init_distributed
+    from draco_tpu.training.trainer import Trainer
+
+    init_distributed()
+    cfg = config_from_args(args)
+    trainer = Trainer(cfg)
+    last = trainer.run()
+    return last
+
+
+if __name__ == "__main__":
+    main()

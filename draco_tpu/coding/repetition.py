@@ -36,6 +36,9 @@ class RepetitionCode:
 
 
 def build_repetition_code(n: int, r: int) -> RepetitionCode:
+    """Byzantine tolerance is (r-1)//2 per group: with r < 3 a single
+    adversary ties the vote and the tie-break is arbitrary — config.validate
+    enforces r >= 2s+1 whenever worker_fail > 0."""
     if n % r != 0:
         raise ValueError(f"num_workers {n} must be divisible by group_size {r}")
     return RepetitionCode(n=n, r=r)

@@ -108,6 +108,14 @@ class TrainConfig:
                     "maj_vote requires num_workers divisible by group_size "
                     f"(got {self.num_workers} % {self.group_size})"
                 )
+            if self.worker_fail > 0 and self.group_size < 2 * self.worker_fail + 1:
+                # the repetition code's guarantee is r = 2s+1 (reference
+                # README.md:9); with r < 2s+1 all s adversaries can land in one
+                # group and break its majority
+                raise ValueError(
+                    f"maj_vote with worker_fail={self.worker_fail} requires "
+                    f"group_size >= {2 * self.worker_fail + 1} (r = 2s+1)"
+                )
         if self.approach == "cyclic":
             if self.num_workers <= 4 * self.worker_fail:
                 # decode needs n-2s honest rows to span C1's n-2s columns and

@@ -1,0 +1,7 @@
+from draco_tpu.data.datasets import Dataset, load_dataset  # noqa: F401
+from draco_tpu.data.batching import (  # noqa: F401
+    get_batch,
+    worker_batches_baseline,
+    worker_batches_grouped,
+    cyclic_global_batch,
+)

@@ -1,0 +1,33 @@
+"""On-device data augmentation (CIFAR-10 policy of the reference:
+reflect-pad 4, random 32×32 crop, random horizontal flip — util.py:42-52;
+MNIST gets normalisation only).
+
+Runs inside the jitted step on the worker-sharded batch, so augmentation
+cost rides the accelerator and determinism is a property of the rng key:
+the trainer folds the key per (step, group-or-row), which keeps repetition
+group members' batches bitwise identical (vote soundness) and cyclic batch
+rows worker-independent (decode exactness).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _augment_one(x: jnp.ndarray, key: jax.Array, pad: int = 4) -> jnp.ndarray:
+    """x: (H, W, C) — reflect-pad, random crop back to (H, W), random flip."""
+    h, w, _ = x.shape
+    kh, kw, kf = jax.random.split(key, 3)
+    xp = jnp.pad(x, ((pad, pad), (pad, pad), (0, 0)), mode="reflect")
+    top = jax.random.randint(kh, (), 0, 2 * pad + 1)
+    left = jax.random.randint(kw, (), 0, 2 * pad + 1)
+    x = jax.lax.dynamic_slice(xp, (top, left, 0), (h, w, x.shape[2]))
+    flip = jax.random.bernoulli(kf)
+    return jnp.where(flip, x[:, ::-1, :], x)
+
+
+def augment_batch(x: jnp.ndarray, key: jax.Array, pad: int = 4) -> jnp.ndarray:
+    """x: (B, H, W, C); per-sample independent draws from ``key``."""
+    keys = jax.random.split(key, x.shape[0])
+    return jax.vmap(_augment_one, in_axes=(0, 0, None))(x, keys, pad)

@@ -1,0 +1,92 @@
+"""Deterministic batch construction for the three training approaches.
+
+The reference's load-bearing batching invariants (SURVEY.md §2.1 rows 9, 10, 19):
+
+  * baseline: each worker draws an independent shuffle.
+  * maj_vote: all members of a repetition group share a shuffle seed, so the
+    group computes *identical* batches every step (rep_worker.py:89) — the
+    soundness condition of the bitwise majority vote.
+  * cyclic: every worker addresses one deterministic *global* batch of
+    n·B consecutive post-shuffle samples per step (get_batch with an explicit
+    index range, cyclic_worker.py:91-96, datasets/utils.py:7-29) and computes
+    the ŝ=2s+1 sub-batches its row of the support mask selects.
+
+All return numpy arrays ready to be device_put with a leading worker axis.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from draco_tpu import rng as drng
+from draco_tpu.data.datasets import Dataset
+
+
+def get_batch(ds: Dataset, indices: np.ndarray):
+    """Fetch an explicit index set as one batch (reference: datasets/utils.py:7-29)."""
+    return ds.train_x[indices], ds.train_y[indices]
+
+
+def _epoch_and_offset(step: int, batches_per_epoch: int):
+    return step // batches_per_epoch, step % batches_per_epoch
+
+
+def worker_batches_baseline(ds: Dataset, step: int, num_workers: int, batch_size: int,
+                            seed: int):
+    """(n, B, ...) batches — each worker has its own shuffle stream."""
+    n_samples = len(ds)
+    bpe = max(n_samples // batch_size, 1)
+    epoch, off = _epoch_and_offset(step, bpe)
+    xs, ys = [], []
+    for w in range(num_workers):
+        perm = drng.epoch_permutation(seed + 31 * (w + 1), epoch, n_samples)
+        idx = perm[(off * batch_size) % n_samples :][:batch_size]
+        if len(idx) < batch_size:  # wrap
+            idx = np.concatenate([idx, perm[: batch_size - len(idx)]])
+        x, y = get_batch(ds, idx)
+        xs.append(x)
+        ys.append(y)
+    return np.stack(xs), np.stack(ys)
+
+
+def worker_batches_grouped(ds: Dataset, step: int, num_workers: int, group_size: int,
+                           batch_size: int, seeds: np.ndarray):
+    """(n, B, ...) batches where group members share the shuffle (identical
+    batches within a group). ``seeds`` from rng.group_seeds."""
+    n_samples = len(ds)
+    bpe = max(n_samples // batch_size, 1)
+    epoch, off = _epoch_and_offset(step, bpe)
+    xs, ys = [], []
+    for w in range(num_workers):
+        g = w // group_size
+        perm = drng.epoch_permutation(int(seeds[g]), epoch, n_samples)
+        idx = perm[(off * batch_size) % n_samples :][:batch_size]
+        if len(idx) < batch_size:
+            idx = np.concatenate([idx, perm[: batch_size - len(idx)]])
+        x, y = get_batch(ds, idx)
+        xs.append(x)
+        ys.append(y)
+    return np.stack(xs), np.stack(ys)
+
+
+def cyclic_global_batch(ds: Dataset, step: int, num_workers: int, batch_size: int,
+                        seed: int):
+    """(n, B, ...) — the step's global batch of n·B samples split into the n
+    coded sub-batches, all addressed deterministically.
+
+    Mirrors the reference's batch_bias walk over an epoch-shuffled dataset
+    (cyclic_worker.py:88-96) with the shared seed folded per epoch; row k is
+    sub-batch k, to be gathered per worker via code.batch_ids.
+    """
+    n_samples = len(ds)
+    global_bs = num_workers * batch_size
+    bpe = max(n_samples // global_bs, 1)
+    epoch, off = _epoch_and_offset(step, bpe)
+    perm = drng.epoch_permutation(seed, epoch, n_samples)
+    start = off * global_bs
+    idx = perm[start : start + global_bs]
+    if len(idx) < global_bs:
+        idx = np.concatenate([idx, perm[: global_bs - len(idx)]])
+    x, y = get_batch(ds, idx)
+    shape = (num_workers, batch_size) + x.shape[1:]
+    return x.reshape(shape), y.reshape(num_workers, batch_size)

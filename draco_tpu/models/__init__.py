@@ -1,0 +1,66 @@
+"""Model zoo — Flax ports of the reference's model_ops/ architectures.
+
+The reference carries two copies of every model: a plain nn.Module and a
+"*Split" variant whose hand-rolled per-layer backward streams each gradient
+over MPI as soon as it exists (reference: src/model_ops/resnet_split.py:431-623).
+Under XLA the overlap the Split models bought is the compiler's job (async
+collectives + latency hiding), so there is exactly one copy of each model here.
+"""
+
+from draco_tpu.models.fc import FC_NN
+from draco_tpu.models.lenet import LeNet
+from draco_tpu.models.resnet import (
+    ResNet,
+    ResNet18,
+    ResNet34,
+    ResNet50,
+    ResNet101,
+    ResNet152,
+)
+from draco_tpu.models.vgg import (
+    VGG,
+    VGG11,
+    VGG11_bn,
+    VGG13,
+    VGG13_bn,
+    VGG16,
+    VGG16_bn,
+    VGG19,
+    VGG19_bn,
+)
+
+_REGISTRY = {
+    "LeNet": LeNet,
+    "FC": FC_NN,
+    "ResNet18": ResNet18,
+    "ResNet34": ResNet34,
+    "ResNet50": ResNet50,
+    "ResNet101": ResNet101,
+    "ResNet152": ResNet152,
+    "VGG11": VGG11,
+    "VGG11_bn": VGG11_bn,
+    "VGG13": VGG13,
+    "VGG13_bn": VGG13_bn,
+    "VGG16": VGG16,
+    "VGG16_bn": VGG16_bn,
+    "VGG19": VGG19,
+    "VGG19_bn": VGG19_bn,
+}
+
+
+def build_model(name: str, num_classes: int = 10):
+    """Name-based model construction (reference: build_model switches in
+    baseline_master.py:30-47 / baseline_worker.py:37-50)."""
+    if name not in _REGISTRY:
+        raise ValueError(f"unknown network: {name} (have {sorted(_REGISTRY)})")
+    return _REGISTRY[name](num_classes=num_classes)
+
+
+def input_shape(dataset: str):
+    """Per-dataset sample shape, NHWC."""
+    d = dataset.lower()
+    if "mnist" in d:
+        return (28, 28, 1)
+    if "cifar" in d:
+        return (32, 32, 3)
+    raise ValueError(f"unknown dataset: {dataset}")

@@ -1,0 +1,24 @@
+"""Fully-connected MNIST net (reference: src/model_ops/fc_nn.py:21-39).
+
+784 → 800 → relu → 500 → relu → 10 → sigmoid. The trailing sigmoid before
+cross-entropy is a reference quirk preserved for parity (the canonical
+run_pytorch.sh config trains exactly this model)."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+
+
+class FC_NN(nn.Module):
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(800)(x)
+        x = nn.relu(x)
+        x = nn.Dense(500)(x)
+        x = nn.relu(x)
+        x = nn.Dense(self.num_classes)(x)
+        x = nn.sigmoid(x)
+        return x

@@ -1,0 +1,27 @@
+"""LeNet for MNIST (reference: src/model_ops/lenet.py:20-41).
+
+conv(1→20, 5×5, VALID) → maxpool2 → relu → conv(20→50) → maxpool2 → relu →
+fc(800→500) → fc(500→10). Note the reference applies relu *after* the pool;
+kept as-is."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class LeNet(nn.Module):
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = nn.Conv(20, (5, 5), padding="VALID")(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(x)
+        x = nn.Conv(50, (5, 5), padding="VALID")(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(x)
+        x = x.reshape((x.shape[0], -1))  # (B, 4*4*50)
+        x = nn.Dense(500)(x)
+        x = nn.Dense(self.num_classes)(x)
+        return x

@@ -1,0 +1,102 @@
+"""CIFAR ResNet-18/34/50/101/152 (reference: src/model_ops/resnet.py).
+
+3×3 stem (no max-pool), stage widths 64/128/256/512, BasicBlock for 18/34 and
+Bottleneck (expansion 4) for 50/101/152, 4×4 average pool before the
+classifier — the standard CIFAR variant the reference uses.
+
+BatchNorm policy (load-bearing for the coded paths, see SURVEY.md §7.4): the
+reference never ships running statistics to the PS (src/worker/utils.py:46-48);
+each worker keeps local stats and only *parameters* are aggregated. Here the
+``batch_stats`` collection is vmapped per logical worker and never averaged;
+training normalisation uses batch statistics, so two workers given the same
+batch produce bitwise-identical gradients — which is what the repetition
+vote and the cyclic decode rely on.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class BasicBlock(nn.Module):
+    planes: int
+    stride: int = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        norm = lambda: nn.BatchNorm(use_running_average=not train, momentum=0.9)
+        in_planes = x.shape[-1]
+        out = nn.Conv(self.planes, (3, 3), strides=(self.stride, self.stride),
+                      padding=((1, 1), (1, 1)), use_bias=False)(x)
+        out = nn.relu(norm()(out))
+        out = nn.Conv(self.planes, (3, 3), padding=((1, 1), (1, 1)), use_bias=False)(out)
+        out = norm()(out)
+        if self.stride != 1 or in_planes != self.planes:
+            x = nn.Conv(self.planes, (1, 1), strides=(self.stride, self.stride),
+                        use_bias=False)(x)
+            x = norm()(x)
+        return nn.relu(out + x)
+
+
+class Bottleneck(nn.Module):
+    planes: int
+    stride: int = 1
+    expansion: int = 4
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        norm = lambda: nn.BatchNorm(use_running_average=not train, momentum=0.9)
+        in_planes = x.shape[-1]
+        wide = self.planes * self.expansion
+        out = nn.Conv(self.planes, (1, 1), use_bias=False)(x)
+        out = nn.relu(norm()(out))
+        out = nn.Conv(self.planes, (3, 3), strides=(self.stride, self.stride),
+                      padding=((1, 1), (1, 1)), use_bias=False)(out)
+        out = nn.relu(norm()(out))
+        out = nn.Conv(wide, (1, 1), use_bias=False)(out)
+        out = norm()(out)
+        if self.stride != 1 or in_planes != wide:
+            x = nn.Conv(wide, (1, 1), strides=(self.stride, self.stride), use_bias=False)(x)
+            x = norm()(x)
+        return nn.relu(out + x)
+
+
+class ResNet(nn.Module):
+    block: Callable
+    num_blocks: Sequence[int]
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = nn.Conv(64, (3, 3), padding=((1, 1), (1, 1)), use_bias=False)(x)
+        x = nn.relu(nn.BatchNorm(use_running_average=not train, momentum=0.9)(x))
+        for stage, (planes, blocks) in enumerate(zip((64, 128, 256, 512), self.num_blocks)):
+            for b in range(blocks):
+                stride = 2 if (stage > 0 and b == 0) else 1
+                x = self.block(planes, stride)(x, train=train)
+        x = nn.avg_pool(x, (4, 4), strides=(4, 4))
+        x = x.reshape((x.shape[0], -1))
+        return nn.Dense(self.num_classes)(x)
+
+
+def ResNet18(num_classes: int = 10):
+    return ResNet(BasicBlock, (2, 2, 2, 2), num_classes)
+
+
+def ResNet34(num_classes: int = 10):
+    return ResNet(BasicBlock, (3, 4, 6, 3), num_classes)
+
+
+def ResNet50(num_classes: int = 10):
+    return ResNet(Bottleneck, (3, 4, 6, 3), num_classes)
+
+
+def ResNet101(num_classes: int = 10):
+    return ResNet(Bottleneck, (3, 4, 23, 3), num_classes)
+
+
+def ResNet152(num_classes: int = 10):
+    return ResNet(Bottleneck, (3, 8, 36, 3), num_classes)

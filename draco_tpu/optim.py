@@ -1,0 +1,106 @@
+"""Optimizers with the reference's "aggregated-gradient-as-argument" semantics.
+
+The reference's SGDModified / AdamModified (src/optim/sgd_modified.py:53-89,
+src/optim/adam_modified.py:32-92) are torch optimizers whose ``.step(grads,
+mode)`` consumes the PS-aggregated numpy gradients instead of ``.grad``. In
+jax that is simply an optax-style GradientTransformation applied to the
+decoded/aggregated gradient pytree — but the *update rules* here mirror
+torch's formulations exactly (they differ from optax defaults):
+
+  torch SGD-momentum: buf ← μ·buf + g  (first step: buf = g);  p ← p − lr·buf
+  torch Adam:         m ← β1 m + (1−β1) g;  v ← β2 v + (1−β2) g²
+                      p ← p − lr·√(1−β2ᵗ)/(1−β1ᵗ) · m/(√v + ε)
+                      (ε added *outside* the bias-corrected sqrt, like torch)
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class SGDState(NamedTuple):
+    momentum_buf: optax.Params
+    initialized: jnp.ndarray  # scalar bool — torch's first-step buf = g rule
+
+
+def sgd_modified(
+    lr: float, momentum: float = 0.0, dampening: float = 0.0, weight_decay: float = 0.0,
+    nesterov: bool = False,
+) -> optax.GradientTransformation:
+    """torch.optim.SGD update rule (reference: sgd_modified.py:70-89)."""
+
+    def init(params):
+        return SGDState(
+            momentum_buf=jax.tree.map(jnp.zeros_like, params),
+            initialized=jnp.zeros((), dtype=bool),
+        )
+
+    def update(grads, state, params=None):
+        if weight_decay != 0.0:
+            grads = jax.tree.map(lambda g, p: g + weight_decay * p, grads, params)
+        if momentum != 0.0:
+            def upd_buf(buf, g):
+                # first step: buf = g; after: buf = μ·buf + (1-dampening)·g
+                later = momentum * buf + (1.0 - dampening) * g
+                return jnp.where(state.initialized, later, g)
+
+            buf = jax.tree.map(upd_buf, state.momentum_buf, grads)
+            if nesterov:
+                d_p = jax.tree.map(lambda g, b: g + momentum * b, grads, buf)
+            else:
+                d_p = buf
+            new_state = SGDState(momentum_buf=buf, initialized=jnp.ones((), dtype=bool))
+        else:
+            d_p = grads
+            new_state = state
+        updates = jax.tree.map(lambda d: -lr * d, d_p)
+        return updates, new_state
+
+    return optax.GradientTransformation(init, update)
+
+
+class AdamState(NamedTuple):
+    count: jnp.ndarray
+    exp_avg: optax.Params
+    exp_avg_sq: optax.Params
+
+
+def adam_modified(
+    lr: float = 1e-3, b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8,
+    weight_decay: float = 0.0,
+) -> optax.GradientTransformation:
+    """torch.optim.Adam update rule (reference: adam_modified.py:32-92)."""
+
+    def init(params):
+        return AdamState(
+            count=jnp.zeros((), jnp.int32),
+            exp_avg=jax.tree.map(jnp.zeros_like, params),
+            exp_avg_sq=jax.tree.map(jnp.zeros_like, params),
+        )
+
+    def update(grads, state, params=None):
+        if weight_decay != 0.0:
+            grads = jax.tree.map(lambda g, p: g + weight_decay * p, grads, params)
+        count = state.count + 1
+        m = jax.tree.map(lambda m_, g: b1 * m_ + (1 - b1) * g, state.exp_avg, grads)
+        v = jax.tree.map(lambda v_, g: b2 * v_ + (1 - b2) * g * g, state.exp_avg_sq, grads)
+        t = count.astype(jnp.float32)
+        bc1 = 1.0 - b1**t
+        bc2 = 1.0 - b2**t
+        step_size = lr * jnp.sqrt(bc2) / bc1
+        updates = jax.tree.map(lambda m_, v_: -step_size * m_ / (jnp.sqrt(v_) + eps), m, v)
+        return updates, AdamState(count=count, exp_avg=m, exp_avg_sq=v)
+
+    return optax.GradientTransformation(init, update)
+
+
+def build_optimizer(name: str, lr: float, momentum: float = 0.0) -> optax.GradientTransformation:
+    if name == "sgd":
+        return sgd_modified(lr=lr, momentum=momentum)
+    if name == "adam":
+        return adam_modified(lr=lr)
+    raise ValueError(f"unknown optimizer: {name}")

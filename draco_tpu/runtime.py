@@ -52,14 +52,23 @@ def make_mesh(num_workers: int, devices: Optional[Sequence[jax.Device]] = None) 
     """Build a 1-D mesh with axis ``w``.
 
     ``num_workers`` logical workers are laid out over the available devices;
-    if there are fewer devices than workers, each device holds a contiguous
-    block of the worker axis (num_workers % num_devices must be 0); if there
-    are more devices than workers, the extra devices are left out of the mesh.
+    each device holds an equal contiguous block of the worker axis. When
+    num_workers does not divide the device count, the mesh shrinks to the
+    largest divisor-count of devices and the rest idle — loudly.
     """
     devices = list(devices if devices is not None else jax.devices())
+    if not devices:
+        raise ValueError("make_mesh: no devices available")
     n_dev = min(len(devices), num_workers)
     while num_workers % n_dev != 0:
         n_dev -= 1
+    if n_dev < len(devices):
+        print(
+            f"make_mesh: using {n_dev}/{len(devices)} devices for "
+            f"{num_workers} workers (pick num_workers as a multiple of the "
+            f"device count to use the whole slice)",
+            flush=True,
+        )
     return Mesh(np.asarray(devices[:n_dev]), (WORKER_AXIS,))
 
 

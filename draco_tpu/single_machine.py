@@ -1,0 +1,33 @@
+"""Single-process sanity/benchmark path (reference: src/single_machine.py +
+src/nn_ops/__init__.py NN_Trainer). Equivalent to the distributed trainer
+with num_workers=1, approach=baseline, no adversaries — one device, plain SGD.
+
+  python -m draco_tpu.single_machine --network LeNet --dataset MNIST --max-steps 500
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from draco_tpu.cli import add_fit_args, config_from_args, maybe_force_cpu_mesh
+
+
+def main(argv=None):
+    parser = add_fit_args(argparse.ArgumentParser(description="draco_tpu single machine"))
+    args = parser.parse_args(argv)
+    args.approach = "baseline"
+    args.mode = "normal"
+    args.num_workers = 1
+    args.worker_fail = 0
+
+    maybe_force_cpu_mesh(args)
+
+    from draco_tpu.training.trainer import Trainer
+
+    cfg = config_from_args(args)
+    trainer = Trainer(cfg)
+    return trainer.run()
+
+
+if __name__ == "__main__":
+    main()

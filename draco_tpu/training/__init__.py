@@ -1,0 +1,2 @@
+from draco_tpu.training.step import TrainState, build_train_setup  # noqa: F401
+from draco_tpu.training.trainer import Trainer  # noqa: F401

@@ -1,0 +1,88 @@
+"""Standalone checkpoint-polling evaluator (reference:
+src/distributed_evaluator.py — a separate process that watches train_dir over
+NFS for ``model_step_k`` files every 10 s and reports top-1/top-5).
+
+  python -m draco_tpu.training.evaluator --network LeNet --dataset MNIST \\
+      --train-dir ./train_out/ --eval-freq 50
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def evaluate_params(model, params, batch_stats, xs, ys, batch_size=1000):
+    n = len(xs)
+    bs = min(batch_size, n)
+    p1s, p5s = [], []
+    vs = {"params": params}
+    if batch_stats is not None:
+        vs["batch_stats"] = batch_stats
+
+    @jax.jit
+    def _eval(x, y):
+        logits = model.apply(vs, x, train=False)
+        top1 = jnp.mean((jnp.argmax(logits, -1) == y).astype(jnp.float32))
+        top5 = jnp.mean(
+            jnp.any(jax.lax.top_k(logits, 5)[1] == y[:, None], axis=1).astype(jnp.float32)
+        )
+        return top1, top5
+
+    for i in range(0, n - bs + 1, bs):
+        p1, p5 = _eval(jnp.asarray(xs[i : i + bs]), jnp.asarray(ys[i : i + bs]))
+        p1s.append(float(p1))
+        p5s.append(float(p5))
+    return float(np.mean(p1s)), float(np.mean(p5s))
+
+
+def main(argv=None):
+    from draco_tpu.cli import add_fit_args, config_from_args, maybe_force_cpu_mesh
+    from draco_tpu.data.datasets import load_dataset
+    from draco_tpu.runtime import make_mesh
+    from draco_tpu.training.step import build_train_setup
+    from draco_tpu.utils import checkpoint as ckpt
+
+    parser = add_fit_args(argparse.ArgumentParser(description="draco_tpu evaluator"))
+    parser.add_argument("--poll-seconds", type=float, default=10.0,
+                        help="poll interval (reference sleeps 10 s, "
+                        "distributed_evaluator.py:90)")
+    parser.add_argument("--once", action="store_true", help="evaluate what exists, exit")
+    args = parser.parse_args(argv)
+    maybe_force_cpu_mesh(args)
+    cfg = config_from_args(args)
+
+    ds = load_dataset(cfg.dataset, cfg.data_dir)
+    mesh = make_mesh(cfg.num_workers)
+    setup = build_train_setup(cfg, mesh, dataset_name=ds.name)
+
+    abstract = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), jax.device_get(setup.state)
+    )
+    seen = set()
+    while True:
+        for step in ckpt.available_steps(cfg.train_dir):
+            if step in seen:
+                continue
+            state = ckpt.load(cfg.train_dir, step, abstract)
+            stats = (
+                jax.tree.map(lambda t: t[0], state.batch_stats)
+                if state.batch_stats is not None
+                else None
+            )
+            p1, p5 = evaluate_params(setup.model, state.params, stats,
+                                     ds.test_x, ds.test_y, cfg.test_batch_size)
+            print(f"Testset Performance: Cur Step:{step} Prec@1: {p1:.4f} Prec@5: {p5:.4f}",
+                  flush=True)
+            seen.add(step)
+        if args.once:
+            break
+        time.sleep(args.poll_seconds)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,330 @@
+"""The SPMD training step — the reference's whole PS↔worker protocol as one
+jitted program.
+
+One call to the returned ``train_step`` does what the reference spreads over
+rank-0 and rank-1..P processes and an MPI tag protocol (SURVEY.md §3.1-3.3):
+
+  reference                                   here
+  ---------                                   ----
+  async_bcast_step / weights Bcast            params replicated on the mesh —
+    (baseline_master.py:156-186)              nothing moves
+  worker forward/backward + layer streaming   vmap'ed jax.grad over the
+    (baseline_worker.py:225, resnet_split)    worker-sharded batch axis
+  err_simulation at every send site           branch-free masked injection
+    (model_ops/utils.py:6)                    (draco_tpu.attacks)
+  P×L Irecv + Waitany drain                   XLA all-gather of the (n, d)
+    (baseline_master.py:90-116)               gradient matrix over ICI
+  decode / vote / median / krum on rank 0     the same math, replicated on
+    (rep/cyclic/baseline_master)              every device after the gather
+  SGDModified.step(grads)                     optimizer update on replicated
+    (sgd_modified.py:53)                      params
+
+The worker axis ``w`` is a real array axis: per-worker gradients live in an
+(n, d) matrix sharded over the mesh; aggregation contracts over that axis and
+XLA inserts the collectives. No tags, no buffers, no races by construction
+(SURVEY.md §5.2).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from draco_tpu import aggregation, attacks, optim, rng as drng
+from draco_tpu.config import TrainConfig
+from draco_tpu.coding import cyclic as cyclic_mod
+from draco_tpu.coding import repetition as rep_mod
+from draco_tpu.data import augment as augment_mod
+from draco_tpu.models import build_model, input_shape
+from draco_tpu.runtime import WORKER_AXIS
+
+
+class TrainState(NamedTuple):
+    params: Any  # replicated pytree
+    opt_state: Any  # replicated
+    batch_stats: Any  # per-worker (leading n axis) or None
+    step: jnp.ndarray  # scalar int32
+
+
+class TrainSetup(NamedTuple):
+    """Everything the trainer loop needs, built once from a TrainConfig."""
+
+    model: Any
+    state: TrainState
+    train_step: Any  # (state, x, y, adv_mask) -> (state, metrics)
+    eval_step: Any  # (state, x, y) -> (prec1, prec5)
+    code: Any  # CyclicCode | RepetitionCode | None
+    unravel: Any  # flat (d,) -> params pytree
+    dim: int
+
+
+def _cross_entropy(logits, labels):
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=1))
+
+
+def _flatten_tree(tree) -> jnp.ndarray:
+    return jnp.concatenate([jnp.reshape(x, (-1,)) for x in jax.tree.leaves(tree)])
+
+
+def _make_unravel(params):
+    leaves, treedef = jax.tree.flatten(params)
+    shapes = [l.shape for l in leaves]
+    sizes = [int(np.prod(s)) for s in shapes]
+    offsets = np.cumsum([0] + sizes)
+
+    def unravel(flat):
+        parts = [
+            jnp.reshape(flat[offsets[i] : offsets[i + 1]], shapes[i])
+            for i in range(len(shapes))
+        ]
+        return jax.tree.unflatten(treedef, parts)
+
+    return unravel, int(offsets[-1])
+
+
+def build_train_setup(cfg: TrainConfig, mesh, dataset_name: Optional[str] = None) -> TrainSetup:
+    """Construct model/state and the jitted train & eval steps for cfg.approach."""
+    cfg.validate()
+    n = cfg.num_workers
+    shape = input_shape(dataset_name or cfg.dataset)
+    model = build_model(cfg.network)
+    use_aug = "cifar" in (dataset_name or cfg.dataset).lower()
+
+    root = jax.random.key(cfg.seed)
+    init_x = jnp.zeros((2,) + shape, jnp.float32)
+    variables = model.init({"params": root, "dropout": jax.random.fold_in(root, 1)},
+                           init_x, train=True)
+    params = variables["params"]
+    has_bn = "batch_stats" in variables
+    # per-worker BN statistics (never aggregated — reference worker/utils.py:46-48)
+    batch_stats = (
+        jax.tree.map(lambda x: jnp.broadcast_to(x, (n,) + x.shape), variables["batch_stats"])
+        if has_bn
+        else None
+    )
+
+    opt = optim.build_optimizer(cfg.optimizer, cfg.lr, cfg.momentum)
+    opt_state = opt.init(params)
+    unravel, dim = _make_unravel(params)
+
+    repl = NamedSharding(mesh, P())
+    shard_w = NamedSharding(mesh, P(WORKER_AXIS))
+
+    state = TrainState(
+        params=jax.device_put(params, repl),
+        opt_state=jax.device_put(opt_state, repl),
+        batch_stats=jax.device_put(batch_stats, shard_w) if has_bn else None,
+        step=jax.device_put(jnp.asarray(1, jnp.int32), repl),  # STEP_START_=1
+    )
+
+    # ---- per-(lane) loss/grad --------------------------------------------
+    def loss_fn(p, stats, x, y, dkey):
+        vs = {"params": p}
+        if has_bn:
+            vs["batch_stats"] = stats
+        out = model.apply(
+            vs, x, train=True,
+            mutable=["batch_stats"] if has_bn else False,
+            rngs={"dropout": dkey},
+        )
+        if has_bn:
+            logits, mutated = out
+            new_stats = mutated["batch_stats"]
+        else:
+            logits = out
+            new_stats = stats
+        loss = _cross_entropy(logits, y)
+        prec1 = jnp.mean((jnp.argmax(logits, -1) == y).astype(jnp.float32))
+        return loss, (new_stats, prec1)
+
+    def lane(p, stats, x, y, dkey):
+        """One logical worker/batch lane -> (flat grad, new_stats, loss, prec1)."""
+        (loss, (new_stats, prec1)), g = jax.value_and_grad(loss_fn, has_aux=True)(
+            p, stats, x, y, dkey
+        )
+        return _flatten_tree(g), new_stats, loss, prec1
+
+    def apply_update(state: TrainState, flat_grad, new_stats):
+        grads_tree = unravel(flat_grad)
+        updates, new_opt = opt.update(grads_tree, state.opt_state, state.params)
+        new_params = jax.tree.map(lambda p, u: p + u, state.params, updates)
+        return TrainState(
+            params=new_params,
+            opt_state=new_opt,
+            batch_stats=new_stats,
+            step=state.step + 1,
+        )
+
+    adv_mag = cfg.adversarial
+
+    # ---- approach-specific step bodies -----------------------------------
+    if cfg.approach == "baseline":
+        code = None
+        rep_code = None
+
+        def step_body(state: TrainState, x, y, adv_mask):
+            # x, y: (n, B, ...) sharded over w; aug key per (step, worker)
+            if use_aug:
+                keys = jax.vmap(
+                    lambda i: drng.fold(jax.random.key(cfg.seed + 2), state.step, i)
+                )(jnp.arange(n))
+                x = jax.vmap(augment_mod.augment_batch)(x, keys)
+            dkeys = jax.vmap(
+                lambda i: drng.fold(jax.random.key(cfg.seed + 3), state.step, i)
+            )(jnp.arange(n))
+            grads, new_stats, losses, precs = jax.vmap(lane, in_axes=(None, 0, 0, 0, 0))(
+                state.params, state.batch_stats, x, y, dkeys
+            )
+            grads = jax.lax.with_sharding_constraint(grads, shard_w)
+            grads = attacks.inject_plain(grads, adv_mask, cfg.err_mode, adv_mag)
+            agg = aggregation.aggregate(grads, cfg.mode, s=cfg.worker_fail,
+                                        geomedian_iters=cfg.geomedian_iters)
+            new_state = apply_update(state, agg, new_stats)
+            return new_state, {"loss": jnp.mean(losses), "prec1": jnp.mean(precs)}
+
+    elif cfg.approach == "maj_vote":
+        code = None
+        rep_code = rep_mod.build_repetition_code(n, cfg.group_size)
+        group_ids = jnp.asarray(np.arange(n) // cfg.group_size, jnp.int32)
+
+        def step_body(state: TrainState, x, y, adv_mask):
+            # group members carry identical batches (batching layer guarantees
+            # it); aug + dropout keys fold the *group* id so lanes stay
+            # bitwise identical within a group — the vote's soundness condition
+            if use_aug:
+                keys = jax.vmap(
+                    lambda gid: drng.fold(jax.random.key(cfg.seed + 2), state.step, gid)
+                )(group_ids)
+                x = jax.vmap(augment_mod.augment_batch)(x, keys)
+            dkeys = jax.vmap(
+                lambda gid: drng.fold(jax.random.key(cfg.seed + 3), state.step, gid)
+            )(group_ids)
+            grads, new_stats, losses, precs = jax.vmap(lane, in_axes=(None, 0, 0, 0, 0))(
+                state.params, state.batch_stats, x, y, dkeys
+            )
+            grads = jax.lax.with_sharding_constraint(grads, shard_w)
+            grads = attacks.inject_plain(grads, adv_mask, cfg.err_mode, adv_mag)
+            voted = rep_mod.majority_vote(rep_code, grads)
+            new_state = apply_update(state, voted, new_stats)
+            return new_state, {"loss": jnp.mean(losses), "prec1": jnp.mean(precs)}
+
+    elif cfg.approach == "cyclic":
+        code = cyclic_mod.build_cyclic_code(n, cfg.worker_fail)
+        rep_code = None
+        rand_factor = jnp.asarray(drng.random_projection_factors(cfg.seed, dim))
+        batch_ids = jnp.asarray(code.batch_ids)  # (n, hat_s)
+        hat_s = code.hat_s
+
+        def prep_rows(state, x, y):
+            """Augment + dropout keys per *global batch row* k — any worker
+            computing batch k sees identical data and rng (decode exactness)."""
+            if use_aug:
+                keys = jax.vmap(
+                    lambda k: drng.fold(jax.random.key(cfg.seed + 2), state.step, k)
+                )(jnp.arange(n))
+                x = jax.vmap(augment_mod.augment_batch)(x, keys)
+            dkeys = jax.vmap(
+                lambda k: drng.fold(jax.random.key(cfg.seed + 3), state.step, k)
+            )(jnp.arange(n))
+            return x, y, dkeys
+
+        if cfg.redundancy == "shared":
+
+            def compute_encoded(state, x, y):
+                # each batch row computed once; rows then combined with the
+                # masked W — identical semantics, r× less compute (TPU-native
+                # fast path; see config.redundancy)
+                x, y, dkeys = prep_rows(state, x, y)
+                grads, new_stats, losses, precs = jax.vmap(
+                    lane, in_axes=(None, 0, 0, 0, 0)
+                )(state.params, state.batch_stats, x, y, dkeys)
+                grads = jax.lax.with_sharding_constraint(grads, shard_w)
+                enc_re, enc_im = cyclic_mod.encode_shared(code, grads)
+                return enc_re, enc_im, new_stats, losses, precs
+
+        else:  # "simulate": the reference's true r× redundant compute
+
+            def compute_encoded(state, x, y):
+                x, y, dkeys = prep_rows(state, x, y)
+                # worker i gathers its hat_s batch rows: (n, hat_s, B, ...)
+                xw = x[batch_ids]
+                yw = y[batch_ids]
+                kw = dkeys[batch_ids]
+                # worker's BN stats replicated over its hat_s lanes
+                stats_w = (
+                    jax.tree.map(
+                        lambda t: jnp.broadcast_to(t[:, None], (n, hat_s) + t.shape[1:]),
+                        state.batch_stats,
+                    )
+                    if has_bn
+                    else None
+                )
+                def worker_lane(stats_i, x_i, y_i, k_i):
+                    return jax.vmap(lane, in_axes=(None, 0, 0, 0, 0))(
+                        state.params, stats_i, x_i, y_i, k_i
+                    )
+                grads, new_stats, losses, precs = jax.vmap(worker_lane)(
+                    stats_w, xw, yw, kw
+                )  # grads: (n, hat_s, d)
+                grads = jax.lax.with_sharding_constraint(
+                    grads, NamedSharding(mesh, P(WORKER_AXIS, None, None))
+                )
+                enc_re, enc_im = cyclic_mod.encode(code, grads)
+                # fold the per-sub-batch stats back to one per worker
+                new_stats = (
+                    jax.tree.map(lambda t: jnp.mean(t, axis=1), new_stats)
+                    if has_bn
+                    else None
+                )
+                return enc_re, enc_im, new_stats, jnp.mean(losses, 1), jnp.mean(precs, 1)
+
+        def step_body(state: TrainState, x, y, adv_mask):
+            enc_re, enc_im, new_stats, losses, precs = compute_encoded(state, x, y)
+            enc_re, enc_im = attacks.inject_cyclic(enc_re, enc_im, adv_mask,
+                                                   cfg.err_mode, adv_mag)
+            enc_re = jax.lax.with_sharding_constraint(enc_re, shard_w)
+            enc_im = jax.lax.with_sharding_constraint(enc_im, shard_w)
+            decoded, honest = cyclic_mod.decode(code, enc_re, enc_im, rand_factor)
+            new_state = apply_update(state, decoded, new_stats)
+            return new_state, {
+                "loss": jnp.mean(losses),
+                "prec1": jnp.mean(precs),
+                "honest_located": jnp.sum(honest.astype(jnp.int32)),
+            }
+
+    else:  # pragma: no cover
+        raise ValueError(cfg.approach)
+
+    # ---- eval ------------------------------------------------------------
+    def eval_body(state: TrainState, x, y):
+        vs = {"params": state.params}
+        if has_bn:
+            # evaluate with worker-0's running stats (reference evaluates a
+            # single worker's checkpointed state, distributed_evaluator.py:119)
+            vs["batch_stats"] = jax.tree.map(lambda t: t[0], state.batch_stats)
+        logits = model.apply(vs, x, train=False)
+        top1 = jnp.mean((jnp.argmax(logits, -1) == y).astype(jnp.float32))
+        top5 = jnp.mean(
+            jnp.any(jax.lax.top_k(logits, 5)[1] == y[:, None], axis=1).astype(jnp.float32)
+        )
+        return top1, top5
+
+    with mesh:
+        train_step = jax.jit(step_body, donate_argnums=(0,))
+        eval_step = jax.jit(eval_body)
+
+    return TrainSetup(
+        model=model,
+        state=state,
+        train_step=train_step,
+        eval_step=eval_step,
+        code=code if cfg.approach == "cyclic" else rep_code,
+        unravel=unravel,
+        dim=dim,
+    )

@@ -1,0 +1,124 @@
+"""Training loop — host-side orchestration around the jitted SPMD step.
+
+Replaces the reference's per-role hot loops (SyncReplicasMaster_NN.start /
+DistributedWorker.train and their coded variants, SURVEY.md §3) with one loop:
+build batches (deterministic, approach-specific), device_put them sharded over
+the worker axis, call the jitted step, emit metrics with the reference's
+segment names, checkpoint every eval_freq steps.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from draco_tpu import rng as drng
+from draco_tpu.config import TrainConfig
+from draco_tpu.data import batching
+from draco_tpu.data.datasets import Dataset, load_dataset
+from draco_tpu.runtime import WORKER_AXIS, make_mesh
+from draco_tpu.training.step import build_train_setup
+from draco_tpu.utils import checkpoint as ckpt
+from draco_tpu.utils.metrics import MetricWriter, Segments
+
+
+class Trainer:
+    def __init__(self, cfg: TrainConfig, mesh=None, dataset: Optional[Dataset] = None,
+                 quiet: bool = False):
+        self.cfg = cfg.validate()
+        self.mesh = mesh if mesh is not None else make_mesh(cfg.num_workers)
+        self.ds = dataset if dataset is not None else load_dataset(cfg.dataset, cfg.data_dir)
+        self.setup = build_train_setup(cfg, self.mesh, dataset_name=self.ds.name)
+        self.state = self.setup.state
+        self.writer = MetricWriter(cfg.train_dir, quiet=quiet)
+        self._shard_w = NamedSharding(self.mesh, P(WORKER_AXIS))
+        self._adv_schedule = drng.adversary_schedule(
+            cfg.seed, cfg.max_steps, cfg.num_workers, cfg.worker_fail
+        )
+        self._group_seeds = drng.group_seeds(cfg.seed, max(cfg.num_groups, 1))
+        self._start_step = 1
+        if cfg.checkpoint_step:
+            self.restore(cfg.checkpoint_step)
+
+    # ---- data ------------------------------------------------------------
+    def _host_batch(self, step: int):
+        cfg = self.cfg
+        if cfg.approach == "baseline":
+            return batching.worker_batches_baseline(
+                self.ds, step - 1, cfg.num_workers, cfg.batch_size, cfg.seed
+            )
+        if cfg.approach == "maj_vote":
+            return batching.worker_batches_grouped(
+                self.ds, step - 1, cfg.num_workers, cfg.group_size, cfg.batch_size,
+                self._group_seeds,
+            )
+        return batching.cyclic_global_batch(
+            self.ds, step - 1, cfg.num_workers, cfg.batch_size, cfg.seed
+        )
+
+    def _device_batch(self, step: int):
+        x, y = self._host_batch(step)
+        return (
+            jax.device_put(jnp.asarray(x), self._shard_w),
+            jax.device_put(jnp.asarray(y), self._shard_w),
+        )
+
+    # ---- train -----------------------------------------------------------
+    def run(self, max_steps: Optional[int] = None) -> dict:
+        cfg = self.cfg
+        last = {}
+        n_steps = max_steps if max_steps is not None else cfg.max_steps
+        for step in range(self._start_step, n_steps + 1):
+            seg = Segments()
+            seg.begin("fetch")
+            x, y = self._device_batch(step)
+            mask = jnp.asarray(self._adv_schedule[min(step, cfg.max_steps)])
+            seg.end()
+
+            seg.begin("comp")  # fwd+bwd+encode+gather+decode+update, one program
+            self.state, metrics = self.setup.train_step(self.state, x, y, mask)
+            metrics = {k: float(v) for k, v in metrics.items()}
+            jax.block_until_ready(self.state.params)
+            seg.end()
+
+            record = {"step": step, **metrics, **seg.as_dict()}
+            last = record
+            if step % cfg.log_every == 0 or step == 1:
+                self.writer.write(record)
+            if cfg.eval_freq and step % cfg.eval_freq == 0:
+                self.evaluate(step)
+                if cfg.train_dir:
+                    ckpt.save(cfg.train_dir, step, self.state)
+        return last
+
+    # ---- eval ------------------------------------------------------------
+    def evaluate(self, step: int, batch_size: Optional[int] = None) -> dict:
+        n = len(self.ds.test_x)
+        bs = min(batch_size or self.cfg.test_batch_size, n)
+        p1s, p5s = [], []
+        for i in range(0, n - bs + 1, bs):
+            x = jnp.asarray(self.ds.test_x[i : i + bs])
+            y = jnp.asarray(self.ds.test_y[i : i + bs])
+            p1, p5 = self.setup.eval_step(self.state, x, y)
+            p1s.append(float(p1))
+            p5s.append(float(p5))
+        rec = {
+            "step": step,
+            "prec1_test": float(np.mean(p1s)) if p1s else 0.0,
+            "prec5_test": float(np.mean(p5s)) if p5s else 0.0,
+        }
+        self.writer.write(rec)
+        return rec
+
+    # ---- checkpoint ------------------------------------------------------
+    def restore(self, step: int):
+        abstract = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), jax.device_get(self.state)
+        )
+        self.state = ckpt.load(self.cfg.train_dir, step, abstract)
+        self._start_step = step + 1

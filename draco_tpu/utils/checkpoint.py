@@ -1,0 +1,47 @@
+"""Step-indexed checkpointing via Orbax (replaces the reference's
+torch.save of whole modules / state_dicts every eval_freq steps,
+baseline_master.py:237-248, and the hardcoded ../checkpoints resume path,
+baseline_master.py:54-57). Layout: ``{train_dir}/model_step_{k}/`` — the same
+naming contract the reference's evaluator polls for
+(distributed_evaluator.py:83)."""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Any, Optional
+
+import jax
+import orbax.checkpoint as ocp
+
+
+def _path(train_dir: str, step: int) -> str:
+    return os.path.abspath(os.path.join(train_dir, f"model_step_{step}"))
+
+
+def save(train_dir: str, step: int, state: Any) -> str:
+    os.makedirs(train_dir, exist_ok=True)
+    path = _path(train_dir, step)
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(path, jax.device_get(state), force=True)
+    return path
+
+
+def load(train_dir: str, step: int, abstract_state: Any) -> Any:
+    with ocp.StandardCheckpointer() as ckptr:
+        return ckptr.restore(_path(train_dir, step), abstract_state)
+
+
+def exists(train_dir: str, step: int) -> bool:
+    return os.path.isdir(_path(train_dir, step))
+
+
+def available_steps(train_dir: str):
+    if not os.path.isdir(train_dir):
+        return []
+    steps = []
+    for name in os.listdir(train_dir):
+        m = re.fullmatch(r"model_step_(\d+)", name)
+        if m:
+            steps.append(int(m.group(1)))
+    return sorted(steps)

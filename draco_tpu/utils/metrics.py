@@ -1,0 +1,62 @@
+"""Structured metric emission (replaces the reference's print-to-stdout
+observability, SURVEY.md §5.5) while keeping the reference's segment names —
+fetch/comp/encode/comm/decode/update wall-clock splits
+(cyclic_worker.py:154-156, baseline_master.py:145) — so per-step timing is
+comparable against BASELINE.md."""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from typing import Optional
+
+
+class MetricWriter:
+    """JSONL metrics to ``train_dir/metrics.jsonl`` + human lines to stdout."""
+
+    def __init__(self, train_dir: Optional[str], quiet: bool = False):
+        self._fh = None
+        self._quiet = quiet
+        if train_dir:
+            os.makedirs(train_dir, exist_ok=True)
+            self._fh = open(os.path.join(train_dir, "metrics.jsonl"), "a")
+
+    def write(self, record: dict):
+        record = dict(record, time=time.time())
+        if self._fh:
+            self._fh.write(json.dumps(record) + "\n")
+            self._fh.flush()
+        if not self._quiet:
+            step = record.get("step", "?")
+            body = ", ".join(
+                f"{k}: {v:.4f}" if isinstance(v, float) else f"{k}: {v}"
+                for k, v in record.items()
+                if k not in ("step", "time")
+            )
+            print(f"Step: {step}, {body}", file=sys.stdout, flush=True)
+
+    def close(self):
+        if self._fh:
+            self._fh.close()
+
+
+class Segments:
+    """Wall-clock segment timer with the reference's phase names."""
+
+    def __init__(self):
+        self.t = {}
+        self._start = None
+        self._name = None
+
+    def begin(self, name: str):
+        self._name, self._start = name, time.time()
+
+    def end(self):
+        if self._name is not None:
+            self.t[self._name] = self.t.get(self._name, 0.0) + time.time() - self._start
+            self._name = None
+
+    def as_dict(self, prefix: str = "t_"):
+        return {prefix + k: round(v, 6) for k, v in self.t.items()}

@@ -1,0 +1,152 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from draco_tpu import models, optim
+from draco_tpu.data import augment, batching, datasets
+
+
+class TestModels:
+    @pytest.mark.parametrize(
+        "name,shape",
+        [
+            ("LeNet", (28, 28, 1)),
+            ("FC", (28, 28, 1)),
+            ("ResNet18", (32, 32, 3)),
+            ("VGG11", (32, 32, 3)),
+            ("VGG11_bn", (32, 32, 3)),
+        ],
+    )
+    def test_forward_shapes(self, name, shape):
+        model = models.build_model(name)
+        x = jnp.zeros((2,) + shape)
+        variables = model.init(
+            {"params": jax.random.key(0), "dropout": jax.random.key(1)}, x, train=False
+        )
+        out = model.apply(variables, x, train=False)
+        assert out.shape == (2, 10)
+
+    def test_resnet18_param_count(self):
+        # CIFAR ResNet-18 has ~11.17M parameters — sanity against the standard
+        model = models.build_model("ResNet18")
+        v = model.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3)), train=False)
+        n = sum(np.prod(p.shape) for p in jax.tree.leaves(v["params"]))
+        assert 11_000_000 < n < 11_400_000
+
+    def test_lenet_param_count(self):
+        # 20*25+20 + 50*20*25+50 + 800*500+500 + 500*10+10 = 431080
+        model = models.build_model("LeNet")
+        v = model.init(jax.random.key(0), jnp.zeros((1, 28, 28, 1)), train=False)
+        n = sum(np.prod(p.shape) for p in jax.tree.leaves(v["params"]))
+        assert n == 431080
+
+    def test_heavy_models_build(self):
+        # trace-only (init shapes) for the rest of the zoo
+        for name in ("ResNet34", "VGG13", "VGG16"):
+            model = models.build_model(name)
+            out, _ = jax.eval_shape(
+                lambda m=model: m.init_with_output(
+                    {"params": jax.random.key(0), "dropout": jax.random.key(1)},
+                    jnp.zeros((1, 32, 32, 3)),
+                    train=False,
+                )
+            )
+            assert out.shape == (1, 10)
+
+    def test_unknown_name_raises(self):
+        with pytest.raises(ValueError):
+            models.build_model("AlexNet")
+
+
+class TestOptim:
+    def test_sgd_matches_torch(self, rng):
+        import torch
+
+        w0 = rng.randn(7, 3).astype(np.float32)
+        grads = [rng.randn(7, 3).astype(np.float32) for _ in range(5)]
+
+        tp = torch.nn.Parameter(torch.tensor(w0.copy()))
+        topt = torch.optim.SGD([tp], lr=0.1, momentum=0.9)
+        for g in grads:
+            tp.grad = torch.tensor(g)
+            topt.step()
+
+        jopt = optim.sgd_modified(lr=0.1, momentum=0.9)
+        params = {"w": jnp.asarray(w0)}
+        state = jopt.init(params)
+        for g in grads:
+            updates, state = jopt.update({"w": jnp.asarray(g)}, state, params)
+            params = jax.tree.map(lambda p, u: p + u, params, updates)
+        np.testing.assert_allclose(np.asarray(params["w"]), tp.detach().numpy(), rtol=1e-5, atol=1e-6)
+
+    def test_adam_matches_torch(self, rng):
+        import torch
+
+        w0 = rng.randn(4, 4).astype(np.float32)
+        grads = [rng.randn(4, 4).astype(np.float32) for _ in range(4)]
+
+        tp = torch.nn.Parameter(torch.tensor(w0.copy()))
+        topt = torch.optim.Adam([tp], lr=0.01)
+        for g in grads:
+            tp.grad = torch.tensor(g)
+            topt.step()
+
+        jopt = optim.adam_modified(lr=0.01)
+        params = {"w": jnp.asarray(w0)}
+        state = jopt.init(params)
+        for g in grads:
+            updates, state = jopt.update({"w": jnp.asarray(g)}, state, params)
+            params = jax.tree.map(lambda p, u: p + u, params, updates)
+        np.testing.assert_allclose(np.asarray(params["w"]), tp.detach().numpy(), rtol=1e-4, atol=1e-6)
+
+
+class TestData:
+    def test_synthetic_fallback_shapes(self):
+        ds = datasets.load_dataset("synthetic-mnist", synthetic_train=256, synthetic_test=64)
+        assert ds.train_x.shape == (256, 28, 28, 1)
+        assert ds.synthetic
+        ds = datasets.load_dataset("Cifar10", data_dir="/nonexistent", synthetic_train=128)
+        assert ds.train_x.shape == (128, 32, 32, 3)
+        assert ds.name == "synthetic-cifar10"
+
+    def test_synthetic_learnable(self):
+        # a nearest-prototype probe must beat chance by a wide margin
+        ds = datasets.load_dataset("synthetic-mnist", synthetic_train=2048, synthetic_test=512)
+        protos = np.stack([ds.train_x[ds.train_y == c].mean(0) for c in range(10)])
+        d = ((ds.test_x[:, None] - protos[None]) ** 2).sum(axis=(2, 3, 4))
+        acc = (d.argmin(1) == ds.test_y).mean()
+        assert acc > 0.6
+
+    def test_grouped_batches_identical_within_group(self):
+        ds = datasets.load_dataset("synthetic-mnist", synthetic_train=512, synthetic_test=64)
+        seeds = np.array([11, 22, 33])
+        x, y = batching.worker_batches_grouped(ds, step=5, num_workers=6, group_size=2,
+                                               batch_size=8, seeds=seeds)
+        assert x.shape == (6, 8, 28, 28, 1)
+        np.testing.assert_array_equal(x[0], x[1])
+        np.testing.assert_array_equal(x[2], x[3])
+        assert not np.array_equal(x[0], x[2])
+
+    def test_baseline_batches_differ_across_workers(self):
+        ds = datasets.load_dataset("synthetic-mnist", synthetic_train=512, synthetic_test=64)
+        x, y = batching.worker_batches_baseline(ds, step=0, num_workers=4, batch_size=8, seed=428)
+        assert not np.array_equal(x[0], x[1])
+
+    def test_cyclic_global_batch_deterministic(self):
+        ds = datasets.load_dataset("synthetic-mnist", synthetic_train=512, synthetic_test=64)
+        x1, y1 = batching.cyclic_global_batch(ds, step=3, num_workers=8, batch_size=4, seed=428)
+        x2, y2 = batching.cyclic_global_batch(ds, step=3, num_workers=8, batch_size=4, seed=428)
+        np.testing.assert_array_equal(x1, x2)
+        assert x1.shape == (8, 4, 28, 28, 1)
+        # consecutive steps address disjoint sample ranges within an epoch
+        x3, _ = batching.cyclic_global_batch(ds, step=4, num_workers=8, batch_size=4, seed=428)
+        assert not np.array_equal(x1, x3)
+
+    def test_augment_shapes_and_determinism(self):
+        x = jnp.asarray(np.random.RandomState(0).randn(4, 32, 32, 3).astype(np.float32))
+        k = jax.random.key(7)
+        a1 = augment.augment_batch(x, k)
+        a2 = augment.augment_batch(x, k)
+        assert a1.shape == x.shape
+        np.testing.assert_array_equal(np.asarray(a1), np.asarray(a2))
