@@ -52,6 +52,15 @@ def add_fit_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     p.add_argument("--checkpoint-step", type=int, default=0)
     p.add_argument("--seed", type=int, default=SEED)
     p.add_argument("--log-every", type=int, default=10)
+    # long-context / sequence parallelism (TPU-native addition; no reference
+    # counterpart — the reference zoo is CNN-only, SURVEY.md §5.7)
+    p.add_argument("--seq-shards", type=int, default=1,
+                   help="sp mesh-axis size for network=TransformerLM")
+    p.add_argument("--seq-len", type=int, default=256)
+    p.add_argument("--vocab", type=int, default=256)
+    p.add_argument("--model-dim", type=int, default=128)
+    p.add_argument("--model-heads", type=int, default=4)
+    p.add_argument("--model-layers", type=int, default=2)
     p.add_argument("--cpu-mesh", type=int, default=0, metavar="N",
                    help="force an N-device virtual CPU mesh (testing without TPUs)")
     return p
@@ -97,6 +106,12 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         checkpoint_step=args.checkpoint_step,
         seed=args.seed,
         log_every=args.log_every,
+        seq_shards=args.seq_shards,
+        seq_len=args.seq_len,
+        vocab=args.vocab,
+        model_dim=args.model_dim,
+        model_heads=args.model_heads,
+        model_layers=args.model_layers,
     ).validate()
 
 
@@ -111,6 +126,14 @@ def main(argv=None):
 
     init_distributed()
     cfg = config_from_args(args)
+    if cfg.network == "TransformerLM":
+        # long-context path: 2-D (w × sp) mesh, ring attention, coded DP on w
+        from draco_tpu.parallel import make_mesh_2d
+        from draco_tpu.parallel.sp_step import train_sp
+
+        mesh = make_mesh_2d(cfg.num_workers, cfg.seq_shards)
+        _, last = train_sp(cfg, mesh)
+        return last
     trainer = Trainer(cfg)
     last = trainer.run()
     return last

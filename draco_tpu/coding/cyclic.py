@@ -34,6 +34,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from draco_tpu.ops import coded as ops_coded
+
 PREC = jax.lax.Precision.HIGHEST
 
 
@@ -163,10 +165,12 @@ def encode_shared(code: CyclicCode, batch_grads: jnp.ndarray):
     batch_grads: (n, d) — gradient of batch k at row k, each computed once.
     Equivalent to :func:`encode` when redundant computations of the same batch
     agree bitwise (they do: per-batch gradients are deterministic functions of
-    (params, batch) under XLA). Uses the full masked W as a single matmul.
+    (params, batch) under XLA). One fused complex matmul (Pallas on TPU —
+    draco_tpu.ops.coded — streaming the (n, d) gradient matrix once).
     """
-    return (jnp.matmul(jnp.asarray(code.w_masked_re), batch_grads, precision=PREC),
-            jnp.matmul(jnp.asarray(code.w_masked_im), batch_grads, precision=PREC))
+    return ops_coded.complex_matmul(
+        jnp.asarray(code.w_masked_re), jnp.asarray(code.w_masked_im), batch_grads
+    )
 
 
 # --------------------------------------------------------------------------
@@ -211,9 +215,8 @@ def decode(code: CyclicCode, r_re: jnp.ndarray, r_im: jnp.ndarray, rand_factor: 
     c2h_im = jnp.asarray(code.c2h_im)
 
     # 1. project to one column: e = R @ f  (the only O(n·d) work besides the
-    #    final recombination — MXU-friendly matvecs)
-    e_re = jnp.matmul(r_re, rand_factor, precision=PREC)
-    e_im = jnp.matmul(r_im, rand_factor, precision=PREC)
+    #    final recombination — one fused pass over (R_re, R_im))
+    e_re, e_im = ops_coded.complex_project(r_re, r_im, rand_factor)
 
     # 2. syndrome E2 = C2^H e, shape (2s,)
     e2_re = jnp.matmul(c2h_re, e_re, precision=PREC) - jnp.matmul(c2h_im, e_im, precision=PREC)
@@ -263,6 +266,6 @@ def decode(code: CyclicCode, r_re: jnp.ndarray, r_im: jnp.ndarray, rand_factor: 
     v_full_re = jnp.zeros((n,), rec_re.dtype).at[idx].set(v_re)
     v_full_im = jnp.zeros((n,), rec_re.dtype).at[idx].set(v_im)
 
-    # 6. recombine: Re(v^T R) / n — the second O(n·d) matvec
-    decoded = (jnp.matmul(v_full_re, r_re, precision=PREC) - jnp.matmul(v_full_im, r_im, precision=PREC)) / n
+    # 6. recombine: Re(v^T R) / n — the second O(n·d) pass, fused
+    decoded = ops_coded.complex_recombine(v_full_re, v_full_im, r_re, r_im) / n
     return decoded, honest

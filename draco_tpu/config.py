@@ -64,6 +64,15 @@ class TrainConfig:
     # (cyclic_master.py:126-128).
     decode_granularity: str = "global"
 
+    # --- long context / sequence parallelism (TPU-native addition; the
+    # reference is CNN-only, SURVEY.md §5.7) ---
+    seq_shards: int = 1  # sp mesh-axis size; ring attention spans these
+    seq_len: int = 256  # tokens per sequence (global, pre-sharding)
+    vocab: int = 256
+    model_dim: int = 128
+    model_heads: int = 4
+    model_layers: int = 2
+
     # --- precision ---
     compute_dtype: str = "float32"  # forward/backward dtype (bfloat16|float32)
     code_dtype: str = "float32"  # encode/decode arithmetic dtype
@@ -125,4 +134,30 @@ class TrainConfig:
                 )
         if self.worker_fail > self.num_workers:
             raise ValueError("worker_fail cannot exceed num_workers")
+        if self.network == "TransformerLM":
+            if self.approach == "maj_vote":
+                raise ValueError(
+                    "approach=maj_vote is not supported for TransformerLM: the "
+                    "vote's bitwise-equality contract is specified over "
+                    "replicated CNN lanes (use baseline or cyclic; "
+                    "draco_tpu/parallel/sp_step.py)"
+                )
+            if self.model_dim % self.model_heads != 0:
+                raise ValueError(
+                    f"model_dim {self.model_dim} not divisible by "
+                    f"model_heads {self.model_heads}"
+                )
+            if (self.model_dim // self.model_heads) % 2 != 0:
+                raise ValueError(
+                    "head dim must be even for the rotary embedding "
+                    f"(model_dim/model_heads = {self.model_dim // self.model_heads})"
+                )
+            if self.seq_len % max(self.seq_shards, 1) != 0:
+                raise ValueError(
+                    f"seq_len {self.seq_len} not divisible by seq_shards {self.seq_shards}"
+                )
+            if self.seq_len < 2 or self.vocab < 2:
+                raise ValueError("TransformerLM needs seq_len >= 2 and vocab >= 2")
+        elif self.seq_shards > 1:
+            raise ValueError("seq_shards > 1 requires network=TransformerLM")
         return self

@@ -17,6 +17,7 @@ from draco_tpu.models.resnet import (
     ResNet101,
     ResNet152,
 )
+from draco_tpu.models.transformer import TransformerLM
 from draco_tpu.models.vgg import (
     VGG,
     VGG11,
@@ -51,6 +52,13 @@ _REGISTRY = {
 def build_model(name: str, num_classes: int = 10):
     """Name-based model construction (reference: build_model switches in
     baseline_master.py:30-47 / baseline_worker.py:37-50)."""
+    if name == "TransformerLM":
+        raise ValueError(
+            "TransformerLM is a token model and does not run on the image "
+            "pipeline; the CLI routes it automatically, or construct it via "
+            "draco_tpu.parallel.sp_step.build_sp_train_setup (all knobs) / "
+            "draco_tpu.models.TransformerLM directly"
+        )
     if name not in _REGISTRY:
         raise ValueError(f"unknown network: {name} (have {sorted(_REGISTRY)})")
     return _REGISTRY[name](num_classes=num_classes)

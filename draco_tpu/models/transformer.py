@@ -1,0 +1,104 @@
+"""Decoder-only Transformer LM — the long-context workload of the TPU build.
+
+The reference's zoo is CNN-only (src/model_ops/: LeNet/FC/ResNet/VGG —
+SURVEY.md §2.1 row 14); this model adds the sequence dimension those models
+lack, so the sequence-parallel axis (draco_tpu/parallel/) has a first-class
+consumer. Attention is injectable: dense causal attention single-shard, ring
+attention under sequence parallelism — the module code is identical in both
+worlds, only ``attn_fn`` changes.
+
+Design notes (TPU-first): pre-LN blocks, RoPE (positions arrive as an offset
+so a sequence shard knows its global coordinates), GELU MLP, weight-tied
+logits. All matmuls are batched over (B·T) and land on the MXU.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+AttnFn = Callable[..., jnp.ndarray]  # (q, k, v) -> o, all (B, T, H, Dh)
+
+
+def rope(x: jnp.ndarray, positions: jnp.ndarray, base: float = 10000.0) -> jnp.ndarray:
+    """Rotary embedding. x: (B, T, H, Dh), positions: (T,) global coords."""
+    dh = x.shape[-1]
+    half = dh // 2
+    freqs = 1.0 / (base ** (np.arange(0, half) / half))
+    angles = positions[:, None] * freqs[None, :]  # (T, half)
+    cos = jnp.cos(angles)[None, :, None, :]
+    sin = jnp.sin(angles)[None, :, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+
+
+class Block(nn.Module):
+    dim: int
+    heads: int
+    mlp_ratio: int = 4
+    attn_fn: Optional[AttnFn] = None
+
+    @nn.compact
+    def __call__(self, x, positions, train: bool):
+        b, t, _ = x.shape
+        dh = self.dim // self.heads
+        h = nn.LayerNorm(use_bias=False)(x)
+        qkv = nn.Dense(3 * self.dim, use_bias=False, name="qkv")(h)
+        q, k, v = jnp.split(qkv.reshape(b, t, 3 * self.heads, dh), 3, axis=2)
+        q = rope(q, positions)
+        k = rope(k, positions)
+        attn = self.attn_fn
+        if attn is None:
+            from draco_tpu.parallel.ring_attention import dense_attention
+
+            off = positions[0]
+            attn = lambda q, k, v: dense_attention(q, k, v, q_offset=off, k_offset=off)
+        o = attn(q, k, v).reshape(b, t, self.dim)
+        x = x + nn.Dense(self.dim, use_bias=False, name="proj")(o)
+        h = nn.LayerNorm(use_bias=False)(x)
+        h = nn.Dense(self.mlp_ratio * self.dim, name="mlp_in")(h)
+        h = nn.gelu(h)
+        x = x + nn.Dense(self.dim, name="mlp_out")(h)
+        return x
+
+
+class TransformerLM(nn.Module):
+    """Returns next-token logits (B, T, vocab).
+
+    ``pos_offset``: global position of this sequence shard's first token —
+    0 single-shard; ``axis_index(sp) * T_local`` under sequence parallelism.
+    """
+
+    vocab: int = 256
+    dim: int = 128
+    heads: int = 4
+    layers: int = 2
+    attn_fn: Optional[AttnFn] = None
+
+    @nn.compact
+    def __call__(self, tokens, pos_offset=0, train: bool = True):
+        emb = nn.Embed(self.vocab, self.dim, name="embed")
+        x = emb(tokens)
+        positions = pos_offset + jnp.arange(tokens.shape[1])
+        for i in range(self.layers):
+            x = Block(self.dim, self.heads, attn_fn=self.attn_fn, name=f"block{i}")(
+                x, positions, train
+            )
+        x = nn.LayerNorm(use_bias=False, name="final_ln")(x)
+        return emb.attend(x)
+
+
+def lm_loss(logits: jnp.ndarray, tokens: jnp.ndarray) -> jnp.ndarray:
+    """Mean next-token cross-entropy over positions 0..T-2 of this shard.
+
+    Under sequence parallelism each shard predicts within its own block; the
+    cross-shard boundary token is dropped on every shard identically, so the
+    psum-of-means over ``sp`` is a well-defined global objective.
+    """
+    logp = nn.log_softmax(logits[:, :-1].astype(jnp.float32))
+    tgt = tokens[:, 1:]
+    nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+    return jnp.mean(nll)

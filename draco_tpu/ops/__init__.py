@@ -1,0 +1,26 @@
+"""Pallas TPU kernels for the framework's hot ops.
+
+The coded-DP data path is bandwidth-bound, not FLOP-bound: n (workers) is
+tiny, d (gradient dimension) is millions, so every encode/decode product is a
+skinny matmul whose cost is streaming the (n, d) gradient matrix through HBM.
+The kernels here fuse the real/imag pairs of each complex product into a
+single pass over the data — one HBM read where naive XLA lowering takes two.
+
+Reference parity note: these replace the role of the reference's native
+decoder module (src/c_coding.cpp) on the d-dimensional products; the tiny
+s×s / m×m solves stay in jnp.linalg (SURVEY.md §2.2).
+"""
+
+from draco_tpu.ops.coded import (
+    complex_matmul,
+    complex_project,
+    complex_recombine,
+    use_pallas,
+)
+
+__all__ = [
+    "complex_matmul",
+    "complex_project",
+    "complex_recombine",
+    "use_pallas",
+]

@@ -1,0 +1,197 @@
+"""Fused complex-arithmetic kernels for the cyclic gradient code.
+
+Three ops cover the O(n·d) work of a cyclic encode/decode step (reference:
+the einsum encode in src/worker/cyclic_worker.py:172-175 and the R-matvecs in
+src/master/cyclic_master.py:154,171 around the native s×s solve of
+src/c_coding.cpp):
+
+  * ``complex_matmul``    — encode:      (Wr + i·Wi) @ G          for real G
+  * ``complex_project``   — decode in:   (Rr + i·Ri) @ f          for real f
+  * ``complex_recombine`` — decode out:  Re[(vr + i·vi)ᵀ (Rr + i·Ri)]
+
+All three stream the big (n, d) operand exactly once; the complex pairing is
+done in VMEM. Without fusion each complex product lowers to 2–4 independent
+XLA matmuls that each re-read the operand from HBM.
+
+Dispatch: Pallas on TPU, jnp elsewhere (tests run both and compare; the
+kernels are also exercised in Pallas interpret mode on CPU).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+PREC = jax.lax.Precision.HIGHEST
+
+# d-axis tile: 8 MXU lanes' worth of f32 per row block; (n≤64, 4096)·f32
+# blocks keep well under VMEM even with two inputs + two outputs resident.
+TILE_D = 4096
+
+
+def use_pallas() -> bool:
+    return jax.default_backend() == "tpu"
+
+
+def _pad_d(x: jnp.ndarray, tile: int) -> jnp.ndarray:
+    d = x.shape[-1]
+    pad = (-d) % tile
+    if pad:
+        x = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, pad)])
+    return x
+
+
+# --------------------------------------------------------------------------
+# encode: (Wr + i Wi) @ G, G real (n, d) -> two (n, d) outputs, one read of G
+# --------------------------------------------------------------------------
+
+def _matmul_kernel(wr_ref, wi_ref, g_ref, or_ref, oi_ref):
+    g = g_ref[:]
+    or_ref[:] = jnp.dot(wr_ref[:], g, preferred_element_type=jnp.float32, precision=PREC)
+    oi_ref[:] = jnp.dot(wi_ref[:], g, preferred_element_type=jnp.float32, precision=PREC)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _matmul_pallas(w_re, w_im, g, interpret=False):
+    n, d = g.shape
+    gp = _pad_d(g, TILE_D)
+    dp = gp.shape[-1]
+    grid = (dp // TILE_D,)
+    out_re, out_im = pl.pallas_call(
+        _matmul_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((w_re.shape[0], n), lambda j: (0, 0)),
+            pl.BlockSpec((w_im.shape[0], n), lambda j: (0, 0)),
+            pl.BlockSpec((n, TILE_D), lambda j: (0, j)),
+        ],
+        out_specs=[
+            pl.BlockSpec((w_re.shape[0], TILE_D), lambda j: (0, j)),
+            pl.BlockSpec((w_re.shape[0], TILE_D), lambda j: (0, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((w_re.shape[0], dp), jnp.float32),
+            jax.ShapeDtypeStruct((w_re.shape[0], dp), jnp.float32),
+        ],
+        interpret=interpret,
+    )(w_re, w_im, gp)
+    return out_re[:, :d], out_im[:, :d]
+
+
+def complex_matmul(w_re, w_im, g, *, force=None, interpret=False):
+    """(Wr + i·Wi) @ G for real G: returns (re, im).
+
+    force: None = auto (Pallas on TPU), True/False to override.
+    """
+    w_re, w_im, g = jnp.asarray(w_re), jnp.asarray(w_im), jnp.asarray(g)
+    if force is True or interpret or (force is None and use_pallas()):
+        return _matmul_pallas(w_re, w_im, g, interpret=interpret)
+    return (
+        jnp.matmul(w_re, g, precision=PREC),
+        jnp.matmul(w_im, g, precision=PREC),
+    )
+
+
+# --------------------------------------------------------------------------
+# project: (Rr + i Ri) @ f, f real (d,) -> two (n,) outputs; reduction over d
+# accumulated across sequential grid steps, both R's read once
+# --------------------------------------------------------------------------
+
+def _project_kernel(d, rr_ref, ri_ref, f_ref, er_ref, ei_ref):
+    j = pl.program_id(0)
+
+    @pl.when(j == 0)
+    def _init():
+        er_ref[:] = jnp.zeros_like(er_ref)
+        ei_ref[:] = jnp.zeros_like(ei_ref)
+
+    base = j * TILE_D
+    cols = base + jax.lax.broadcasted_iota(jnp.int32, (1, TILE_D), 1)
+    f = jnp.where(cols < d, f_ref[:], 0.0)  # mask the ragged edge tile
+    er_ref[:] += jnp.dot(rr_ref[:], f.T, preferred_element_type=jnp.float32, precision=PREC)
+    ei_ref[:] += jnp.dot(ri_ref[:], f.T, preferred_element_type=jnp.float32, precision=PREC)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _project_pallas(r_re, r_im, f, interpret=False):
+    n, d = r_re.shape
+    rrp = _pad_d(r_re, TILE_D)
+    rip = _pad_d(r_im, TILE_D)
+    fp = _pad_d(f[None, :], TILE_D)
+    dp = rrp.shape[-1]
+    grid = (dp // TILE_D,)
+    e_re, e_im = pl.pallas_call(
+        functools.partial(_project_kernel, d),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((n, TILE_D), lambda j: (0, j)),
+            pl.BlockSpec((n, TILE_D), lambda j: (0, j)),
+            pl.BlockSpec((1, TILE_D), lambda j: (0, j)),
+        ],
+        out_specs=[
+            pl.BlockSpec((n, 1), lambda j: (0, 0)),
+            pl.BlockSpec((n, 1), lambda j: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, 1), jnp.float32),
+            jax.ShapeDtypeStruct((n, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(rrp, rip, fp)
+    return e_re[:, 0], e_im[:, 0]
+
+
+def complex_project(r_re, r_im, f, *, force=None, interpret=False):
+    """(Rr + i·Ri) @ f for real f (d,): returns (re, im) of shape (n,)."""
+    r_re, r_im, f = jnp.asarray(r_re), jnp.asarray(r_im), jnp.asarray(f)
+    if force is True or interpret or (force is None and use_pallas()):
+        return _project_pallas(r_re, r_im, f, interpret=interpret)
+    return (
+        jnp.matmul(r_re, f, precision=PREC),
+        jnp.matmul(r_im, f, precision=PREC),
+    )
+
+
+# --------------------------------------------------------------------------
+# recombine: Re[(vr + i vi)^T (Rr + i Ri)] = vr^T Rr - vi^T Ri, one pass
+# --------------------------------------------------------------------------
+
+def _recombine_kernel(vr_ref, vi_ref, rr_ref, ri_ref, out_ref):
+    out_ref[:] = jnp.dot(vr_ref[:], rr_ref[:], preferred_element_type=jnp.float32, precision=PREC) - jnp.dot(
+        vi_ref[:], ri_ref[:], preferred_element_type=jnp.float32, precision=PREC
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _recombine_pallas(v_re, v_im, r_re, r_im, interpret=False):
+    n, d = r_re.shape
+    rrp = _pad_d(r_re, TILE_D)
+    rip = _pad_d(r_im, TILE_D)
+    dp = rrp.shape[-1]
+    grid = (dp // TILE_D,)
+    out = pl.pallas_call(
+        _recombine_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, n), lambda j: (0, 0)),
+            pl.BlockSpec((1, n), lambda j: (0, 0)),
+            pl.BlockSpec((n, TILE_D), lambda j: (0, j)),
+            pl.BlockSpec((n, TILE_D), lambda j: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((1, TILE_D), lambda j: (0, j)),
+        out_shape=jax.ShapeDtypeStruct((1, dp), jnp.float32),
+        interpret=interpret,
+    )(v_re[None, :], v_im[None, :], rrp, rip)
+    return out[0, :d]
+
+
+def complex_recombine(v_re, v_im, r_re, r_im, *, force=None, interpret=False):
+    """Re[(vr + i·vi)ᵀ (Rr + i·Ri)]: returns real (d,)."""
+    v_re, v_im = jnp.asarray(v_re), jnp.asarray(v_im)
+    r_re, r_im = jnp.asarray(r_re), jnp.asarray(r_im)
+    if force is True or interpret or (force is None and use_pallas()):
+        return _recombine_pallas(v_re, v_im, r_re, r_im, interpret=interpret)
+    return jnp.matmul(v_re, r_re, precision=PREC) - jnp.matmul(v_im, r_im, precision=PREC)

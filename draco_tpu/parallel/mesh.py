@@ -1,0 +1,34 @@
+"""2-D device meshes: coded worker axis × sequence axis."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from draco_tpu.runtime import WORKER_AXIS
+
+SEQ_AXIS = "sp"
+
+
+def make_mesh_2d(
+    num_workers: int,
+    seq_shards: int,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Mesh of shape (num_workers, seq_shards) with axes (w, sp).
+
+    Lay the sequence axis innermost so its ring rides neighbouring ICI links;
+    the worker-axis gather crosses the slower dimension once per step.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    need = num_workers * seq_shards
+    if len(devices) < need:
+        raise ValueError(
+            f"make_mesh_2d({num_workers}, {seq_shards}) needs {need} devices, "
+            f"have {len(devices)}"
+        )
+    grid = np.asarray(devices[:need]).reshape(num_workers, seq_shards)
+    return Mesh(grid, (WORKER_AXIS, SEQ_AXIS))

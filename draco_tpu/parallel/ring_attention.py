@@ -1,0 +1,107 @@
+"""Ring attention — sequence-parallel exact attention over a device ring.
+
+Each ``sp``-shard holds a contiguous block of the sequence. Q stays put; K/V
+blocks travel the ring via ``lax.ppermute`` (one ICI hop per step), and every
+shard folds each visiting block into a numerically-stable streaming softmax
+(flash-attention accumulators m/l/o). After ``sp`` steps every query has seen
+every key exactly once — exact attention, O(T/sp) memory per chip, comm
+overlapped by XLA with the block einsums.
+
+Written with ``lax.scan`` so the whole ring is reverse-differentiable
+(``ppermute`` is linear; its transpose is the inverted ring), which is what
+lets per-shard gradients psum over ``sp`` into exact per-worker gradients for
+the coded-DP layer above (draco_tpu/parallel/sp_step.py).
+
+No reference counterpart: the reference is CNN-only (SURVEY.md §5.7); this
+axis is the TPU build's long-context capability.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def _block_attn(q, k, v, q_pos, k_pos, scale, causal, o, m, l):
+    """Fold one K/V block into the streaming-softmax accumulators.
+
+    q: (B, Tq, H, Dh); k, v: (B, Tk, H, Dh); q_pos: (Tq,), k_pos: (Tk,)
+    o: (B, Tq, H, Dh) accumulator, m, l: (B, Tq, H) running max / normaliser.
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+    if causal:
+        mask = q_pos[:, None] >= k_pos[None, :]  # (Tq, Tk)
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    m_blk = jnp.max(s, axis=-1)  # (B, H, Tq)
+    m_blk = jnp.moveaxis(m_blk, 1, 2)  # (B, Tq, H)
+    m_new = jnp.maximum(m, m_blk)
+    # exp of masked-everything rows stays 0 through the NEG_INF offset
+    p = jnp.exp(s - jnp.moveaxis(m_new, 1, 2)[:, :, :, None])  # (B, H, Tq, Tk)
+    corr = jnp.exp(m - m_new)  # (B, Tq, H)
+    l_new = l * corr + jnp.moveaxis(jnp.sum(p, axis=-1), 1, 2)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v, preferred_element_type=jnp.float32)
+    o_new = o * corr[..., None] + pv
+    return o_new, m_new, l_new
+
+
+def dense_attention(q, k, v, q_offset=0, k_offset=0, causal: bool = True):
+    """Single-shard exact attention with the same streaming accumulators.
+
+    Used as the sp=1 fallback and as the oracle in tests.
+    """
+    b, tq, h, dh = q.shape
+    tk = k.shape[1]
+    scale = 1.0 / (dh**0.5)
+    q_pos = q_offset + jnp.arange(tq)
+    k_pos = k_offset + jnp.arange(tk)
+    o = jnp.zeros((b, tq, h, dh), jnp.float32)
+    m = jnp.full((b, tq, h), NEG_INF, jnp.float32)
+    l = jnp.zeros((b, tq, h), jnp.float32)
+    o, m, l = _block_attn(q, k, v, q_pos, k_pos, scale, causal, o, m, l)
+    return (o / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
+
+
+def ring_attention(
+    q,
+    k,
+    v,
+    axis_name: Optional[str],
+    causal: bool = True,
+):
+    """Exact attention over sequence shards laid out on mesh axis ``axis_name``.
+
+    q, k, v: (B, T_local, H, Dh) — this shard's block of the sequence. Must be
+    called inside ``shard_map`` (or any context where ``axis_name`` is bound).
+    With ``axis_name=None`` it degrades to single-shard dense attention.
+    """
+    if axis_name is None:
+        return dense_attention(q, k, v, causal=causal)
+
+    sp = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    b, t, h, dh = q.shape
+    scale = 1.0 / (dh**0.5)
+    q_pos = idx * t + jnp.arange(t)
+
+    o0 = jnp.zeros((b, t, h, dh), jnp.float32)
+    m0 = jnp.full((b, t, h), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, t, h), jnp.float32)
+    perm = [(i, (i + 1) % sp) for i in range(sp)]
+
+    def ring_step(carry, r):
+        o, m, l, k_blk, v_blk = carry
+        # after r hops this shard holds the block owned by (idx - r) mod sp
+        owner = (idx - r) % sp
+        k_pos = owner * t + jnp.arange(t)
+        o, m, l = _block_attn(q, k_blk, v_blk, q_pos, k_pos, scale, causal, o, m, l)
+        k_nxt = lax.ppermute(k_blk, axis_name, perm)
+        v_nxt = lax.ppermute(v_blk, axis_name, perm)
+        return (o, m, l, k_nxt, v_nxt), None
+
+    (o, m, l, _, _), _ = lax.scan(ring_step, (o0, m0, l0, k, v), jnp.arange(sp))
+    return (o / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)

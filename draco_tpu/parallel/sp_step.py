@@ -1,0 +1,225 @@
+"""Coded data parallelism × sequence parallelism: the 2-D-mesh training step.
+
+Composition (SURVEY.md §5.7): ring attention makes each logical worker's
+sequence span the ``sp`` axis; the per-shard gradients psum over ``sp`` into
+exact whole per-worker gradients; Draco's coding/aggregation then acts on the
+(n, d) gradient matrix over ``w`` exactly as in the CNN path
+(draco_tpu/training/step.py) — Byzantine resilience is oblivious to how each
+worker's compute was sharded.
+
+Supported approaches here: ``baseline`` (mean / geo-median / krum) and
+``cyclic`` with shared-redundancy encode. (maj_vote's bitwise-equality vote
+is specified over identical lanes; under SP a group member is a whole mesh
+row, which the batching layer does not replicate — use the CNN path for it.)
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax import shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from draco_tpu import aggregation, attacks, optim, rng as drng
+from draco_tpu.coding import cyclic as cyclic_mod
+from draco_tpu.config import TrainConfig
+from draco_tpu.models.transformer import TransformerLM
+from draco_tpu.parallel.mesh import SEQ_AXIS
+from draco_tpu.parallel.ring_attention import ring_attention
+from draco_tpu.runtime import WORKER_AXIS
+from draco_tpu.training.step import TrainState, _flatten_tree, _make_unravel
+
+
+class SPTrainSetup(NamedTuple):
+    model: TransformerLM
+    state: TrainState
+    train_step: any  # (state, tokens (n,B,T), adv_mask (n,)) -> (state, metrics)
+    eval_step: any  # (params, tokens) -> loss (no donation, no update)
+    code: Optional[cyclic_mod.CyclicCode]
+    unravel: any
+    dim: int
+
+
+def synthetic_text(seed: int, step: int, n: int, batch: int, seq_len: int, vocab: int):
+    """Deterministic learnable token stream: ramps t_{i+1} = t_i + stride with
+    per-sequence stride ∈ {1, 2}. Same (seed, step) ⇒ same batch everywhere."""
+    r = np.random.RandomState((seed * 1_000_003 + step) % (2**31 - 1))
+    start = r.randint(0, vocab, size=(n, batch, 1))
+    stride = r.randint(1, 3, size=(n, batch, 1))
+    idx = np.arange(seq_len)[None, None, :]
+    return ((start + stride * idx) % vocab).astype(np.int32)
+
+
+def build_sp_train_setup(cfg: TrainConfig, mesh) -> SPTrainSetup:
+    """mesh must have axes (w, sp) — see make_mesh_2d."""
+    cfg.validate()
+    if cfg.approach not in ("baseline", "cyclic"):
+        raise ValueError(f"SP path supports baseline|cyclic, got {cfg.approach}")
+    n = cfg.num_workers
+    sp = mesh.shape[SEQ_AXIS]
+    assert mesh.shape[WORKER_AXIS] == n, (mesh.shape, n)
+    if cfg.seq_len % sp:
+        raise ValueError(f"seq_len {cfg.seq_len} not divisible by sp={sp}")
+    t_local = cfg.seq_len // sp
+
+    attn = functools.partial(ring_attention, axis_name=SEQ_AXIS if sp > 1 else None)
+    model = TransformerLM(
+        vocab=cfg.vocab, dim=cfg.model_dim, heads=cfg.model_heads,
+        layers=cfg.model_layers, attn_fn=attn,
+    )
+    # init single-shard (dense attention) — parameter shapes are identical
+    init_model = TransformerLM(
+        vocab=cfg.vocab, dim=cfg.model_dim, heads=cfg.model_heads,
+        layers=cfg.model_layers, attn_fn=None,
+    )
+    root = jax.random.key(cfg.seed)
+    init_toks = jnp.zeros((1, min(cfg.seq_len, 8)), jnp.int32)
+    params = init_model.init({"params": root}, init_toks, train=True)["params"]
+
+    opt = optim.build_optimizer(cfg.optimizer, cfg.lr, cfg.momentum)
+    unravel, dim = _make_unravel(params)
+
+    repl = NamedSharding(mesh, P())
+    shard_w = NamedSharding(mesh, P(WORKER_AXIS))
+    state = TrainState(
+        params=jax.device_put(params, repl),
+        opt_state=jax.device_put(opt.init(params), repl),
+        batch_stats=None,
+        step=jax.device_put(jnp.asarray(1, jnp.int32), repl),
+    )
+
+    # ---- per-device worker-gradient computation (manual SPMD) -------------
+    def device_grads(params, tokens):
+        """tokens: (1, B, t_local) — this device's shard of one worker's
+        batch. Returns (flat_grad (1, d), loss (1,)) — the worker's FULL
+        gradient, psum-assembled over sp and replicated along it.
+
+        The objective is exactly the single-shard mean next-token CE: each
+        shard also predicts its successor shard's first token (fetched with
+        one ppermute hop), the global last position is masked, and the
+        per-shard sums are normalised by the global (T−1)·B before the psum —
+        so sp is trajectory-invariant (asserted in tests/test_parallel_sp.py).
+        """
+        toks = tokens[0]
+        idx = lax.axis_index(SEQ_AXIS)
+        off = idx * t_local
+        # shard i receives shard (i+1)'s first token (garbage on the last
+        # shard, masked below)
+        nxt_first = lax.ppermute(
+            toks[:, :1], SEQ_AXIS, [(j, (j - 1) % sp) for j in range(sp)]
+        )
+        targets = jnp.concatenate([toks[:, 1:], nxt_first], axis=1)  # (B, t_local)
+        pos_valid = jnp.where(
+            idx == sp - 1,
+            (jnp.arange(t_local) < t_local - 1).astype(jnp.float32),
+            jnp.ones((t_local,), jnp.float32),
+        )
+        denom = toks.shape[0] * (cfg.seq_len - 1)
+
+        def local_loss(p):
+            logits = model.apply({"params": p}, toks, pos_offset=off, train=True)
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+            nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+            return jnp.sum(nll * pos_valid[None, :]) / denom
+
+        loss, g = jax.value_and_grad(local_loss)(params)
+        # exact per-worker grad: cotangents already routed through the ring's
+        # transpose; psum folds the shard contributions
+        g = lax.psum(g, SEQ_AXIS)
+        loss = lax.psum(loss, SEQ_AXIS)
+        return _flatten_tree(g)[None], loss[None]
+
+    grads_fn = shard_map(
+        device_grads,
+        mesh=mesh,
+        in_specs=(P(), P(WORKER_AXIS, None, SEQ_AXIS)),
+        out_specs=(P(WORKER_AXIS, None), P(WORKER_AXIS)),
+        check_vma=False,
+    )
+
+    # ---- aggregation over w (identical machinery to the CNN path) ---------
+    if cfg.approach == "cyclic":
+        code = cyclic_mod.build_cyclic_code(n, cfg.worker_fail)
+        rand_factor = jnp.asarray(drng.random_projection_factors(cfg.seed, dim))
+    else:
+        code = None
+
+    def step_body(state: TrainState, tokens, adv_mask):
+        grads, losses = grads_fn(state.params, tokens)
+        grads = lax.with_sharding_constraint(grads, shard_w)
+        if cfg.approach == "cyclic":
+            enc_re, enc_im = cyclic_mod.encode_shared(code, grads)
+            enc_re, enc_im = attacks.inject_cyclic(
+                enc_re, enc_im, adv_mask, cfg.err_mode, cfg.adversarial
+            )
+            agg, _honest = cyclic_mod.decode(code, enc_re, enc_im, rand_factor)
+        else:
+            grads = attacks.inject_plain(grads, adv_mask, cfg.err_mode, cfg.adversarial)
+            agg = aggregation.aggregate(
+                grads, cfg.mode, s=cfg.worker_fail, geomedian_iters=cfg.geomedian_iters
+            )
+        grads_tree = unravel(agg)
+        updates, new_opt = opt.update(grads_tree, state.opt_state, state.params)
+        new_params = jax.tree.map(lambda p, u: p + u, state.params, updates)
+        new_state = TrainState(new_params, new_opt, None, state.step + 1)
+        return new_state, {"loss": jnp.mean(losses)}
+
+    def eval_body(params, tokens):
+        _, losses = grads_fn(params, tokens)
+        return jnp.mean(losses)
+
+    with mesh:
+        train_step = jax.jit(step_body, donate_argnums=(0,))
+        eval_step = jax.jit(eval_body)
+
+    return SPTrainSetup(
+        model=model, state=state, train_step=train_step, eval_step=eval_step,
+        code=code, unravel=unravel, dim=dim,
+    )
+
+
+def train_sp(cfg: TrainConfig, mesh, steps: Optional[int] = None, quiet: bool = False):
+    """SP training loop on the synthetic text stream; returns the final state
+    and last-step metrics. Same operational contract as the CNN Trainer:
+    step-indexed Orbax checkpoints + held-out eval every ``eval_freq`` steps
+    into ``train_dir`` (reference: baseline_master.py:142-144), resume via
+    ``checkpoint_step``."""
+    from draco_tpu.utils import checkpoint as ckpt_mod
+    from draco_tpu.utils.metrics import MetricWriter
+
+    setup = build_sp_train_setup(cfg, mesh)
+    state = setup.state
+    start = 1
+    if cfg.checkpoint_step > 0:
+        state = ckpt_mod.load(cfg.train_dir, cfg.checkpoint_step,
+                              jax.tree.map(lambda x: x, state))
+        start = cfg.checkpoint_step + 1
+    total = steps or cfg.max_steps
+    adv = drng.adversary_schedule(
+        cfg.seed, start + total + 1, cfg.num_workers, cfg.worker_fail
+    )
+    writer = MetricWriter(cfg.train_dir or None, quiet=quiet)
+    # held-out stream: step 0 is never trained on
+    eval_toks = jnp.asarray(
+        synthetic_text(cfg.seed + 1, 0, cfg.num_workers, cfg.batch_size,
+                       cfg.seq_len, cfg.vocab)
+    )
+    metrics = {}
+    for step in range(start, start + total):
+        toks = jnp.asarray(
+            synthetic_text(cfg.seed, step, cfg.num_workers, cfg.batch_size,
+                           cfg.seq_len, cfg.vocab)
+        )
+        state, metrics = setup.train_step(state, toks, jnp.asarray(adv[step]))
+        if not quiet and step % cfg.log_every == 0:
+            print(f"sp step {step}: loss {float(metrics['loss']):.4f}", flush=True)
+        if cfg.eval_freq and cfg.train_dir and step % cfg.eval_freq == 0:
+            eval_loss = float(setup.eval_step(state.params, eval_toks))
+            writer.write({"step": step, "split": "eval", "loss": eval_loss})
+            ckpt_mod.save(cfg.train_dir, step, state)
+    return state, metrics
