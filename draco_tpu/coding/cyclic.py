@@ -208,7 +208,8 @@ def decode(code: CyclicCode, r_re: jnp.ndarray, r_im: jnp.ndarray, rand_factor: 
     r_re, r_im: (n, d) received encoded rows (≤ s rows arbitrarily corrupt).
     rand_factor: (d,) random projection (reference: cyclic_master.py:58-61).
     Returns (n·mean-gradient, honest_mask): the (d,) real decoded sum / n and
-    the located honest-row mask (n,) for observability.
+    the (n,) mask of rows the recombination actually used (True = treated as
+    honest; exactly n-2s rows are True, every located adversary is False).
     """
     n, s = code.n, code.s
     c2h_re = jnp.asarray(code.c2h_re)
@@ -232,10 +233,15 @@ def decode(code: CyclicCode, r_re: jnp.ndarray, r_im: jnp.ndarray, rand_factor: 
         b_idx = 2 * s - rows - 1
         b_re, b_im = e2_re[b_idx], e2_im[b_idx]
         # α is invariant to a common scaling of (A, b); normalising by the
-        # syndrome magnitude makes the ridge scale-free
+        # syndrome magnitude makes the ridge scale-free. The ridge must sit
+        # well above float32 epsilon: with fewer than s corrupt rows the
+        # Hankel system is genuinely rank-deficient (geometric syndromes) and
+        # a sub-epsilon ridge leaves the float32 gram numerically singular
+        # (NaN locator). α only *ranks* rows, so the O(1e-4) perturbation is
+        # harmless: corrupt-row magnitudes stay ~1e-8 vs honest ~1.
         scale = jnp.maximum(jnp.max(e2_re**2 + e2_im**2) ** 0.5, 1e-30)
         alpha_re, alpha_im = _complex_solve(
-            a_re / scale, a_im / scale, b_re / scale, b_im / scale, ridge=1e-8
+            a_re / scale, a_im / scale, b_re / scale, b_im / scale, ridge=1e-4
         )
 
         # 4. locator polynomial p(z) = z^s - Σ α_j z^j, roots at corrupt rows
@@ -247,17 +253,21 @@ def decode(code: CyclicCode, r_re: jnp.ndarray, r_im: jnp.ndarray, rand_factor: 
         val_re = jnp.matmul(est_re, poly_re, precision=PREC) - jnp.matmul(est_im, poly_im, precision=PREC)
         val_im = jnp.matmul(est_re, poly_im, precision=PREC) + jnp.matmul(est_im, poly_re, precision=PREC)
         mag = val_re**2 + val_im**2
-        # honest rows: locator does not vanish. Relative threshold replaces the
-        # reference's absolute 1e-9 (float64 there, float32 here).
-        honest = mag > (1e-6 * jnp.max(mag))
     else:
-        honest = jnp.ones((n,), dtype=bool)
+        mag = jnp.ones((n,), jnp.float32)
 
-    # 5. recombination vector v: supported on the first n-2s honest rows,
+    # 5. recombination vector v supported on n-2s located-honest rows,
     #    v^T C1[idx] = e1^T  (fixed-shape stand-in for the reference's
-    #    dynamic err_indices + scipy lsq_linear, cyclic_master.py:164-171)
+    #    dynamic err_indices + scipy lsq_linear, cyclic_master.py:164-171).
+    #    Rows are chosen as the top n-2s by locator magnitude — corrupt rows
+    #    are locator roots, so they sit in the bottom s — which stays
+    #    full-rank (any n-2s distinct rows of the DFT Vandermonde C1 are
+    #    independent) even when fewer than s rows are actually corrupt and a
+    #    thresholded mask would under- or over-fill. The returned mask marks
+    #    exactly the rows the recombination used.
     m = n - 2 * s
-    (idx,) = jnp.nonzero(honest, size=m, fill_value=0)
+    idx = jnp.sort(jax.lax.top_k(mag, m)[1])
+    honest = jnp.zeros((n,), dtype=bool).at[idx].set(True)
     rec_re = jnp.asarray(code.c1_re)[idx]  # (m, m)
     rec_im = jnp.asarray(code.c1_im)[idx]
     e1 = jnp.zeros((m,), rec_re.dtype).at[0].set(1.0)

@@ -31,54 +31,45 @@ def _epoch_and_offset(step: int, batches_per_epoch: int):
     return step // batches_per_epoch, step % batches_per_epoch
 
 
-def worker_batches_baseline(ds: Dataset, step: int, num_workers: int, batch_size: int,
-                            seed: int):
-    """(n, B, ...) batches — each worker has its own shuffle stream."""
-    n_samples = len(ds)
+def _perm_slice(perm: np.ndarray, off: int, batch_size: int, n_samples: int):
+    idx = perm[(off * batch_size) % n_samples :][:batch_size]
+    if len(idx) < batch_size:  # wrap
+        idx = np.concatenate([idx, perm[: batch_size - len(idx)]])
+    return idx
+
+
+def indices_baseline(n_samples: int, step: int, num_workers: int, batch_size: int,
+                     seed: int) -> np.ndarray:
+    """(n·B,) flat sample indices — each worker has its own shuffle stream."""
     bpe = max(n_samples // batch_size, 1)
     epoch, off = _epoch_and_offset(step, bpe)
-    xs, ys = [], []
-    for w in range(num_workers):
-        perm = drng.epoch_permutation(seed + 31 * (w + 1), epoch, n_samples)
-        idx = perm[(off * batch_size) % n_samples :][:batch_size]
-        if len(idx) < batch_size:  # wrap
-            idx = np.concatenate([idx, perm[: batch_size - len(idx)]])
-        x, y = get_batch(ds, idx)
-        xs.append(x)
-        ys.append(y)
-    return np.stack(xs), np.stack(ys)
+    return np.concatenate([
+        _perm_slice(drng.epoch_permutation(seed + 31 * (w + 1), epoch, n_samples),
+                    off, batch_size, n_samples)
+        for w in range(num_workers)
+    ])
 
 
-def worker_batches_grouped(ds: Dataset, step: int, num_workers: int, group_size: int,
-                           batch_size: int, seeds: np.ndarray):
-    """(n, B, ...) batches where group members share the shuffle (identical
+def indices_grouped(n_samples: int, step: int, num_workers: int, group_size: int,
+                    batch_size: int, seeds: np.ndarray) -> np.ndarray:
+    """(n·B,) flat indices where group members share the shuffle (identical
     batches within a group). ``seeds`` from rng.group_seeds."""
-    n_samples = len(ds)
     bpe = max(n_samples // batch_size, 1)
     epoch, off = _epoch_and_offset(step, bpe)
-    xs, ys = [], []
-    for w in range(num_workers):
-        g = w // group_size
-        perm = drng.epoch_permutation(int(seeds[g]), epoch, n_samples)
-        idx = perm[(off * batch_size) % n_samples :][:batch_size]
-        if len(idx) < batch_size:
-            idx = np.concatenate([idx, perm[: batch_size - len(idx)]])
-        x, y = get_batch(ds, idx)
-        xs.append(x)
-        ys.append(y)
-    return np.stack(xs), np.stack(ys)
+    return np.concatenate([
+        _perm_slice(drng.epoch_permutation(int(seeds[w // group_size]), epoch, n_samples),
+                    off, batch_size, n_samples)
+        for w in range(num_workers)
+    ])
 
 
-def cyclic_global_batch(ds: Dataset, step: int, num_workers: int, batch_size: int,
-                        seed: int):
-    """(n, B, ...) — the step's global batch of n·B samples split into the n
-    coded sub-batches, all addressed deterministically.
+def indices_cyclic(n_samples: int, step: int, num_workers: int, batch_size: int,
+                   seed: int) -> np.ndarray:
+    """(n·B,) flat indices of the step's deterministic global batch.
 
     Mirrors the reference's batch_bias walk over an epoch-shuffled dataset
-    (cyclic_worker.py:88-96) with the shared seed folded per epoch; row k is
-    sub-batch k, to be gathered per worker via code.batch_ids.
+    (cyclic_worker.py:88-96) with the shared seed folded per epoch.
     """
-    n_samples = len(ds)
     global_bs = num_workers * batch_size
     bpe = max(n_samples // global_bs, 1)
     epoch, off = _epoch_and_offset(step, bpe)
@@ -87,6 +78,35 @@ def cyclic_global_batch(ds: Dataset, step: int, num_workers: int, batch_size: in
     idx = perm[start : start + global_bs]
     if len(idx) < global_bs:
         idx = np.concatenate([idx, perm[: global_bs - len(idx)]])
+    return idx
+
+
+def gather(ds: Dataset, idx: np.ndarray, num_workers: int, batch_size: int):
+    """Indices -> (n, B, ...) batches + (n, B) labels."""
     x, y = get_batch(ds, idx)
-    shape = (num_workers, batch_size) + x.shape[1:]
-    return x.reshape(shape), y.reshape(num_workers, batch_size)
+    return (
+        x.reshape((num_workers, batch_size) + x.shape[1:]),
+        y.reshape(num_workers, batch_size),
+    )
+
+
+def worker_batches_baseline(ds: Dataset, step: int, num_workers: int, batch_size: int,
+                            seed: int):
+    """(n, B, ...) batches — each worker has its own shuffle stream."""
+    idx = indices_baseline(len(ds), step, num_workers, batch_size, seed)
+    return gather(ds, idx, num_workers, batch_size)
+
+
+def worker_batches_grouped(ds: Dataset, step: int, num_workers: int, group_size: int,
+                           batch_size: int, seeds: np.ndarray):
+    """(n, B, ...) batches with per-group shared shuffles (rep_worker.py:89)."""
+    idx = indices_grouped(len(ds), step, num_workers, group_size, batch_size, seeds)
+    return gather(ds, idx, num_workers, batch_size)
+
+
+def cyclic_global_batch(ds: Dataset, step: int, num_workers: int, batch_size: int,
+                        seed: int):
+    """(n, B, ...) — the global batch's n coded sub-batches; row k is
+    sub-batch k, to be gathered per worker via code.batch_ids."""
+    idx = indices_cyclic(len(ds), step, num_workers, batch_size, seed)
+    return gather(ds, idx, num_workers, batch_size)

@@ -89,16 +89,3 @@ class TransformerLM(nn.Module):
             )
         x = nn.LayerNorm(use_bias=False, name="final_ln")(x)
         return emb.attend(x)
-
-
-def lm_loss(logits: jnp.ndarray, tokens: jnp.ndarray) -> jnp.ndarray:
-    """Mean next-token cross-entropy over positions 0..T-2 of this shard.
-
-    Under sequence parallelism each shard predicts within its own block; the
-    cross-shard boundary token is dropped on every shard identically, so the
-    psum-of-means over ``sp`` is a well-defined global objective.
-    """
-    logp = nn.log_softmax(logits[:, :-1].astype(jnp.float32))
-    tgt = tokens[:, 1:]
-    nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
-    return jnp.mean(nll)

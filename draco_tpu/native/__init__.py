@@ -1,0 +1,257 @@
+"""ctypes bindings for the native runtime library (native/*.cpp).
+
+The reference shipped its hot decoder as a pybind11/Eigen extension
+(reference: src/c_coding.cpp + prebuilt c_coding.so). This image has no
+pybind11, so the native layer is a plain C-ABI shared library loaded with
+ctypes; it is built on demand from ``native/`` with the system toolchain and
+cached next to this file. Everything here degrades gracefully: if the build
+fails, ``AVAILABLE`` is False and callers use pure-Python fallbacks that
+produce byte-identical results (draco_tpu/utils/compress.py) or numpy math
+(tests assert native/jnp decode equivalence when the library is present).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(os.path.dirname(_HERE))
+_SRC_DIR = os.path.join(_REPO, "native")
+_LIB_PATH = os.path.join(_HERE, "libdraco_native.so")
+_SOURCES = ("coding.cpp", "compress.cpp", "loader.cpp")
+
+_lib = None
+AVAILABLE = False
+BUILD_ERROR: str | None = None
+
+
+def _stale() -> bool:
+    if not os.path.exists(_LIB_PATH):
+        return True
+    lib_mtime = os.path.getmtime(_LIB_PATH)
+    return any(
+        os.path.getmtime(os.path.join(_SRC_DIR, s)) > lib_mtime
+        for s in _SOURCES
+        if os.path.exists(os.path.join(_SRC_DIR, s))
+    )
+
+
+def build(verbose: bool = False) -> bool:
+    """Compile native/*.cpp -> libdraco_native.so. Returns success."""
+    global BUILD_ERROR
+    if not os.path.isdir(_SRC_DIR):
+        BUILD_ERROR = f"native source dir missing: {_SRC_DIR}"
+        return False
+    cmd = [
+        os.environ.get("CXX", "g++"), "-O3", "-std=c++17", "-fPIC", "-Wall",
+        "-pthread", *[os.path.join(_SRC_DIR, s) for s in _SOURCES],
+        "-shared", "-lz", "-o", _LIB_PATH,
+    ]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+    except (OSError, subprocess.TimeoutExpired) as e:  # no toolchain
+        BUILD_ERROR = str(e)
+        return False
+    if proc.returncode != 0:
+        BUILD_ERROR = proc.stderr[-2000:]
+        if verbose:
+            print(proc.stderr, file=sys.stderr)
+        return False
+    return True
+
+
+def _load():
+    global _lib, AVAILABLE, BUILD_ERROR
+    if _stale() and not build():
+        return
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError as e:
+        BUILD_ERROR = str(e)
+        return
+    c = ctypes
+    f64p, f32p = c.POINTER(c.c_double), c.POINTER(c.c_float)
+    u8p, i32p, i64p = c.POINTER(c.c_uint8), c.POINTER(c.c_int32), c.POINTER(c.c_int64)
+
+    lib.draco_solve_poly_a.restype = c.c_int
+    lib.draco_solve_poly_a.argtypes = [c.c_int, c.c_int, f64p, f64p, f64p, f64p]
+
+    lib.draco_cyclic_decode.restype = c.c_int
+    lib.draco_cyclic_decode.argtypes = [
+        c.c_int, c.c_int, c.c_longlong, f32p, f32p, f64p, f32p, i32p, c.c_int,
+    ]
+
+    lib.draco_compress_bound.restype = c.c_longlong
+    lib.draco_compress_bound.argtypes = [c.c_longlong]
+    lib.draco_compress.restype = c.c_longlong
+    lib.draco_compress.argtypes = [u8p, c.c_longlong, c.c_int, u8p, c.c_longlong, c.c_int]
+    lib.draco_decompress.restype = c.c_longlong
+    lib.draco_decompress.argtypes = [u8p, c.c_longlong, u8p, c.c_longlong, c.c_int]
+
+    lib.draco_loader_create.restype = c.c_void_p
+    lib.draco_loader_create.argtypes = [c.c_int]
+    lib.draco_loader_destroy.restype = None
+    lib.draco_loader_destroy.argtypes = [c.c_void_p]
+    lib.draco_loader_submit.restype = c.c_longlong
+    lib.draco_loader_submit.argtypes = [
+        c.c_void_p, u8p, c.c_longlong, i64p, c.c_longlong, u8p,
+    ]
+    lib.draco_loader_wait.restype = c.c_int
+    lib.draco_loader_wait.argtypes = [c.c_void_p, c.c_longlong]
+
+    _lib = lib
+    AVAILABLE = True
+
+
+if os.environ.get("DRACO_TPU_NO_NATIVE", "") != "1":
+    _load()
+
+
+def _ptr(a: np.ndarray, ctype):
+    return a.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+# --------------------------------------------------------------------------
+# Coding
+# --------------------------------------------------------------------------
+
+def solve_poly_a(n: int, s: int, e: np.ndarray) -> np.ndarray:
+    """Error-locator coefficients for projected column e (complex, len n).
+
+    Reference-parity signature (c_coding.cpp:15 takes (n, s, R) with R the
+    projected column). Requires the native library.
+    """
+    if not AVAILABLE:
+        raise RuntimeError(f"native library unavailable: {BUILD_ERROR}")
+    e = np.asarray(e, dtype=np.complex128)
+    e_re = np.ascontiguousarray(e.real)
+    e_im = np.ascontiguousarray(e.imag)
+    a_re = np.zeros(s, np.float64)
+    a_im = np.zeros(s, np.float64)
+    rc = _lib.draco_solve_poly_a(
+        n, s, _ptr(e_re, ctypes.c_double), _ptr(e_im, ctypes.c_double),
+        _ptr(a_re, ctypes.c_double), _ptr(a_im, ctypes.c_double),
+    )
+    if rc != 0:
+        raise ValueError(f"draco_solve_poly_a failed with code {rc}")
+    return a_re + 1j * a_im
+
+
+def cyclic_decode_host(n: int, s: int, r: np.ndarray,
+                       rand_factor: np.ndarray, num_threads: int = 0):
+    """Full native decode of received rows r ((n, d) complex) — returns
+    (mean_gradient (d,) float32, honest_mask (n,) bool). Host-side oracle /
+    fallback for draco_tpu.coding.cyclic.decode."""
+    if not AVAILABLE:
+        raise RuntimeError(f"native library unavailable: {BUILD_ERROR}")
+    r = np.asarray(r)
+    d = r.shape[1]
+    r_re = np.ascontiguousarray(r.real, dtype=np.float32)
+    r_im = np.ascontiguousarray(r.imag, dtype=np.float32)
+    f = np.ascontiguousarray(rand_factor, dtype=np.float64)
+    out = np.zeros(d, np.float32)
+    honest = np.zeros(n, np.int32)
+    rc = _lib.draco_cyclic_decode(
+        n, s, d, _ptr(r_re, ctypes.c_float), _ptr(r_im, ctypes.c_float),
+        _ptr(f, ctypes.c_double), _ptr(out, ctypes.c_float),
+        _ptr(honest, ctypes.c_int32), num_threads,
+    )
+    if rc != 0:
+        raise ValueError(f"draco_cyclic_decode failed with code {rc}")
+    return out, honest.astype(bool)
+
+
+# --------------------------------------------------------------------------
+# Compression (raw payload transforms; framing lives in utils/compress.py)
+# --------------------------------------------------------------------------
+
+def compress_bytes(raw: bytes | np.ndarray, elem_size: int, level: int = 1) -> bytes:
+    if not AVAILABLE:
+        raise RuntimeError(f"native library unavailable: {BUILD_ERROR}")
+    src = np.frombuffer(raw, dtype=np.uint8) if isinstance(raw, (bytes, bytearray)) \
+        else np.ascontiguousarray(raw).view(np.uint8).reshape(-1)
+    n = src.nbytes
+    cap = _lib.draco_compress_bound(n)
+    dst = np.zeros(cap, np.uint8)
+    size = _lib.draco_compress(
+        _ptr(src, ctypes.c_uint8), n, elem_size, _ptr(dst, ctypes.c_uint8), cap, level
+    )
+    if size < 0:
+        raise ValueError("draco_compress failed")
+    return dst[:size].tobytes()
+
+
+def decompress_bytes(buf: bytes, raw_nbytes: int, elem_size: int) -> bytes:
+    if not AVAILABLE:
+        raise RuntimeError(f"native library unavailable: {BUILD_ERROR}")
+    src = np.frombuffer(buf, dtype=np.uint8)
+    dst = np.zeros(raw_nbytes, np.uint8)
+    size = _lib.draco_decompress(
+        _ptr(src, ctypes.c_uint8), src.nbytes, _ptr(dst, ctypes.c_uint8),
+        raw_nbytes, elem_size,
+    )
+    if size != raw_nbytes:
+        raise ValueError("draco_decompress failed")
+    return dst.tobytes()
+
+
+# --------------------------------------------------------------------------
+# Batch loader
+# --------------------------------------------------------------------------
+
+class BatchLoader:
+    """Thread-pool gather of dataset rows into batch buffers, off the GIL.
+
+    Replaces the reference's multiprocess DataLoader
+    (my_data_loader.py:137-319): ``submit`` starts an async gather of
+    ``indices`` rows from a (N, ...) source array into a fresh batch array;
+    ``wait`` blocks until it is filled. Buffers are pinned in the pending
+    table so the C++ threads never outlive them.
+    """
+
+    def __init__(self, num_threads: int = 2):
+        if not AVAILABLE:
+            raise RuntimeError(f"native library unavailable: {BUILD_ERROR}")
+        self._h = _lib.draco_loader_create(num_threads)
+        self._pending: dict[int, tuple] = {}
+
+    def submit(self, src: np.ndarray, indices: np.ndarray) -> int:
+        assert src.flags["C_CONTIGUOUS"]
+        idx = np.ascontiguousarray(indices, dtype=np.int64)
+        # the C++ gather computes src + i*row_bytes with no checks; keep
+        # numpy's IndexError failure mode rather than a silent OOB read
+        if len(idx) and (idx.min() < 0 or idx.max() >= len(src)):
+            raise IndexError(
+                f"gather index out of range [0, {len(src)}): "
+                f"min={idx.min()}, max={idx.max()}"
+            )
+        row_bytes = src[0].nbytes if len(src) else 0
+        out = np.empty((len(idx),) + src.shape[1:], dtype=src.dtype)
+        ticket = _lib.draco_loader_submit(
+            self._h, _ptr(src.view(np.uint8).reshape(-1), ctypes.c_uint8),
+            row_bytes, _ptr(idx, ctypes.c_int64), len(idx),
+            _ptr(out.view(np.uint8).reshape(-1), ctypes.c_uint8),
+        )
+        self._pending[ticket] = (src, idx, out)
+        return ticket
+
+    def wait(self, ticket: int) -> np.ndarray:
+        _lib.draco_loader_wait(self._h, ticket)
+        _, _, out = self._pending.pop(ticket)
+        return out
+
+    def close(self):
+        if self._h is not None:
+            _lib.draco_loader_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

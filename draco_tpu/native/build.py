@@ -1,0 +1,15 @@
+"""Force-(re)build the native library: ``python -m draco_tpu.native.build``."""
+
+from draco_tpu import native
+
+
+def main():
+    ok = native.build(verbose=True)
+    if ok:
+        print(f"built {native._LIB_PATH}")
+    else:
+        raise SystemExit(f"native build failed:\n{native.BUILD_ERROR}")
+
+
+if __name__ == "__main__":
+    main()

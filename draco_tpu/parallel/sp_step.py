@@ -94,18 +94,13 @@ def build_sp_train_setup(cfg: TrainConfig, mesh) -> SPTrainSetup:
     )
 
     # ---- per-device worker-gradient computation (manual SPMD) -------------
-    def device_grads(params, tokens):
-        """tokens: (1, B, t_local) — this device's shard of one worker's
-        batch. Returns (flat_grad (1, d), loss (1,)) — the worker's FULL
-        gradient, psum-assembled over sp and replicated along it.
-
-        The objective is exactly the single-shard mean next-token CE: each
-        shard also predicts its successor shard's first token (fetched with
-        one ppermute hop), the global last position is masked, and the
-        per-shard sums are normalised by the global (T−1)·B before the psum —
-        so sp is trajectory-invariant (asserted in tests/test_parallel_sp.py).
-        """
-        toks = tokens[0]
+    def _shard_objective(params, toks, train: bool):
+        """This shard's masked next-token CE contribution (scalar); the
+        psum over sp equals the single-shard mean CE: each shard also
+        predicts its successor shard's first token (fetched with one
+        ppermute hop), the global last position is masked, and per-shard
+        sums are normalised by the global (T−1)·B — so sp is
+        trajectory-invariant (asserted in tests/test_parallel_sp.py)."""
         idx = lax.axis_index(SEQ_AXIS)
         off = idx * t_local
         # shard i receives shard (i+1)'s first token (garbage on the last
@@ -120,19 +115,29 @@ def build_sp_train_setup(cfg: TrainConfig, mesh) -> SPTrainSetup:
             jnp.ones((t_local,), jnp.float32),
         )
         denom = toks.shape[0] * (cfg.seq_len - 1)
+        logits = model.apply({"params": params}, toks, pos_offset=off, train=train)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        return jnp.sum(nll * pos_valid[None, :]) / denom
 
-        def local_loss(p):
-            logits = model.apply({"params": p}, toks, pos_offset=off, train=True)
-            logp = jax.nn.log_softmax(logits.astype(jnp.float32))
-            nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-            return jnp.sum(nll * pos_valid[None, :]) / denom
-
-        loss, g = jax.value_and_grad(local_loss)(params)
+    def device_grads(params, tokens):
+        """tokens: (1, B, t_local) — this device's shard of one worker's
+        batch. Returns (flat_grad (1, d), loss (1,)) — the worker's FULL
+        gradient, psum-assembled over sp and replicated along it."""
+        toks = tokens[0]
+        loss, g = jax.value_and_grad(
+            lambda p: _shard_objective(p, toks, train=True)
+        )(params)
         # exact per-worker grad: cotangents already routed through the ring's
         # transpose; psum folds the shard contributions
         g = lax.psum(g, SEQ_AXIS)
         loss = lax.psum(loss, SEQ_AXIS)
         return _flatten_tree(g)[None], loss[None]
+
+    def device_loss(params, tokens):
+        """Forward-only held-out loss (no backward, no gradient ICI traffic)."""
+        loss = lax.psum(_shard_objective(params, tokens[0], train=False), SEQ_AXIS)
+        return loss[None]
 
     grads_fn = shard_map(
         device_grads,
@@ -169,9 +174,16 @@ def build_sp_train_setup(cfg: TrainConfig, mesh) -> SPTrainSetup:
         new_state = TrainState(new_params, new_opt, None, state.step + 1)
         return new_state, {"loss": jnp.mean(losses)}
 
+    loss_fn = shard_map(
+        device_loss,
+        mesh=mesh,
+        in_specs=(P(), P(WORKER_AXIS, None, SEQ_AXIS)),
+        out_specs=P(WORKER_AXIS),
+        check_vma=False,
+    )
+
     def eval_body(params, tokens):
-        _, losses = grads_fn(params, tokens)
-        return jnp.mean(losses)
+        return jnp.mean(loss_fn(params, tokens))
 
     with mesh:
         train_step = jax.jit(step_body, donate_argnums=(0,))
@@ -204,11 +216,13 @@ def train_sp(cfg: TrainConfig, mesh, steps: Optional[int] = None, quiet: bool = 
         cfg.seed, start + total + 1, cfg.num_workers, cfg.worker_fail
     )
     writer = MetricWriter(cfg.train_dir or None, quiet=quiet)
-    # held-out stream: step 0 is never trained on
-    eval_toks = jnp.asarray(
-        synthetic_text(cfg.seed + 1, 0, cfg.num_workers, cfg.batch_size,
-                       cfg.seq_len, cfg.vocab)
-    )
+    eval_toks = None
+    if cfg.eval_freq and cfg.train_dir:
+        # held-out stream: step 0 is never trained on
+        eval_toks = jnp.asarray(
+            synthetic_text(cfg.seed + 1, 0, cfg.num_workers, cfg.batch_size,
+                           cfg.seq_len, cfg.vocab)
+        )
     metrics = {}
     for step in range(start, start + total):
         toks = jnp.asarray(

@@ -21,6 +21,7 @@ from draco_tpu import rng as drng
 from draco_tpu.config import TrainConfig
 from draco_tpu.data import batching
 from draco_tpu.data.datasets import Dataset, load_dataset
+from draco_tpu.data.prefetch import BatchPrefetcher
 from draco_tpu.runtime import WORKER_AXIS, make_mesh
 from draco_tpu.training.step import build_train_setup
 from draco_tpu.utils import checkpoint as ckpt
@@ -41,25 +42,30 @@ class Trainer:
             cfg.seed, cfg.max_steps, cfg.num_workers, cfg.worker_fail
         )
         self._group_seeds = drng.group_seeds(cfg.seed, max(cfg.num_groups, 1))
+        self._prefetch = BatchPrefetcher(
+            self.ds, self._batch_indices, cfg.num_workers, cfg.batch_size
+        )
         self._start_step = 1
         if cfg.checkpoint_step:
             self.restore(cfg.checkpoint_step)
 
     # ---- data ------------------------------------------------------------
-    def _host_batch(self, step: int):
+    def _batch_indices(self, step: int) -> np.ndarray:
+        """Flat (n·B,) sample indices for 1-based training ``step``."""
         cfg = self.cfg
+        n = len(self.ds)
         if cfg.approach == "baseline":
-            return batching.worker_batches_baseline(
-                self.ds, step - 1, cfg.num_workers, cfg.batch_size, cfg.seed
-            )
+            return batching.indices_baseline(n, step - 1, cfg.num_workers,
+                                             cfg.batch_size, cfg.seed)
         if cfg.approach == "maj_vote":
-            return batching.worker_batches_grouped(
-                self.ds, step - 1, cfg.num_workers, cfg.group_size, cfg.batch_size,
-                self._group_seeds,
-            )
-        return batching.cyclic_global_batch(
-            self.ds, step - 1, cfg.num_workers, cfg.batch_size, cfg.seed
-        )
+            return batching.indices_grouped(n, step - 1, cfg.num_workers,
+                                            cfg.group_size, cfg.batch_size,
+                                            self._group_seeds)
+        return batching.indices_cyclic(n, step - 1, cfg.num_workers,
+                                       cfg.batch_size, cfg.seed)
+
+    def _host_batch(self, step: int):
+        return self._prefetch.get(step)
 
     def _device_batch(self, step: int):
         x, y = self._host_batch(step)
@@ -114,6 +120,10 @@ class Trainer:
         }
         self.writer.write(rec)
         return rec
+
+    def close(self):
+        self._prefetch.close()
+        self.writer.close()
 
     # ---- checkpoint ------------------------------------------------------
     def restore(self, step: int):

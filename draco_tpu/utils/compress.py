@@ -1,0 +1,77 @@
+"""Gradient/array wire compression — API parity with the reference's
+``compress_gradient.compress/decompress`` (reference:
+src/compress_gradient.py:7-15, blosc.pack_array with the 'snappy' codec).
+
+On-ICI gradient traffic needs no host compression in the SPMD design
+(SURVEY.md §5.8), so this serves the places bytes still cross a slow link:
+checkpoint payloads, host<->host DCN sidecars, and the evaluator's NFS-like
+train_dir. Format: a fixed header (dtype/shape/elem-size) + byte-shuffled
+deflate payload — blosc's SHUFFLE filter re-implemented natively
+(native/compress.cpp), with a numpy+zlib fallback that produces byte-identical
+streams (same shuffle, same zlib), so archives are portable across backends.
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+
+import numpy as np
+
+from draco_tpu import native
+
+_MAGIC = b"DCG1"
+
+
+def _shuffle_np(raw: bytes, elem: int) -> bytes:
+    a = np.frombuffer(raw, np.uint8)
+    n = (len(a) // elem) * elem
+    body = a[:n].reshape(-1, elem).T
+    return body.tobytes() + a[n:].tobytes()
+
+
+def _unshuffle_np(raw: bytes, elem: int) -> bytes:
+    a = np.frombuffer(raw, np.uint8)
+    n = (len(a) // elem) * elem
+    body = np.ascontiguousarray(a[:n].reshape(elem, -1).T)
+    return body.tobytes() + a[n:].tobytes()
+
+
+def compress(grad: np.ndarray, level: int = 1) -> bytes:
+    """Pack an ndarray (reference: compress_gradient.py:7-10)."""
+    arr = np.ascontiguousarray(grad)
+    elem = arr.dtype.itemsize
+    dt = arr.dtype.str.encode()
+    header = _MAGIC + struct.pack(
+        "<BBH", elem, len(dt), arr.ndim
+    ) + dt + struct.pack(f"<{arr.ndim}q", *arr.shape) + struct.pack("<q", arr.nbytes)
+    if native.AVAILABLE:
+        payload = native.compress_bytes(arr, elem, level)
+    else:
+        raw = arr.tobytes()
+        if elem > 1 and arr.nbytes >= elem:
+            raw = _shuffle_np(raw, elem)
+        payload = zlib.compress(raw, level)
+    return header + payload
+
+
+def decompress(buf: bytes) -> np.ndarray:
+    """Unpack (reference: compress_gradient.py:12-15)."""
+    if buf[:4] != _MAGIC:
+        raise ValueError("not a draco_tpu compressed array")
+    elem, dt_len, ndim = struct.unpack_from("<BBH", buf, 4)
+    off = 8
+    dtype = np.dtype(buf[off : off + dt_len].decode())
+    off += dt_len
+    shape = struct.unpack_from(f"<{ndim}q", buf, off)
+    off += 8 * ndim
+    (nbytes,) = struct.unpack_from("<q", buf, off)
+    off += 8
+    payload = buf[off:]
+    if native.AVAILABLE:
+        raw = native.decompress_bytes(payload, nbytes, elem)
+    else:
+        raw = zlib.decompress(payload)
+        if elem > 1 and nbytes >= elem:
+            raw = _unshuffle_np(raw, elem)
+    return np.frombuffer(raw, dtype).reshape(shape).copy()

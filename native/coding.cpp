@@ -1,0 +1,243 @@
+// Native cyclic-code decoder core.
+//
+// TPU-native re-design of the reference's native decoder (reference:
+// src/c_coding.cpp:15-84 — pybind11/Eigen `solve_poly_a`): same algebra
+// (syndrome -> Hankel system -> error-locator polynomial), but exposed as a
+// plain C ABI (ctypes-loadable, no pybind11 in this image) and extended with
+// a complete host-side decoder `draco_cyclic_decode` used as (a) the test
+// oracle for the jit/Pallas decode path in draco_tpu/coding/cyclic.py and
+// (b) a host fallback when no accelerator is attached.
+//
+// No Eigen: the systems are at most (n-2s)x(n-2s); hand-rolled complex
+// Gaussian elimination with partial pivoting + ridge-regularised normal
+// equations (mirroring the jnp path's rank-deficiency handling, which in
+// turn mirrors the reference's SVD least-squares, c_coding.cpp:81).
+
+#include <algorithm>
+#include <cmath>
+#include <complex>
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+using cd = std::complex<double>;
+constexpr double kPi = 3.14159265358979323846;
+
+// Solve A x = b in-place (m x m complex, Gaussian elimination, partial
+// pivoting). Returns false if singular to working precision.
+bool solve_ge(std::vector<cd>& a, std::vector<cd>& b, int m) {
+  for (int col = 0; col < m; ++col) {
+    int piv = col;
+    double best = std::abs(a[col * m + col]);
+    for (int r = col + 1; r < m; ++r) {
+      double v = std::abs(a[r * m + col]);
+      if (v > best) { best = v; piv = r; }
+    }
+    if (best < 1e-300) return false;
+    if (piv != col) {
+      for (int c = 0; c < m; ++c) std::swap(a[col * m + c], a[piv * m + c]);
+      std::swap(b[col], b[piv]);
+    }
+    cd inv = 1.0 / a[col * m + col];
+    for (int r = col + 1; r < m; ++r) {
+      cd f = a[r * m + col] * inv;
+      if (f == cd(0.0, 0.0)) continue;
+      for (int c = col; c < m; ++c) a[r * m + c] -= f * a[col * m + c];
+      b[r] -= f * b[col];
+    }
+  }
+  for (int r = m - 1; r >= 0; --r) {
+    cd acc = b[r];
+    for (int c = r + 1; c < m; ++c) acc -= a[r * m + c] * b[c];
+    b[r] = acc / a[r * m + r];
+  }
+  return true;
+}
+
+// Ridge-regularised least squares via normal equations:
+// x = (A^H A + ridge I)^{-1} A^H b.  A is m x m.
+bool solve_ridge(const std::vector<cd>& a, const std::vector<cd>& b,
+                 std::vector<cd>& x, int m, double ridge) {
+  std::vector<cd> gram(m * m);
+  std::vector<cd> rhs(m);
+  for (int i = 0; i < m; ++i) {
+    for (int j = 0; j < m; ++j) {
+      cd acc(0.0, 0.0);
+      for (int k = 0; k < m; ++k) acc += std::conj(a[k * m + i]) * a[k * m + j];
+      if (i == j) acc += ridge;
+      gram[i * m + j] = acc;
+    }
+    cd acc(0.0, 0.0);
+    for (int k = 0; k < m; ++k) acc += std::conj(a[k * m + i]) * b[k];
+    rhs[i] = acc;
+  }
+  if (!solve_ge(gram, rhs, m)) return false;
+  x = rhs;
+  return true;
+}
+
+// C[p][q] = exp(-2*pi*i*p*q/n)/sqrt(n) (draco_tpu.coding.cyclic._dft_c;
+// reference builds the same matrix natively, c_coding.cpp:38-60).
+std::vector<cd> dft_c(int n) {
+  std::vector<cd> c(n * n);
+  double scale = 1.0 / std::sqrt((double)n);
+  for (int p = 0; p < n; ++p)
+    for (int q = 0; q < n; ++q) {
+      double ang = -2.0 * kPi * (double)((long long)p * q % n) / n;
+      c[p * n + q] = cd(std::cos(ang) * scale, std::sin(ang) * scale);
+    }
+  return c;
+}
+
+// Error-locator coefficients alpha from the projected received column e
+// (length n).  Mirrors c_coding.cpp:65-81: syndrome E2 = C2^H e, Hankel
+// system A[i][j] = E2[s-1-i+j], rhs b[i] = E2[2s-1-i], ridge least squares.
+bool locator_alpha(int n, int s, const cd* e, std::vector<cd>& alpha) {
+  int m = n - 2 * s;  // C1 width; C2 = columns m..n-1
+  std::vector<cd> c = dft_c(n);
+  std::vector<cd> e2(2 * s);
+  for (int r = 0; r < 2 * s; ++r) {
+    cd acc(0.0, 0.0);
+    for (int i = 0; i < n; ++i) acc += std::conj(c[i * n + (m + r)]) * e[i];
+    e2[r] = acc;
+  }
+  double scale = 0.0;
+  for (const cd& v : e2) scale = std::max(scale, std::abs(v));
+  scale = std::max(scale, 1e-30);
+  std::vector<cd> a(s * s);
+  std::vector<cd> b(s);
+  for (int i = 0; i < s; ++i) {
+    for (int j = 0; j < s; ++j) a[i * s + j] = e2[s - 1 - i + j] / scale;
+    b[i] = e2[2 * s - 1 - i] / scale;
+  }
+  return solve_ridge(a, b, alpha, s, 1e-8);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Reference-parity entry point (c_coding.cpp:15,91 `solve_poly_a`): e is the
+// projected column (n complex values as separate re/im arrays); writes the s
+// error-locator coefficients. Returns 0 on success.
+int draco_solve_poly_a(int n, int s, const double* e_re, const double* e_im,
+                       double* alpha_re, double* alpha_im) {
+  if (n <= 4 * s || s <= 0) return 1;
+  std::vector<cd> e(n);
+  for (int i = 0; i < n; ++i) e[i] = cd(e_re[i], e_im[i]);
+  std::vector<cd> alpha;
+  if (!locator_alpha(n, s, e.data(), alpha)) return 2;
+  for (int i = 0; i < s; ++i) { alpha_re[i] = alpha[i].real(); alpha_im[i] = alpha[i].imag(); }
+  return 0;
+}
+
+// Full host decode (cyclic_master.py:152-173 semantics, matching the
+// fixed-shape jnp decode in draco_tpu/coding/cyclic.py):
+//   r_re/r_im: (n, d) row-major received rows, <= s arbitrarily corrupt.
+//   rand_factor: (d,) projection.
+//   out: (d,) = Re(v^T R) / n, i.e. the mean of the n batch gradients.
+//   honest_out: (n,) 0/1 located-honest mask (may be null).
+// Returns 0 on success.
+int draco_cyclic_decode(int n, int s, long long d,
+                        const float* r_re, const float* r_im,
+                        const double* rand_factor,
+                        float* out, int32_t* honest_out, int num_threads) {
+  if (n <= 4 * s || s < 0 || d <= 0) return 1;
+  int m = n - 2 * s;
+  if (num_threads < 1) num_threads = (int)std::thread::hardware_concurrency();
+  if (num_threads < 1) num_threads = 1;
+  num_threads = std::min<long long>(num_threads, std::max<long long>(1, d / 4096 + 1));
+
+  // 1. project e = R f (threaded over the d axis with partial sums)
+  std::vector<cd> e(n, cd(0.0, 0.0));
+  {
+    std::vector<std::vector<cd>> partial(num_threads, std::vector<cd>(n, cd(0.0, 0.0)));
+    std::vector<std::thread> ts;
+    long long chunk = (d + num_threads - 1) / num_threads;
+    for (int t = 0; t < num_threads; ++t) {
+      ts.emplace_back([&, t] {
+        long long lo = t * chunk, hi = std::min<long long>(d, lo + chunk);
+        for (int i = 0; i < n; ++i) {
+          double ar = 0.0, ai = 0.0;
+          const float* rr = r_re + (long long)i * d;
+          const float* ri = r_im + (long long)i * d;
+          for (long long j = lo; j < hi; ++j) {
+            ar += (double)rr[j] * rand_factor[j];
+            ai += (double)ri[j] * rand_factor[j];
+          }
+          partial[t][i] = cd(ar, ai);
+        }
+      });
+    }
+    for (auto& th : ts) th.join();
+    for (int t = 0; t < num_threads; ++t)
+      for (int i = 0; i < n; ++i) e[i] += partial[t][i];
+  }
+
+  // 2-4. locator polynomial -> per-row magnitudes
+  std::vector<double> mag(n, 1.0);
+  if (s > 0) {
+    std::vector<cd> alpha;
+    if (!locator_alpha(n, s, e.data(), alpha)) return 2;
+    // p(z) = z^s - sum_j alpha_j z^j on the grid z_t = exp(+2*pi*i*t/n)
+    for (int t = 0; t < n; ++t) {
+      double ang = 2.0 * kPi * t / n;
+      cd z(std::cos(ang), std::sin(ang));
+      cd zp(1.0, 0.0);
+      cd val(0.0, 0.0);
+      for (int j = 0; j < s; ++j) { val -= alpha[j] * zp; zp *= z; }
+      val += zp;  // z^s
+      mag[t] = std::norm(val);
+    }
+  }
+
+  // 5. recombination v on the top n-2s rows by locator magnitude (corrupt
+  //    rows are locator roots, so they rank in the bottom s; top-m selection
+  //    stays full-rank even under fewer-than-s actual corruptions — same
+  //    policy as the jit decode), solve C1[idx]^T v = e1. honest_out marks
+  //    exactly the rows used.
+  std::vector<int> order(n);
+  for (int i = 0; i < n; ++i) order[i] = i;
+  std::stable_sort(order.begin(), order.end(),
+                   [&](int a, int b) { return mag[a] > mag[b]; });
+  std::vector<int> idx(order.begin(), order.begin() + m);
+  std::sort(idx.begin(), idx.end());
+  if (honest_out) {
+    for (int i = 0; i < n; ++i) honest_out[i] = 0;
+    for (int i : idx) honest_out[i] = 1;
+  }
+  std::vector<cd> c = dft_c(n);
+  std::vector<cd> a(m * m);  // a[k][j] = C1[idx[j]][k]  (the transpose)
+  for (int k = 0; k < m; ++k)
+    for (int j = 0; j < m; ++j) a[k * m + j] = c[idx[j] * n + k];
+  std::vector<cd> v(m, cd(0.0, 0.0));
+  v[0] = cd(1.0, 0.0);
+  if (!solve_ge(a, v, m)) return 4;
+
+  // 6. out = Re(v^T R)/n, threaded over d
+  {
+    std::vector<std::thread> ts;
+    long long chunk = (d + num_threads - 1) / num_threads;
+    for (int t = 0; t < num_threads; ++t) {
+      ts.emplace_back([&, t] {
+        long long lo = t * chunk, hi = std::min<long long>(d, lo + chunk);
+        for (long long j = lo; j < hi; ++j) out[j] = 0.0f;
+        for (int j = 0; j < m; ++j) {
+          int row = idx[j];
+          double vr = v[j].real(), vi = v[j].imag();
+          const float* rr = r_re + (long long)row * d;
+          const float* ri = r_im + (long long)row * d;
+          for (long long k = lo; k < hi; ++k)
+            out[k] += (float)((vr * rr[k] - vi * ri[k]) / n);
+        }
+      });
+    }
+    for (auto& th : ts) th.join();
+  }
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,84 @@
+// Native gradient wire compression: byte-shuffle filter + zlib deflate.
+//
+// Replaces the reference's blosc('snappy') gradient packer (reference:
+// src/compress_gradient.py:7-15). blosc is not in this image; the shuffle
+// filter it applies before the codec is what makes float gradients
+// compressible, so we implement shuffle + deflate directly. The byte format
+// is owned by draco_tpu/utils/compress.py (which prepends dtype/shape
+// headers); this file only transforms raw byte payloads.
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+#include <zlib.h>
+
+namespace {
+
+// Byte transposition across elements: output groups byte 0 of every element,
+// then byte 1, ... (same filter as blosc's SHUFFLE).
+void shuffle_bytes(const uint8_t* src, uint8_t* dst, long long nbytes, int elem) {
+  long long nelem = nbytes / elem;
+  for (int b = 0; b < elem; ++b) {
+    const uint8_t* s = src + b;
+    uint8_t* o = dst + b * nelem;
+    for (long long i = 0; i < nelem; ++i) o[i] = s[i * elem];
+  }
+  // trailing bytes (nbytes not divisible by elem) are passed through
+  std::memcpy(dst + nelem * elem, src + nelem * elem, nbytes - nelem * elem);
+}
+
+void unshuffle_bytes(const uint8_t* src, uint8_t* dst, long long nbytes, int elem) {
+  long long nelem = nbytes / elem;
+  for (int b = 0; b < elem; ++b) {
+    const uint8_t* s = src + b * nelem;
+    uint8_t* o = dst + b;
+    for (long long i = 0; i < nelem; ++i) o[i * elem] = s[i];
+  }
+  std::memcpy(dst + nelem * elem, src + nelem * elem, nbytes - nelem * elem);
+}
+
+}  // namespace
+
+extern "C" {
+
+long long draco_compress_bound(long long nbytes) {
+  return (long long)compressBound((uLong)nbytes);
+}
+
+// Shuffle (if elem_size > 1) then deflate. Returns compressed size, or -1 on
+// error. dst must have capacity draco_compress_bound(nbytes).
+long long draco_compress(const uint8_t* src, long long nbytes, int elem_size,
+                         uint8_t* dst, long long dst_cap, int level) {
+  if (nbytes < 0 || elem_size < 1) return -1;
+  const uint8_t* payload = src;
+  std::vector<uint8_t> shuffled;
+  if (elem_size > 1 && nbytes >= elem_size) {
+    shuffled.resize(nbytes);
+    shuffle_bytes(src, shuffled.data(), nbytes, elem_size);
+    payload = shuffled.data();
+  }
+  uLongf out_len = (uLongf)dst_cap;
+  if (compress2(dst, &out_len, payload, (uLong)nbytes, level) != Z_OK) return -1;
+  return (long long)out_len;
+}
+
+// Inflate then unshuffle. dst_bytes must be the exact original size.
+// Returns dst_bytes, or -1 on error.
+long long draco_decompress(const uint8_t* src, long long src_bytes,
+                           uint8_t* dst, long long dst_bytes, int elem_size) {
+  if (src_bytes < 0 || dst_bytes < 0 || elem_size < 1) return -1;
+  if (elem_size > 1 && dst_bytes >= elem_size) {
+    std::vector<uint8_t> shuffled(dst_bytes);
+    uLongf out_len = (uLongf)dst_bytes;
+    if (uncompress(shuffled.data(), &out_len, src, (uLong)src_bytes) != Z_OK) return -1;
+    if ((long long)out_len != dst_bytes) return -1;
+    unshuffle_bytes(shuffled.data(), dst, dst_bytes, elem_size);
+    return dst_bytes;
+  }
+  uLongf out_len = (uLongf)dst_bytes;
+  if (uncompress(dst, &out_len, src, (uLong)src_bytes) != Z_OK) return -1;
+  return (long long)out_len;
+}
+
+}  // extern "C"

@@ -11,7 +11,6 @@ from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from draco_tpu.config import TrainConfig
-from draco_tpu.models.transformer import TransformerLM, lm_loss
 from draco_tpu.parallel import make_mesh_2d, ring_attention
 from draco_tpu.parallel.ring_attention import dense_attention
 from draco_tpu.parallel.sp_step import build_sp_train_setup, synthetic_text, train_sp

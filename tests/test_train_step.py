@@ -112,8 +112,8 @@ class TestCyclic:
                        redundancy=redundancy, max_steps=40)
         tr, first, last = run_steps(cfg, ds, mesh, 25)
         assert last["loss"] < first["loss"]
-        # locator must report exactly n - s honest rows every step
-        assert last["honest_located"] == 7.0
+        # decode uses exactly n - 2s rows every step (n=8, s=1)
+        assert last["honest_located"] == 6.0
 
     def test_simulate_and_shared_agree(self, ds, mesh):
         """The r× redundant path and the compute-once path must produce the
@@ -146,7 +146,7 @@ class TestBatchNormModel:
                        redundancy="shared", max_steps=4, lr=0.01)
         tr, first, last = run_steps(cfg, ds, mesh, 3)
         assert np.isfinite(last["loss"])
-        assert last["honest_located"] == 7.0
+        assert last["honest_located"] == 6.0
 
 
 class TestEvalAndCheckpoint:
