@@ -72,6 +72,21 @@ def make_mesh(num_workers: int, devices: Optional[Sequence[jax.Device]] = None) 
     return Mesh(np.asarray(devices[:n_dev]), (WORKER_AXIS,))
 
 
+def put_global(arr: np.ndarray, sharding: NamedSharding):
+    """Host array -> (possibly multi-host) global device array.
+
+    Single-process: a plain sharded device_put. Multi-process: every process
+    holds the full host array (batch indices are deterministic, so all hosts
+    agree) and contributes only the shards its addressable devices own —
+    the multi-host feeding discipline that replaces the reference's per-rank
+    MPI sends (baseline_worker.py:258-273); the cross-host gradient gather
+    then rides DCN inside the jitted step.
+    """
+    if jax.process_count() == 1:
+        return jax.device_put(arr, sharding)
+    return jax.make_array_from_callback(arr.shape, sharding, lambda idx: arr[idx])
+
+
 def worker_sharding(mesh: Mesh) -> NamedSharding:
     """Sharding for arrays with a leading logical-worker axis."""
     return NamedSharding(mesh, P(WORKER_AXIS))

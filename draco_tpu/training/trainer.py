@@ -22,7 +22,7 @@ from draco_tpu.config import TrainConfig
 from draco_tpu.data import batching
 from draco_tpu.data.datasets import Dataset, load_dataset
 from draco_tpu.data.prefetch import BatchPrefetcher
-from draco_tpu.runtime import WORKER_AXIS, make_mesh
+from draco_tpu.runtime import WORKER_AXIS, make_mesh, put_global
 from draco_tpu.training.step import build_train_setup
 from draco_tpu.utils import checkpoint as ckpt
 from draco_tpu.utils.metrics import MetricWriter, Segments
@@ -36,7 +36,11 @@ class Trainer:
         self.ds = dataset if dataset is not None else load_dataset(cfg.dataset, cfg.data_dir)
         self.setup = build_train_setup(cfg, self.mesh, dataset_name=self.ds.name)
         self.state = self.setup.state
-        self.writer = MetricWriter(cfg.train_dir, quiet=quiet)
+        # on multi-host, only process 0 emits metrics (checkpoint saves stay
+        # collective — every process contributes its addressable shards)
+        self._is_main = jax.process_index() == 0
+        self.writer = MetricWriter(cfg.train_dir if self._is_main else None,
+                                   quiet=quiet or not self._is_main)
         self._shard_w = NamedSharding(self.mesh, P(WORKER_AXIS))
         self._adv_schedule = drng.adversary_schedule(
             cfg.seed, cfg.max_steps, cfg.num_workers, cfg.worker_fail
@@ -70,8 +74,8 @@ class Trainer:
     def _device_batch(self, step: int):
         x, y = self._host_batch(step)
         return (
-            jax.device_put(jnp.asarray(x), self._shard_w),
-            jax.device_put(jnp.asarray(y), self._shard_w),
+            put_global(np.asarray(x), self._shard_w),
+            put_global(np.asarray(y), self._shard_w),
         )
 
     # ---- train -----------------------------------------------------------
@@ -83,7 +87,8 @@ class Trainer:
             seg = Segments()
             seg.begin("fetch")
             x, y = self._device_batch(step)
-            mask = jnp.asarray(self._adv_schedule[min(step, cfg.max_steps)])
+            # numpy (uncommitted) so multi-host jit treats it as replicated
+            mask = np.asarray(self._adv_schedule[min(step, cfg.max_steps)])
             seg.end()
 
             seg.begin("comp")  # fwd+bwd+encode+gather+decode+update, one program
@@ -108,8 +113,8 @@ class Trainer:
         bs = min(batch_size or self.cfg.test_batch_size, n)
         p1s, p5s = [], []
         for i in range(0, n - bs + 1, bs):
-            x = jnp.asarray(self.ds.test_x[i : i + bs])
-            y = jnp.asarray(self.ds.test_y[i : i + bs])
+            x = np.asarray(self.ds.test_x[i : i + bs])
+            y = np.asarray(self.ds.test_y[i : i + bs])
             p1, p5 = self.setup.eval_step(self.state, x, y)
             p1s.append(float(p1))
             p5s.append(float(p5))

@@ -22,8 +22,12 @@ def _path(train_dir: str, step: int) -> str:
 def save(train_dir: str, step: int, state: Any) -> str:
     os.makedirs(train_dir, exist_ok=True)
     path = _path(train_dir, step)
+    # single-host: plain numpy payload. Multi-host: keep global jax.Arrays —
+    # device_get cannot materialise non-addressable shards; Orbax gathers
+    # them collectively (all processes must call save).
+    payload = jax.device_get(state) if jax.process_count() == 1 else state
     with ocp.StandardCheckpointer() as ckptr:
-        ckptr.save(path, jax.device_get(state), force=True)
+        ckptr.save(path, payload, force=True)
     return path
 
 

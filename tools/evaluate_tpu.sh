@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Checkpoint-polling evaluator — parity with the reference's
+# evaluate_pytorch.sh (reference: src/evaluate_pytorch.sh:1-5): watches
+# train_dir for step-indexed checkpoints and reports top-1/top-5.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec python -m draco_tpu.training.evaluator \
+  --network FC \
+  --dataset MNIST \
+  --train-dir ./train_out/ \
+  --eval-freq 50 \
+  "$@"

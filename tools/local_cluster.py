@@ -1,0 +1,88 @@
+#!/usr/bin/env python
+"""Local multi-process cluster launcher — the ``mpirun -n P`` equivalent.
+
+The reference trains multi-node by launching one MPI rank per host
+(reference: src/README.md:10, tools/local_script.sh). The TPU-native
+equivalent is one *JAX process* per host sharing a global device mesh via
+``jax.distributed``; this script simulates that cluster on one machine:
+it spawns N processes, each pinned to K virtual CPU devices, wired to a
+shared coordinator — the same code path (gloo collectives over the
+process boundary) a real multi-host TPU pod uses over DCN.
+
+Usage:
+  python tools/local_cluster.py -n 2 -d 4 -- \
+      python -m draco_tpu.cli --approach cyclic --network LeNet \
+        --dataset synthetic-mnist --num-workers 8 --worker-fail 1 \
+        --max-steps 20 --cpu-mesh 4
+
+Each child gets DRACO_COORDINATOR / DRACO_NUM_PROCESSES / DRACO_PROCESS_ID
+(read by draco_tpu.runtime.init_distributed) and an XLA host-device count of
+``-d``. Exit code is the first non-zero child exit code.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import subprocess
+import sys
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def launch(num_processes: int, devices_per_process: int, cmd: list[str],
+           env: dict | None = None, prefix_output: bool = True) -> int:
+    port = _free_port()
+    base = dict(os.environ, **(env or {}))
+    base["DRACO_COORDINATOR"] = f"localhost:{port}"
+    base["DRACO_NUM_PROCESSES"] = str(num_processes)
+    base["XLA_FLAGS"] = (
+        base.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={devices_per_process}"
+    ).strip()
+    base.setdefault("JAX_PLATFORMS", "cpu")
+
+    procs = []
+    for pid in range(num_processes):
+        child_env = dict(base, DRACO_PROCESS_ID=str(pid))
+        procs.append(
+            subprocess.Popen(
+                cmd, env=child_env,
+                stdout=subprocess.PIPE if prefix_output else None,
+                stderr=subprocess.STDOUT if prefix_output else None,
+                text=prefix_output,
+            )
+        )
+    rc = 0
+    for pid, p in enumerate(procs):
+        out, _ = p.communicate() if prefix_output else (None, None)
+        if prefix_output and out:
+            for line in out.splitlines():
+                print(f"[proc {pid}] {line}", flush=True)
+        if p.returncode != 0 and rc == 0:
+            rc = p.returncode
+    return rc
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("-n", "--num-processes", type=int, default=2)
+    ap.add_argument("-d", "--devices-per-process", type=int, default=4)
+    ap.add_argument("cmd", nargs=argparse.REMAINDER,
+                    help="command to run in every process (prefix with --)")
+    args = ap.parse_args(argv)
+    cmd = args.cmd
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        ap.error("no command given")
+    return launch(args.num_processes, args.devices_per_process, cmd)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

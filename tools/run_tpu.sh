@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# Canonical training launch — parity with the reference's run_pytorch.sh
+# (reference: src/run_pytorch.sh:1-20 — FC/MNIST, per-worker batch 4,
+# lr 0.01, momentum 0.9, cyclic code s=2, constant attack, compression on).
+# On a pod slice, run via: python tools/tpu_pod.py train --name <pod> -- "$@"
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec python -m draco_tpu.cli \
+  --approach cyclic \
+  --network FC \
+  --dataset MNIST \
+  --batch-size 4 \
+  --lr 0.01 \
+  --momentum 0.9 \
+  --num-workers 8 \
+  --worker-fail 2 \
+  --err-mode constant \
+  --eval-freq 50 \
+  --train-dir ./train_out/ \
+  "$@"

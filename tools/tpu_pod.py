@@ -1,0 +1,181 @@
+#!/usr/bin/env python
+"""TPU pod / multi-host cluster tooling — the reference's EC2 launcher,
+re-targeted at Cloud TPU.
+
+Parity with tools/pytorch_ec2.py (reference: 975 lines of boto3+paramiko:
+``launch``, ``get_hosts``, ``run_ssh_commands_parallel``, ``kill_all_python``,
+``terminate_all_instances``, NFS setup): each subcommand shells out to
+``gcloud compute tpus tpu-vm`` (the supported control plane — no raw REST),
+fans commands out to every pod worker with ``--worker=all``, and writes the
+``hosts_address`` file the reference's scripts expect. ``--dry-run`` prints
+every command instead of executing, so the control flow is testable without
+GCP credentials.
+
+Typical session:
+  python tools/tpu_pod.py launch   --name draco-pod --type v5e-16
+  python tools/tpu_pod.py hosts    --name draco-pod           # -> hosts_address
+  python tools/tpu_pod.py push     --name draco-pod --src . --dst '~/draco_tpu'
+  python tools/tpu_pod.py train    --name draco-pod -- --approach cyclic \
+      --network ResNet18 --dataset Cifar10 --num-workers 16 --worker-fail 3
+  python tools/tpu_pod.py kill     --name draco-pod
+  python tools/tpu_pod.py terminate --name draco-pod
+
+Multi-host wiring: on a TPU pod slice, JAX discovers the coordinator from the
+TPU metadata — no DRACO_* env needed (draco_tpu.runtime.init_distributed is
+a no-op and jax.distributed.initialize() auto-configures). The DRACO_* envs
+exist for CPU simulation (tools/local_cluster.py) and non-TPU fleets.
+"""
+
+from __future__ import annotations
+
+import argparse
+import shlex
+import subprocess
+import sys
+
+DEFAULTS = {
+    "zone": "us-central2-b",
+    "project": None,  # use gcloud's configured default
+    "type": "v5litepod-16",
+    "version": "tpu-ubuntu2204-base",
+}
+
+
+def _gcloud(args: argparse.Namespace, *sub: str) -> list[str]:
+    cmd = ["gcloud", "compute", "tpus", "tpu-vm", *sub, "--zone", args.zone]
+    if args.project:
+        cmd += ["--project", args.project]
+    return cmd
+
+
+def _run(args: argparse.Namespace, cmd: list[str], capture: bool = False):
+    print("+ " + " ".join(shlex.quote(c) for c in cmd), flush=True)
+    if args.dry_run:
+        return ""
+    out = subprocess.run(cmd, check=True, text=True,
+                         capture_output=capture)
+    return out.stdout if capture else ""
+
+
+def cmd_launch(args):
+    """Create the pod slice (reference: pytorch_ec2.py `launch`)."""
+    _run(args, _gcloud(args, "create", args.name) + [
+        "--accelerator-type", args.type,
+        "--version", args.version,
+        *(["--spot"] if args.spot else []),
+    ])
+
+
+def cmd_hosts(args):
+    """Write hosts_address (reference writes PS ip first; here all hosts are
+    symmetric — there is no PS rank)."""
+    out = _run(args, _gcloud(args, "describe", args.name) + [
+        "--format", "value(networkEndpoints[].ipAddress)",
+    ], capture=True)
+    hosts = [h for h in out.replace(";", "\n").split() if h]
+    if not args.dry_run:
+        with open(args.hostfile, "w") as fh:
+            fh.write("\n".join(hosts) + "\n")
+        print(f"wrote {len(hosts)} hosts to {args.hostfile}")
+
+
+def cmd_run(args):
+    """Fan a shell command out to every pod worker (reference:
+    run_ssh_commands_parallel)."""
+    _run(args, _gcloud(args, "ssh", args.name) + [
+        "--worker=all", "--command", args.command,
+    ])
+
+
+def cmd_push(args):
+    """Copy the working tree to every worker (replaces the reference's
+    NFS shared dir, pytorch_ec2.py setup_nfs)."""
+    _run(args, _gcloud(args, "scp", "--recurse", args.src,
+                       f"{args.name}:{args.dst}") + ["--worker=all"])
+
+
+def cmd_train(args):
+    """Start training on every worker; JAX auto-discovers the pod topology."""
+    train_args = " ".join(shlex.quote(a) for a in args.train_args)
+    inner = (
+        f"cd {shlex.quote(args.dst)} && "
+        f"nohup python -m draco_tpu.cli {train_args} "
+        f"> train_$(hostname).log 2>&1 &"
+    )
+    _run(args, _gcloud(args, "ssh", args.name) + [
+        "--worker=all", "--command", inner,
+    ])
+
+
+def cmd_kill(args):
+    """Stop all python on the pod (reference: kill_all_python)."""
+    _run(args, _gcloud(args, "ssh", args.name) + [
+        "--worker=all", "--command", "pkill -9 -f python || true",
+    ])
+
+
+def cmd_terminate(args):
+    """Delete the slice (reference: terminate_all_instances)."""
+    _run(args, _gcloud(args, "delete", args.name) + ["--quiet"])
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description="draco_tpu pod tooling")
+    ap.add_argument("--zone", default=DEFAULTS["zone"])
+    ap.add_argument("--project", default=DEFAULTS["project"])
+    ap.add_argument("--dry-run", action="store_true",
+                    help="print gcloud commands without executing")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("launch", help=cmd_launch.__doc__)
+    p.add_argument("--name", required=True)
+    p.add_argument("--type", default=DEFAULTS["type"])
+    p.add_argument("--version", default=DEFAULTS["version"])
+    p.add_argument("--spot", action="store_true",
+                   help="preemptible capacity (the reference used EC2 spot)")
+    p.set_defaults(fn=cmd_launch)
+
+    p = sub.add_parser("hosts", help=cmd_hosts.__doc__)
+    p.add_argument("--name", required=True)
+    p.add_argument("--hostfile", default="hosts_address")
+    p.set_defaults(fn=cmd_hosts)
+
+    p = sub.add_parser("run", help=cmd_run.__doc__)
+    p.add_argument("--name", required=True)
+    p.add_argument("--command", required=True)
+    p.set_defaults(fn=cmd_run)
+
+    p = sub.add_parser("push", help=cmd_push.__doc__)
+    p.add_argument("--name", required=True)
+    p.add_argument("--src", default=".")
+    p.add_argument("--dst", default="~/draco_tpu")
+    p.set_defaults(fn=cmd_push)
+
+    p = sub.add_parser("train", help=cmd_train.__doc__)
+    p.add_argument("--name", required=True)
+    p.add_argument("--dst", default="~/draco_tpu")
+    p.add_argument("train_args", nargs=argparse.REMAINDER,
+                   help="arguments forwarded to draco_tpu.cli (prefix with --)")
+    p.set_defaults(fn=cmd_train)
+
+    p = sub.add_parser("kill", help=cmd_kill.__doc__)
+    p.add_argument("--name", required=True)
+    p.set_defaults(fn=cmd_kill)
+
+    p = sub.add_parser("terminate", help=cmd_terminate.__doc__)
+    p.add_argument("--name", required=True)
+    p.set_defaults(fn=cmd_terminate)
+
+    args = ap.parse_args(argv)
+    if getattr(args, "train_args", None) and args.train_args[0] == "--":
+        args.train_args = args.train_args[1:]
+    try:
+        args.fn(args)
+    except subprocess.CalledProcessError as e:
+        print(f"command failed with exit {e.returncode}", file=sys.stderr)
+        return e.returncode
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
