@@ -8,37 +8,54 @@ _krum :278-296) with on-device jax implementations, so Draco's
 
 from __future__ import annotations
 
-from functools import partial
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
 
+# Every rule takes an optional ``present`` mask ((n,) bool): False rows never
+# arrived (stragglers — the reference PS would block forever on them,
+# baseline_master.py:112-116) and are excluded from the statistic while
+# keeping every shape static under jit.
 
-def mean(grads: jnp.ndarray) -> jnp.ndarray:
-    """Plain averaging (update_mode "normal")."""
-    return jnp.mean(grads, axis=0)
+
+def mean(grads: jnp.ndarray, present: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Plain averaging (update_mode "normal"), over present rows."""
+    if present is None:
+        return jnp.mean(grads, axis=0)
+    w = present.astype(grads.dtype)
+    return (w @ grads) / jnp.maximum(jnp.sum(w), 1.0)
 
 
-def geometric_median(grads: jnp.ndarray, iters: int = 80, eps: float = 1e-8) -> jnp.ndarray:
-    """Weiszfeld iteration for the geometric median of n rows.
+def geometric_median(grads: jnp.ndarray, iters: int = 80, eps: float = 1e-8,
+                     present: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Weiszfeld iteration for the geometric median of the present rows.
 
     Replaces hdmedians.geomedian (baseline_master.py:274). Fixed iteration
     count keeps the op jittable; 80 iterations drives the relative change
-    far below float32 resolution for the gradient scales involved.
+    far below float32 resolution for the gradient scales involved. Absent
+    rows get weight 0 — the Weiszfeld weights absorb the mask exactly.
     """
+    pw = None if present is None else present.astype(grads.dtype)
 
     def body(_, y):
         dist = jnp.linalg.norm(grads - y[None, :], axis=1)
         w = 1.0 / jnp.maximum(dist, eps)
-        return (w @ grads) / jnp.sum(w)
+        if pw is not None:
+            w = w * pw
+        return (w @ grads) / jnp.maximum(jnp.sum(w), 1e-30)
 
-    return jax.lax.fori_loop(0, iters, body, jnp.mean(grads, axis=0))
+    return jax.lax.fori_loop(0, iters, body, mean(grads, present))
 
 
-def krum(grads: jnp.ndarray, s: int) -> jnp.ndarray:
+def krum(grads: jnp.ndarray, s: int,
+         present: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """Krum (Blanchard et al.): select the row closest to its n-s-2 nearest
     neighbours. Mirrors baseline_master.py:278-296: score_i = sum of the
     n-s-2 smallest squared distances to the *other* rows; pick argmin.
+
+    With a present mask, absent rows are unselectable and distances to them
+    rank last (k stays n-s-2 — conservative when rows are missing).
     """
     n = grads.shape[0]
     if n < s + 3:
@@ -49,19 +66,26 @@ def krum(grads: jnp.ndarray, s: int) -> jnp.ndarray:
     gram = jnp.matmul(grads, grads.T, precision=jax.lax.Precision.HIGHEST)
     norms = jnp.diag(gram)
     sq = jnp.maximum(norms[:, None] + norms[None, :] - 2.0 * gram, 0.0)
-    sq = sq + jnp.diag(jnp.full((n,), jnp.inf, dtype=grads.dtype))
+    big = jnp.asarray(jnp.finfo(grads.dtype).max / 4, grads.dtype)
+    sq = sq + jnp.diag(jnp.full((n,), big, dtype=grads.dtype))
+    if present is not None:
+        absent = ~present
+        sq = sq + big * absent[None, :].astype(grads.dtype)
     neighbor_sorted = jnp.sort(sq, axis=1)
     scores = jnp.sum(neighbor_sorted[:, :k], axis=1)
+    if present is not None:
+        scores = jnp.where(present, scores, jnp.inf)
     return grads[jnp.argmin(scores)]
 
 
-def aggregate(grads: jnp.ndarray, mode: str, s: int = 0, geomedian_iters: int = 80) -> jnp.ndarray:
+def aggregate(grads: jnp.ndarray, mode: str, s: int = 0, geomedian_iters: int = 80,
+              present: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """Dispatch used by the baseline training step (mode parity with
     baseline_master.py:118-129)."""
     if mode == "normal":
-        return mean(grads)
+        return mean(grads, present=present)
     if mode == "geometric_median":
-        return geometric_median(grads, iters=geomedian_iters)
+        return geometric_median(grads, iters=geomedian_iters, present=present)
     if mode == "krum":
-        return krum(grads, s)
+        return krum(grads, s, present=present)
     raise ValueError(f"unknown aggregation mode: {mode}")

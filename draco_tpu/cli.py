@@ -43,6 +43,14 @@ def add_fit_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                    choices=["rev_grad", "constant", "random"])
     p.add_argument("--adversarial", type=float, default=-100.0,
                    help="attack magnitude (reference hardcoded -100)")
+    p.add_argument("--adversary-count", type=int, default=None,
+                   help="live adversaries per step (default: worker-fail); set "
+                        "lower to leave decode budget for stragglers")
+    p.add_argument("--straggle-mode", type=str, default="none",
+                   choices=["none", "drop"],
+                   help="drop: straggle-count workers miss each step's "
+                        "deadline and are decoded around as erasures")
+    p.add_argument("--straggle-count", type=int, default=0)
     p.add_argument("--redundancy", type=str, default="simulate",
                    choices=["simulate", "shared"],
                    help="simulate: r-times redundant compute like the reference; "
@@ -100,6 +108,9 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         worker_fail=args.worker_fail,
         err_mode=args.err_mode,
         adversarial=args.adversarial,
+        adversary_count=args.adversary_count,
+        straggle_mode=args.straggle_mode,
+        straggle_count=args.straggle_count,
         redundancy=args.redundancy,
         eval_freq=args.eval_freq,
         train_dir=args.train_dir,

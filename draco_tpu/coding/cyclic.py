@@ -29,6 +29,7 @@ data-dependent "err_indices" selection of the reference
 from __future__ import annotations
 
 import dataclasses
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -202,14 +203,25 @@ def _complex_solve(a_re, a_im, b_re, b_im, ridge: float = 0.0):
     return x[:m], x[m:]
 
 
-def decode(code: CyclicCode, r_re: jnp.ndarray, r_im: jnp.ndarray, rand_factor: jnp.ndarray):
+def decode(code: CyclicCode, r_re: jnp.ndarray, r_im: jnp.ndarray, rand_factor: jnp.ndarray,
+           present: Optional[jnp.ndarray] = None):
     """Recover the exact sum of the n batch gradients from corrupt rows.
 
     r_re, r_im: (n, d) received encoded rows (≤ s rows arbitrarily corrupt).
     rand_factor: (d,) random projection (reference: cyclic_master.py:58-61).
+    present: optional (n,) bool — False rows never arrived (stragglers /
+    crashed workers; they must be zero-filled by the caller). Known-missing
+    rows are *erasures*: they cost one redundancy unit instead of two, so the
+    decode is exact when either (a) no adversary is live and ≤ 2s rows are
+    missing, or (b) adversaries + missing ≤ s (the locator treats each
+    zero-filled row as one located error). No reference counterpart — the
+    reference PS simply blocks forever on a missing worker
+    (baseline_master.py:112-116).
+
     Returns (n·mean-gradient, honest_mask): the (d,) real decoded sum / n and
     the (n,) mask of rows the recombination actually used (True = treated as
-    honest; exactly n-2s rows are True, every located adversary is False).
+    honest; exactly n-2s rows are True, every located adversary and every
+    absent row is False).
     """
     n, s = code.n, code.s
     c2h_re = jnp.asarray(code.c2h_re)
@@ -265,6 +277,11 @@ def decode(code: CyclicCode, r_re: jnp.ndarray, r_im: jnp.ndarray, rand_factor: 
     #    independent) even when fewer than s rows are actually corrupt and a
     #    thresholded mask would under- or over-fill. The returned mask marks
     #    exactly the rows the recombination used.
+    if present is not None:
+        # absent rows are never eligible, whatever the locator thinks; in the
+        # erasure-only regime the locator may be overwhelmed (e > s), but any
+        # n-2s present rows are honest and exactness holds regardless of mag
+        mag = jnp.where(present, mag, -1.0)
     m = n - 2 * s
     idx = jnp.sort(jax.lax.top_k(mag, m)[1])
     honest = jnp.zeros((n,), dtype=bool).at[idx].set(True)

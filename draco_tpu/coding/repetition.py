@@ -44,13 +44,28 @@ def build_repetition_code(n: int, r: int) -> RepetitionCode:
     return RepetitionCode(n=n, r=r)
 
 
-def majority_vote(code: RepetitionCode, grads: jnp.ndarray) -> jnp.ndarray:
-    """grads: (n, d) -> (d,) mean over groups of each group's majority row."""
+def majority_vote(code: RepetitionCode, grads: jnp.ndarray,
+                  present=None) -> jnp.ndarray:
+    """grads: (n, d) -> (d,) mean over groups of each group's majority row.
+
+    ``present``: optional (n,) bool — absent members (stragglers) neither
+    vote nor can win; a group with no present member contributes nothing and
+    the group mean renormalises. (The reference PS blocks forever on a
+    missing member, rep_master.py:104-116.)
+    """
     g, r = code.num_groups, code.r
     rows = grads.reshape(g, r, -1)
     # pairwise exact-equality counts, (G, r): agree[g, i] = #{j : row_i == row_j}
     eq = jnp.all(rows[:, :, None, :] == rows[:, None, :, :], axis=-1)
-    agree = jnp.sum(eq, axis=-1)
-    winner = jnp.argmax(agree, axis=-1)  # (G,)
+    if present is None:
+        agree = jnp.sum(eq, axis=-1)
+        winner = jnp.argmax(agree, axis=-1)  # (G,)
+        picked = jnp.take_along_axis(rows, winner[:, None, None], axis=1)[:, 0, :]
+        return jnp.mean(picked, axis=0)
+    pres = present.reshape(g, r)
+    agree = jnp.sum(eq & pres[:, None, :], axis=-1)  # only present members vote
+    agree = jnp.where(pres, agree, -1)  # absent members cannot win
+    winner = jnp.argmax(agree, axis=-1)
     picked = jnp.take_along_axis(rows, winner[:, None, None], axis=1)[:, 0, :]
-    return jnp.mean(picked, axis=0)
+    group_alive = jnp.any(pres, axis=1).astype(grads.dtype)  # (G,)
+    return (group_alive @ picked) / jnp.maximum(jnp.sum(group_alive), 1.0)

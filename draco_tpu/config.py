@@ -51,6 +51,21 @@ class TrainConfig:
     err_mode: str = "rev_grad"  # rev_grad | constant | random
     adversarial: float = -100.0  # attack magnitude (model_ops/utils.py:3-4)
 
+    # --- straggler simulation (TPU-native; supersedes the reference's
+    # unreferenced tag-77 kill switch, resnet_split.py:625-737) ---
+    # "none": every gradient arrives. "drop": straggle_count workers per step
+    # miss the deadline; their rows are treated as *erasures* (known-missing):
+    # cyclic decodes around them (up to 2s erasure-only, or jointly with
+    # adversaries when straggle_count + worker_fail <= s), maj_vote votes
+    # among present members, baseline aggregates over present rows.
+    straggle_mode: str = "none"  # none | drop
+    straggle_count: int = 0
+    # Actual adversaries injected per step. None = worker_fail (reference
+    # semantics: the code parameter s doubles as the live attack count,
+    # distributed_nn.py:68). Set lower to reserve locator budget for
+    # stragglers (joint regime: adversary_count + straggle_count <= worker_fail).
+    adversary_count: Optional[int] = None
+
     # --- coded-path execution strategy (TPU-native addition) ---
     # "simulate": every worker really computes its (2s+1) redundant batches,
     #             matching the reference's r× compute cost (cyclic_worker.py:122).
@@ -100,6 +115,11 @@ class TrainConfig:
     def num_groups(self) -> int:
         return self.num_workers // self.group_size
 
+    @property
+    def num_adversaries(self) -> int:
+        """Live adversaries per step (defaults to the code parameter s)."""
+        return self.worker_fail if self.adversary_count is None else self.adversary_count
+
     def validate(self) -> "TrainConfig":
         if self.approach not in ("baseline", "maj_vote", "cyclic"):
             raise ValueError(f"unknown approach: {self.approach}")
@@ -134,6 +154,35 @@ class TrainConfig:
                 )
         if self.worker_fail > self.num_workers:
             raise ValueError("worker_fail cannot exceed num_workers")
+        if self.straggle_mode not in ("none", "drop"):
+            raise ValueError(f"unknown straggle_mode: {self.straggle_mode}")
+        if self.adversary_count is not None and self.adversary_count > self.worker_fail:
+            raise ValueError(
+                "adversary_count cannot exceed worker_fail (the code is only "
+                f"built to tolerate worker_fail={self.worker_fail})"
+            )
+        e = self.straggle_count if self.straggle_mode == "drop" else 0
+        if e > 0:
+            s, t, n = self.worker_fail, self.num_adversaries, self.num_workers
+            if self.approach == "cyclic":
+                # Erasures cost one redundancy unit, unknown errors two. The
+                # decoder covers erasure-only (t=0, e <= 2s) and the joint
+                # regime (t + e <= s), where the locator treats missing rows
+                # as one error each.
+                if not ((t == 0 and e <= 2 * s) or (t + e <= s)):
+                    raise ValueError(
+                        f"cyclic straggler budget exceeded: need "
+                        f"adversary_count + straggle_count <= worker_fail "
+                        f"({t}+{e} <= {s}), or adversary_count == 0 with "
+                        f"straggle_count <= 2*worker_fail ({e} <= {2 * s})"
+                    )
+            if self.approach == "maj_vote" and e >= self.group_size:
+                raise ValueError(
+                    f"straggle_count {e} >= group_size {self.group_size} can "
+                    "silence an entire repetition group"
+                )
+            if self.approach == "baseline" and e >= n:
+                raise ValueError("straggle_count must leave at least one worker")
         if self.network == "TransformerLM":
             if self.approach == "maj_vote":
                 raise ValueError(

@@ -33,6 +33,28 @@ def adversary_schedule(seed: int, max_steps: int, num_workers: int, num_fail: in
     return mask
 
 
+def straggler_schedule(seed: int, max_steps: int, num_workers: int,
+                       num_straggle: int) -> np.ndarray:
+    """Boolean mask (max_steps + 1, num_workers): True = worker misses the
+    step's deadline (its gradient never arrives).
+
+    The reference only sketched straggler handling (the unreferenced tag-77
+    kill switch, resnet_split.py:625-737); here missing workers are
+    first-class *erasures* — known positions, unlike Byzantine rows — and the
+    schedule is deterministic for the same every-participant-agrees reason as
+    :func:`adversary_schedule`. Salted so adversary and straggler draws are
+    independent streams.
+    """
+    mask = np.zeros((max_steps + 1, num_workers), dtype=bool)
+    if num_straggle == 0:
+        return mask
+    rng = np.random.RandomState(seed ^ 0x5A5A5A)
+    for t in range(max_steps + 1):
+        idx = rng.choice(num_workers, size=num_straggle, replace=False)
+        mask[t, idx] = True
+    return mask
+
+
 def group_seeds(seed: int, num_groups: int) -> np.ndarray:
     """Per-group shuffle seeds, identical on every participant.
 

@@ -44,6 +44,16 @@ from draco_tpu.models import build_model, input_shape
 from draco_tpu.runtime import WORKER_AXIS
 
 
+def _metrics(losses, precs, present=None):
+    """Per-worker (n,) metrics -> scalars, ignoring absent workers."""
+    if present is None:
+        return {"loss": jnp.mean(losses), "prec1": jnp.mean(precs)}
+    w = present.astype(losses.dtype)
+    denom = jnp.maximum(jnp.sum(w), 1.0)
+    return {"loss": jnp.sum(losses * w) / denom,
+            "prec1": jnp.sum(precs * w) / denom}
+
+
 class TrainState(NamedTuple):
     params: Any  # replicated pytree
     opt_state: Any  # replicated
@@ -168,7 +178,7 @@ def build_train_setup(cfg: TrainConfig, mesh, dataset_name: Optional[str] = None
         code = None
         rep_code = None
 
-        def step_body(state: TrainState, x, y, adv_mask):
+        def step_body(state: TrainState, x, y, adv_mask, present=None):
             # x, y: (n, B, ...) sharded over w; aug key per (step, worker)
             if use_aug:
                 keys = jax.vmap(
@@ -184,16 +194,17 @@ def build_train_setup(cfg: TrainConfig, mesh, dataset_name: Optional[str] = None
             grads = jax.lax.with_sharding_constraint(grads, shard_w)
             grads = attacks.inject_plain(grads, adv_mask, cfg.err_mode, adv_mag)
             agg = aggregation.aggregate(grads, cfg.mode, s=cfg.worker_fail,
-                                        geomedian_iters=cfg.geomedian_iters)
+                                        geomedian_iters=cfg.geomedian_iters,
+                                        present=present)
             new_state = apply_update(state, agg, new_stats)
-            return new_state, {"loss": jnp.mean(losses), "prec1": jnp.mean(precs)}
+            return new_state, _metrics(losses, precs, present)
 
     elif cfg.approach == "maj_vote":
         code = None
         rep_code = rep_mod.build_repetition_code(n, cfg.group_size)
         group_ids = jnp.asarray(np.arange(n) // cfg.group_size, jnp.int32)
 
-        def step_body(state: TrainState, x, y, adv_mask):
+        def step_body(state: TrainState, x, y, adv_mask, present=None):
             # group members carry identical batches (batching layer guarantees
             # it); aug + dropout keys fold the *group* id so lanes stay
             # bitwise identical within a group — the vote's soundness condition
@@ -210,9 +221,9 @@ def build_train_setup(cfg: TrainConfig, mesh, dataset_name: Optional[str] = None
             )
             grads = jax.lax.with_sharding_constraint(grads, shard_w)
             grads = attacks.inject_plain(grads, adv_mask, cfg.err_mode, adv_mag)
-            voted = rep_mod.majority_vote(rep_code, grads)
+            voted = rep_mod.majority_vote(rep_code, grads, present=present)
             new_state = apply_update(state, voted, new_stats)
-            return new_state, {"loss": jnp.mean(losses), "prec1": jnp.mean(precs)}
+            return new_state, _metrics(losses, precs, present)
 
     elif cfg.approach == "cyclic":
         code = cyclic_mod.build_cyclic_code(n, cfg.worker_fail)
@@ -284,19 +295,25 @@ def build_train_setup(cfg: TrainConfig, mesh, dataset_name: Optional[str] = None
                 )
                 return enc_re, enc_im, new_stats, jnp.mean(losses, 1), jnp.mean(precs, 1)
 
-        def step_body(state: TrainState, x, y, adv_mask):
+        def step_body(state: TrainState, x, y, adv_mask, present=None):
             enc_re, enc_im, new_stats, losses, precs = compute_encoded(state, x, y)
             enc_re, enc_im = attacks.inject_cyclic(enc_re, enc_im, adv_mask,
                                                    cfg.err_mode, adv_mag)
+            if present is not None:
+                # straggler rows never arrive: zero-fill (erasures at known
+                # positions; decode recovers exactly within the budget —
+                # config.validate)
+                pw = present[:, None].astype(enc_re.dtype)
+                enc_re = enc_re * pw
+                enc_im = enc_im * pw
             enc_re = jax.lax.with_sharding_constraint(enc_re, shard_w)
             enc_im = jax.lax.with_sharding_constraint(enc_im, shard_w)
-            decoded, honest = cyclic_mod.decode(code, enc_re, enc_im, rand_factor)
+            decoded, honest = cyclic_mod.decode(code, enc_re, enc_im, rand_factor,
+                                                present=present)
             new_state = apply_update(state, decoded, new_stats)
-            return new_state, {
-                "loss": jnp.mean(losses),
-                "prec1": jnp.mean(precs),
-                "honest_located": jnp.sum(honest.astype(jnp.int32)),
-            }
+            out = _metrics(losses, precs, present)
+            out["honest_located"] = jnp.sum(honest.astype(jnp.int32))
+            return new_state, out
 
     else:  # pragma: no cover
         raise ValueError(cfg.approach)

@@ -43,7 +43,13 @@ class Trainer:
                                    quiet=quiet or not self._is_main)
         self._shard_w = NamedSharding(self.mesh, P(WORKER_AXIS))
         self._adv_schedule = drng.adversary_schedule(
-            cfg.seed, cfg.max_steps, cfg.num_workers, cfg.worker_fail
+            cfg.seed, cfg.max_steps, cfg.num_workers, cfg.num_adversaries
+        )
+        self._straggle_schedule = (
+            drng.straggler_schedule(cfg.seed, cfg.max_steps, cfg.num_workers,
+                                    cfg.straggle_count)
+            if cfg.straggle_mode == "drop" and cfg.straggle_count > 0
+            else None
         )
         self._group_seeds = drng.group_seeds(cfg.seed, max(cfg.num_groups, 1))
         self._prefetch = BatchPrefetcher(
@@ -89,11 +95,22 @@ class Trainer:
             x, y = self._device_batch(step)
             # numpy (uncommitted) so multi-host jit treats it as replicated
             mask = np.asarray(self._adv_schedule[min(step, cfg.max_steps)])
+            present = (
+                np.asarray(~self._straggle_schedule[min(step, cfg.max_steps)])
+                if self._straggle_schedule is not None
+                else None
+            )
             seg.end()
 
             seg.begin("comp")  # fwd+bwd+encode+gather+decode+update, one program
-            self.state, metrics = self.setup.train_step(self.state, x, y, mask)
+            if present is None:
+                self.state, metrics = self.setup.train_step(self.state, x, y, mask)
+            else:
+                self.state, metrics = self.setup.train_step(self.state, x, y, mask,
+                                                            present)
             metrics = {k: float(v) for k, v in metrics.items()}
+            if present is not None:
+                metrics["present"] = float(present.sum())
             jax.block_until_ready(self.state.params)
             seg.end()
 
