@@ -71,6 +71,9 @@ def add_fit_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     p.add_argument("--model-layers", type=int, default=2)
     p.add_argument("--cpu-mesh", type=int, default=0, metavar="N",
                    help="force an N-device virtual CPU mesh (testing without TPUs)")
+    p.add_argument("--profile-dir", type=str, default="",
+                   help="capture a jax.profiler trace of a few steps into "
+                        "this directory (SURVEY.md §5.1)")
     return p
 
 
@@ -146,7 +149,7 @@ def main(argv=None):
         _, last = train_sp(cfg, mesh)
         return last
     trainer = Trainer(cfg)
-    last = trainer.run()
+    last = trainer.run(profile_dir=args.profile_dir or None)
     return last
 
 

@@ -85,11 +85,21 @@ class Trainer:
         )
 
     # ---- train -----------------------------------------------------------
-    def run(self, max_steps: Optional[int] = None) -> dict:
+    def run(self, max_steps: Optional[int] = None,
+            profile_dir: Optional[str] = None,
+            profile_steps: tuple = (3, 8)) -> dict:
+        """Train. ``profile_dir`` captures a jax.profiler trace of steps
+        [profile_steps) — the structured replacement for the reference's
+        printed per-phase timers (SURVEY.md §5.1); the t_fetch/t_comp segment
+        metrics keep the reference's names either way."""
         cfg = self.cfg
         last = {}
         n_steps = max_steps if max_steps is not None else cfg.max_steps
         for step in range(self._start_step, n_steps + 1):
+            if profile_dir and step == profile_steps[0] and self._is_main:
+                jax.profiler.start_trace(profile_dir)
+            if profile_dir and step == profile_steps[1] and self._is_main:
+                jax.profiler.stop_trace()
             seg = Segments()
             seg.begin("fetch")
             x, y = self._device_batch(step)

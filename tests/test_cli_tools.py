@@ -1,0 +1,123 @@
+"""CLI surface, evaluator process, single-machine path, cluster tooling.
+
+Covers the reference's L6/L7 layers (SURVEY.md §1): distributed_nn.py flag
+surface, distributed_evaluator.py's checkpoint-polling loop,
+single_machine.py, and tools/pytorch_ec2.py's command structure (ours:
+tools/tpu_pod.py in --dry-run mode — control flow without GCP credentials).
+"""
+
+import os
+import re
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_single_machine_smoke(tmp_path):
+    from draco_tpu import single_machine
+
+    last = single_machine.main([
+        "--network", "FC", "--dataset", "synthetic-mnist",
+        "--batch-size", "16", "--max-steps", "15",
+        "--eval-freq", "0", "--train-dir", "", "--log-every", "1000",
+    ])
+    assert np.isfinite(last["loss"])
+
+
+def test_evaluator_reads_checkpoints(tmp_path):
+    """Train with checkpointing, then run the evaluator once over train_dir —
+    the reference's NFS-polling evaluate path (distributed_evaluator.py:75-90)."""
+    from draco_tpu.config import TrainConfig
+    from draco_tpu.data.datasets import load_dataset
+    from draco_tpu.runtime import make_mesh
+    from draco_tpu.training import evaluator
+    from draco_tpu.training.trainer import Trainer
+
+    d = str(tmp_path / "run")
+    ds = load_dataset("synthetic-mnist", synthetic_train=128, synthetic_test=64)
+    cfg = TrainConfig(network="FC", dataset="synthetic-mnist", batch_size=4,
+                      num_workers=4, approach="baseline", max_steps=4,
+                      eval_freq=2, train_dir=d, log_every=1000,
+                      test_batch_size=64)
+    tr = Trainer(cfg, mesh=make_mesh(4), dataset=ds, quiet=True)
+    tr.run()
+    tr.close()
+
+    from draco_tpu.utils import checkpoint as ckpt
+    assert ckpt.available_steps(d) == [2, 4]
+
+    out = []
+    import contextlib, io
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        evaluator.main([
+            "--network", "FC", "--dataset", "synthetic-mnist",
+            "--num-workers", "4", "--train-dir", d,
+            "--test-batch-size", "64", "--once",
+        ])
+    out = buf.getvalue()
+    # one line per checkpoint with top-1/top-5 (reference print format)
+    steps = re.findall(r"Cur Step:(\d+)", out)
+    assert steps == ["2", "4"]
+    assert all(0.0 <= float(p) <= 1.0 for p in re.findall(r"Prec@1: ([0-9.]+)", out))
+
+
+def test_tpu_pod_dry_run_command_structure():
+    def run(*args):
+        p = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "tpu_pod.py"),
+             "--dry-run", *args],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert p.returncode == 0, p.stderr
+        return p.stdout
+
+    out = run("launch", "--name", "pod1", "--type", "v5litepod-16", "--spot")
+    assert "gcloud compute tpus tpu-vm create pod1" in out and "--spot" in out
+
+    out = run("train", "--name", "pod1", "--", "--approach", "cyclic",
+              "--num-workers", "16")
+    assert "--worker=all" in out and "draco_tpu.cli" in out and "cyclic" in out
+
+    out = run("kill", "--name", "pod1")
+    assert "pkill" in out
+
+    out = run("terminate", "--name", "pod1")
+    assert "delete pod1" in out
+
+
+def test_cli_rejects_bad_flag_combination():
+    from draco_tpu import cli
+
+    with pytest.raises(ValueError, match="straggler budget"):
+        cfg = cli.config_from_args(
+            cli.add_fit_args(__import__("argparse").ArgumentParser()).parse_args([
+                "--approach", "cyclic", "--num-workers", "9",
+                "--worker-fail", "2", "--straggle-mode", "drop",
+                "--straggle-count", "5",
+            ])
+        )
+
+
+def test_profile_flag_writes_trace(tmp_path):
+    from draco_tpu.config import TrainConfig
+    from draco_tpu.data.datasets import load_dataset
+    from draco_tpu.runtime import make_mesh
+    from draco_tpu.training.trainer import Trainer
+
+    ds = load_dataset("synthetic-mnist", synthetic_train=64, synthetic_test=16)
+    cfg = TrainConfig(network="FC", dataset="synthetic-mnist", batch_size=4,
+                      num_workers=4, approach="baseline", max_steps=6,
+                      eval_freq=0, train_dir="", log_every=1000)
+    tr = Trainer(cfg, mesh=make_mesh(4), dataset=ds, quiet=True)
+    prof = str(tmp_path / "trace")
+    tr.run(profile_dir=prof, profile_steps=(2, 4))
+    tr.close()
+    found = []
+    for root, _, files in os.walk(prof):
+        found.extend(f for f in files if f.endswith((".pb", ".json.gz", ".trace.json.gz")))
+    assert found, f"no profiler artifacts under {prof}"
