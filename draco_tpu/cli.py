@@ -71,6 +71,10 @@ def add_fit_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     p.add_argument("--model-layers", type=int, default=2)
     p.add_argument("--cpu-mesh", type=int, default=0, metavar="N",
                    help="force an N-device virtual CPU mesh (testing without TPUs)")
+    p.add_argument("--compute-dtype", type=str, default="float32",
+                   choices=["float32", "bfloat16"],
+                   help="forward/backward dtype; bfloat16 runs the MXU at "
+                        "full rate (params/BN stats/logits stay float32)")
     p.add_argument("--profile-dir", type=str, default="",
                    help="capture a jax.profiler trace of a few steps into "
                         "this directory (SURVEY.md §5.1)")
@@ -115,6 +119,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         straggle_mode=args.straggle_mode,
         straggle_count=args.straggle_count,
         redundancy=args.redundancy,
+        compute_dtype=args.compute_dtype,
         eval_freq=args.eval_freq,
         train_dir=args.train_dir,
         checkpoint_step=args.checkpoint_step,

@@ -154,6 +154,8 @@ class TrainConfig:
                 )
         if self.worker_fail > self.num_workers:
             raise ValueError("worker_fail cannot exceed num_workers")
+        if self.compute_dtype not in ("float32", "bfloat16"):
+            raise ValueError(f"compute_dtype must be float32|bfloat16, got {self.compute_dtype}")
         if self.straggle_mode not in ("none", "drop"):
             raise ValueError(f"unknown straggle_mode: {self.straggle_mode}")
         if self.adversary_count is not None and self.adversary_count > self.worker_fail:

@@ -49,9 +49,11 @@ _REGISTRY = {
 }
 
 
-def build_model(name: str, num_classes: int = 10):
+def build_model(name: str, num_classes: int = 10, dtype=None):
     """Name-based model construction (reference: build_model switches in
-    baseline_master.py:30-47 / baseline_worker.py:37-50)."""
+    baseline_master.py:30-47 / baseline_worker.py:37-50). ``dtype``: compute
+    dtype for the conv/dense stacks ("bfloat16" rides the MXU at full rate;
+    params, BN stats and logits stay float32)."""
     if name == "TransformerLM":
         raise ValueError(
             "TransformerLM is a token model and does not run on the image "
@@ -61,7 +63,12 @@ def build_model(name: str, num_classes: int = 10):
         )
     if name not in _REGISTRY:
         raise ValueError(f"unknown network: {name} (have {sorted(_REGISTRY)})")
-    return _REGISTRY[name](num_classes=num_classes)
+    kwargs = {"num_classes": num_classes}
+    if dtype is not None:
+        import jax.numpy as jnp
+
+        kwargs["dtype"] = jnp.dtype(dtype)
+    return _REGISTRY[name](**kwargs)
 
 
 def input_shape(dataset: str):

@@ -6,19 +6,23 @@ run_pytorch.sh config trains exactly this model)."""
 
 from __future__ import annotations
 
+from typing import Any
+
 import flax.linen as nn
+import jax.numpy as jnp
 
 
 class FC_NN(nn.Module):
     num_classes: int = 10
+    dtype: Any = jnp.float32  # MXU compute dtype; params stay float32
 
     @nn.compact
     def __call__(self, x, train: bool = True):
-        x = x.reshape((x.shape[0], -1))
-        x = nn.Dense(800)(x)
+        x = x.reshape((x.shape[0], -1)).astype(self.dtype)
+        x = nn.Dense(800, dtype=self.dtype)(x)
         x = nn.relu(x)
-        x = nn.Dense(500)(x)
+        x = nn.Dense(500, dtype=self.dtype)(x)
         x = nn.relu(x)
-        x = nn.Dense(self.num_classes)(x)
+        x = nn.Dense(self.num_classes)(x.astype(jnp.float32))
         x = nn.sigmoid(x)
         return x

@@ -6,22 +6,26 @@ kept as-is."""
 
 from __future__ import annotations
 
+from typing import Any
+
 import flax.linen as nn
 import jax.numpy as jnp
 
 
 class LeNet(nn.Module):
     num_classes: int = 10
+    dtype: Any = jnp.float32  # MXU compute dtype; params stay float32
 
     @nn.compact
     def __call__(self, x, train: bool = True):
-        x = nn.Conv(20, (5, 5), padding="VALID")(x)
+        x = x.astype(self.dtype)
+        x = nn.Conv(20, (5, 5), padding="VALID", dtype=self.dtype)(x)
         x = nn.max_pool(x, (2, 2), strides=(2, 2))
         x = nn.relu(x)
-        x = nn.Conv(50, (5, 5), padding="VALID")(x)
+        x = nn.Conv(50, (5, 5), padding="VALID", dtype=self.dtype)(x)
         x = nn.max_pool(x, (2, 2), strides=(2, 2))
         x = nn.relu(x)
         x = x.reshape((x.shape[0], -1))  # (B, 4*4*50)
-        x = nn.Dense(500)(x)
-        x = nn.Dense(self.num_classes)(x)
+        x = nn.Dense(500, dtype=self.dtype)(x)
+        x = nn.Dense(self.num_classes)(x.astype(jnp.float32))
         return x

@@ -15,7 +15,7 @@ vote and the cyclic decode rely on.
 
 from __future__ import annotations
 
-from typing import Callable, Sequence
+from typing import Any, Callable, Sequence
 
 import flax.linen as nn
 import jax.numpy as jnp
@@ -24,19 +24,21 @@ import jax.numpy as jnp
 class BasicBlock(nn.Module):
     planes: int
     stride: int = 1
+    dtype: Any = jnp.float32  # MXU compute dtype; params/stats stay float32
 
     @nn.compact
     def __call__(self, x, train: bool = True):
-        norm = lambda: nn.BatchNorm(use_running_average=not train, momentum=0.9)
+        norm = lambda: nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                                    dtype=self.dtype)
+        conv = lambda *a, **k: nn.Conv(*a, use_bias=False, dtype=self.dtype, **k)
         in_planes = x.shape[-1]
-        out = nn.Conv(self.planes, (3, 3), strides=(self.stride, self.stride),
-                      padding=((1, 1), (1, 1)), use_bias=False)(x)
+        out = conv(self.planes, (3, 3), strides=(self.stride, self.stride),
+                   padding=((1, 1), (1, 1)))(x)
         out = nn.relu(norm()(out))
-        out = nn.Conv(self.planes, (3, 3), padding=((1, 1), (1, 1)), use_bias=False)(out)
+        out = conv(self.planes, (3, 3), padding=((1, 1), (1, 1)))(out)
         out = norm()(out)
         if self.stride != 1 or in_planes != self.planes:
-            x = nn.Conv(self.planes, (1, 1), strides=(self.stride, self.stride),
-                        use_bias=False)(x)
+            x = conv(self.planes, (1, 1), strides=(self.stride, self.stride))(x)
             x = norm()(x)
         return nn.relu(out + x)
 
@@ -45,21 +47,24 @@ class Bottleneck(nn.Module):
     planes: int
     stride: int = 1
     expansion: int = 4
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = True):
-        norm = lambda: nn.BatchNorm(use_running_average=not train, momentum=0.9)
+        norm = lambda: nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                                    dtype=self.dtype)
+        conv = lambda *a, **k: nn.Conv(*a, use_bias=False, dtype=self.dtype, **k)
         in_planes = x.shape[-1]
         wide = self.planes * self.expansion
-        out = nn.Conv(self.planes, (1, 1), use_bias=False)(x)
+        out = conv(self.planes, (1, 1))(x)
         out = nn.relu(norm()(out))
-        out = nn.Conv(self.planes, (3, 3), strides=(self.stride, self.stride),
-                      padding=((1, 1), (1, 1)), use_bias=False)(out)
+        out = conv(self.planes, (3, 3), strides=(self.stride, self.stride),
+                   padding=((1, 1), (1, 1)))(out)
         out = nn.relu(norm()(out))
-        out = nn.Conv(wide, (1, 1), use_bias=False)(out)
+        out = conv(wide, (1, 1))(out)
         out = norm()(out)
         if self.stride != 1 or in_planes != wide:
-            x = nn.Conv(wide, (1, 1), strides=(self.stride, self.stride), use_bias=False)(x)
+            x = conv(wide, (1, 1), strides=(self.stride, self.stride))(x)
             x = norm()(x)
         return nn.relu(out + x)
 
@@ -68,35 +73,40 @@ class ResNet(nn.Module):
     block: Callable
     num_blocks: Sequence[int]
     num_classes: int = 10
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = True):
-        x = nn.Conv(64, (3, 3), padding=((1, 1), (1, 1)), use_bias=False)(x)
-        x = nn.relu(nn.BatchNorm(use_running_average=not train, momentum=0.9)(x))
+        x = x.astype(self.dtype)
+        x = nn.Conv(64, (3, 3), padding=((1, 1), (1, 1)), use_bias=False,
+                    dtype=self.dtype)(x)
+        x = nn.relu(nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                                 dtype=self.dtype)(x))
         for stage, (planes, blocks) in enumerate(zip((64, 128, 256, 512), self.num_blocks)):
             for b in range(blocks):
                 stride = 2 if (stage > 0 and b == 0) else 1
-                x = self.block(planes, stride)(x, train=train)
+                x = self.block(planes, stride, dtype=self.dtype)(x, train=train)
         x = nn.avg_pool(x, (4, 4), strides=(4, 4))
         x = x.reshape((x.shape[0], -1))
-        return nn.Dense(self.num_classes)(x)
+        # classifier + logits in float32 (loss numerics)
+        return nn.Dense(self.num_classes)(x.astype(jnp.float32))
 
 
-def ResNet18(num_classes: int = 10):
-    return ResNet(BasicBlock, (2, 2, 2, 2), num_classes)
+def ResNet18(num_classes: int = 10, dtype: Any = jnp.float32):
+    return ResNet(BasicBlock, (2, 2, 2, 2), num_classes, dtype)
 
 
-def ResNet34(num_classes: int = 10):
-    return ResNet(BasicBlock, (3, 4, 6, 3), num_classes)
+def ResNet34(num_classes: int = 10, dtype: Any = jnp.float32):
+    return ResNet(BasicBlock, (3, 4, 6, 3), num_classes, dtype)
 
 
-def ResNet50(num_classes: int = 10):
-    return ResNet(Bottleneck, (3, 4, 6, 3), num_classes)
+def ResNet50(num_classes: int = 10, dtype: Any = jnp.float32):
+    return ResNet(Bottleneck, (3, 4, 6, 3), num_classes, dtype)
 
 
-def ResNet101(num_classes: int = 10):
-    return ResNet(Bottleneck, (3, 4, 23, 3), num_classes)
+def ResNet101(num_classes: int = 10, dtype: Any = jnp.float32):
+    return ResNet(Bottleneck, (3, 4, 23, 3), num_classes, dtype)
 
 
-def ResNet152(num_classes: int = 10):
-    return ResNet(Bottleneck, (3, 8, 36, 3), num_classes)
+def ResNet152(num_classes: int = 10, dtype: Any = jnp.float32):
+    return ResNet(Bottleneck, (3, 8, 36, 3), num_classes, dtype)

@@ -14,9 +14,10 @@ the same mask and both codes stay exactly decodable.
 
 from __future__ import annotations
 
-from typing import Sequence, Union
+from typing import Any, Sequence, Union
 
 import flax.linen as nn
+import jax.numpy as jnp
 
 _CFG = {
     "A": (64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"),
@@ -32,52 +33,57 @@ class VGG(nn.Module):
     cfg: Sequence[Union[int, str]]
     batch_norm: bool = False
     num_classes: int = 10
+    dtype: Any = jnp.float32  # MXU compute dtype; params/stats stay float32
 
     @nn.compact
     def __call__(self, x, train: bool = True):
+        x = x.astype(self.dtype)
         for v in self.cfg:
             if v == "M":
                 x = nn.max_pool(x, (2, 2), strides=(2, 2))
             else:
-                x = nn.Conv(int(v), (3, 3), padding=((1, 1), (1, 1)))(x)
+                x = nn.Conv(int(v), (3, 3), padding=((1, 1), (1, 1)),
+                            dtype=self.dtype)(x)
                 if self.batch_norm:
-                    x = nn.BatchNorm(use_running_average=not train, momentum=0.9)(x)
+                    x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                                     dtype=self.dtype)(x)
                 x = nn.relu(x)
         x = x.reshape((x.shape[0], -1))  # (B, 512)
         x = nn.Dropout(0.5, deterministic=not train)(x)
-        x = nn.relu(nn.Dense(512)(x))
+        x = nn.relu(nn.Dense(512, dtype=self.dtype)(x))
         x = nn.Dropout(0.5, deterministic=not train)(x)
-        x = nn.relu(nn.Dense(512)(x))
-        return nn.Dense(self.num_classes)(x)
+        x = nn.relu(nn.Dense(512, dtype=self.dtype)(x))
+        # logits in float32 (loss numerics)
+        return nn.Dense(self.num_classes)(x.astype(jnp.float32))
 
 
-def VGG11(num_classes: int = 10):
-    return VGG(_CFG["A"], False, num_classes)
+def VGG11(num_classes: int = 10, dtype: Any = jnp.float32):
+    return VGG(_CFG["A"], False, num_classes, dtype)
 
 
-def VGG11_bn(num_classes: int = 10):
-    return VGG(_CFG["A"], True, num_classes)
+def VGG11_bn(num_classes: int = 10, dtype: Any = jnp.float32):
+    return VGG(_CFG["A"], True, num_classes, dtype)
 
 
-def VGG13(num_classes: int = 10):
-    return VGG(_CFG["B"], False, num_classes)
+def VGG13(num_classes: int = 10, dtype: Any = jnp.float32):
+    return VGG(_CFG["B"], False, num_classes, dtype)
 
 
-def VGG13_bn(num_classes: int = 10):
-    return VGG(_CFG["B"], True, num_classes)
+def VGG13_bn(num_classes: int = 10, dtype: Any = jnp.float32):
+    return VGG(_CFG["B"], True, num_classes, dtype)
 
 
-def VGG16(num_classes: int = 10):
-    return VGG(_CFG["D"], False, num_classes)
+def VGG16(num_classes: int = 10, dtype: Any = jnp.float32):
+    return VGG(_CFG["D"], False, num_classes, dtype)
 
 
-def VGG16_bn(num_classes: int = 10):
-    return VGG(_CFG["D"], True, num_classes)
+def VGG16_bn(num_classes: int = 10, dtype: Any = jnp.float32):
+    return VGG(_CFG["D"], True, num_classes, dtype)
 
 
-def VGG19(num_classes: int = 10):
-    return VGG(_CFG["E"], False, num_classes)
+def VGG19(num_classes: int = 10, dtype: Any = jnp.float32):
+    return VGG(_CFG["E"], False, num_classes, dtype)
 
 
-def VGG19_bn(num_classes: int = 10):
-    return VGG(_CFG["E"], True, num_classes)
+def VGG19_bn(num_classes: int = 10, dtype: Any = jnp.float32):
+    return VGG(_CFG["E"], True, num_classes, dtype)

@@ -103,7 +103,7 @@ def build_train_setup(cfg: TrainConfig, mesh, dataset_name: Optional[str] = None
     cfg.validate()
     n = cfg.num_workers
     shape = input_shape(dataset_name or cfg.dataset)
-    model = build_model(cfg.network)
+    model = build_model(cfg.network, dtype=cfg.compute_dtype)
     use_aug = "cifar" in (dataset_name or cfg.dataset).lower()
 
     root = jax.random.key(cfg.seed)
