@@ -40,16 +40,20 @@ class Block(nn.Module):
     heads: int
     mlp_ratio: int = 4
     attn_fn: Optional[AttnFn] = None
+    dtype: Any = jnp.float32  # MXU compute dtype; params stay float32
 
     @nn.compact
     def __call__(self, x, positions, train: bool):
         b, t, _ = x.shape
         dh = self.dim // self.heads
-        h = nn.LayerNorm(use_bias=False)(x)
-        qkv = nn.Dense(3 * self.dim, use_bias=False, name="qkv")(h)
+        h = nn.LayerNorm(use_bias=False, dtype=self.dtype)(x)
+        qkv = nn.Dense(3 * self.dim, use_bias=False, name="qkv", dtype=self.dtype)(h)
         q, k, v = jnp.split(qkv.reshape(b, t, 3 * self.heads, dh), 3, axis=2)
-        q = rope(q, positions)
-        k = rope(k, positions)
+        # attention math (rope, softmax accumulators) in float32 for the
+        # ring's log-sum-exp stability; projections back in compute dtype
+        q = rope(q.astype(jnp.float32), positions)
+        k = rope(k.astype(jnp.float32), positions)
+        v = v.astype(jnp.float32)
         attn = self.attn_fn
         if attn is None:
             from draco_tpu.parallel.ring_attention import dense_attention
@@ -57,11 +61,11 @@ class Block(nn.Module):
             off = positions[0]
             attn = lambda q, k, v: dense_attention(q, k, v, q_offset=off, k_offset=off)
         o = attn(q, k, v).reshape(b, t, self.dim)
-        x = x + nn.Dense(self.dim, use_bias=False, name="proj")(o)
-        h = nn.LayerNorm(use_bias=False)(x)
-        h = nn.Dense(self.mlp_ratio * self.dim, name="mlp_in")(h)
+        x = x + nn.Dense(self.dim, use_bias=False, name="proj", dtype=self.dtype)(o)
+        h = nn.LayerNorm(use_bias=False, dtype=self.dtype)(x)
+        h = nn.Dense(self.mlp_ratio * self.dim, name="mlp_in", dtype=self.dtype)(h)
         h = nn.gelu(h)
-        x = x + nn.Dense(self.dim, name="mlp_out")(h)
+        x = x + nn.Dense(self.dim, name="mlp_out", dtype=self.dtype)(h)
         return x
 
 
@@ -77,15 +81,16 @@ class TransformerLM(nn.Module):
     heads: int = 4
     layers: int = 2
     attn_fn: Optional[AttnFn] = None
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, tokens, pos_offset=0, train: bool = True):
         emb = nn.Embed(self.vocab, self.dim, name="embed")
-        x = emb(tokens)
+        x = emb(tokens).astype(self.dtype)
         positions = pos_offset + jnp.arange(tokens.shape[1])
         for i in range(self.layers):
-            x = Block(self.dim, self.heads, attn_fn=self.attn_fn, name=f"block{i}")(
-                x, positions, train
-            )
+            x = Block(self.dim, self.heads, attn_fn=self.attn_fn,
+                      dtype=self.dtype, name=f"block{i}")(x, positions, train)
         x = nn.LayerNorm(use_bias=False, name="final_ln")(x)
-        return emb.attend(x)
+        # logits in float32 (loss numerics)
+        return emb.attend(x.astype(jnp.float32))

@@ -68,14 +68,15 @@ def build_sp_train_setup(cfg: TrainConfig, mesh) -> SPTrainSetup:
     t_local = cfg.seq_len // sp
 
     attn = functools.partial(ring_attention, axis_name=SEQ_AXIS if sp > 1 else None)
+    cdtype = jnp.dtype(cfg.compute_dtype)
     model = TransformerLM(
         vocab=cfg.vocab, dim=cfg.model_dim, heads=cfg.model_heads,
-        layers=cfg.model_layers, attn_fn=attn,
+        layers=cfg.model_layers, attn_fn=attn, dtype=cdtype,
     )
     # init single-shard (dense attention) — parameter shapes are identical
     init_model = TransformerLM(
         vocab=cfg.vocab, dim=cfg.model_dim, heads=cfg.model_heads,
-        layers=cfg.model_layers, attn_fn=None,
+        layers=cfg.model_layers, attn_fn=None, dtype=cdtype,
     )
     root = jax.random.key(cfg.seed)
     init_toks = jnp.zeros((1, min(cfg.seq_len, 8)), jnp.int32)
