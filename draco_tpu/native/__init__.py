@@ -85,6 +85,11 @@ def _load():
     lib.draco_cyclic_decode.argtypes = [
         c.c_int, c.c_int, c.c_longlong, f32p, f32p, f64p, f32p, i32p, c.c_int,
     ]
+    lib.draco_cyclic_decode_present.restype = c.c_int
+    lib.draco_cyclic_decode_present.argtypes = [
+        c.c_int, c.c_int, c.c_longlong, f32p, f32p, f64p, i32p, f32p, i32p,
+        c.c_int,
+    ]
 
     lib.draco_compress_bound.restype = c.c_longlong
     lib.draco_compress_bound.argtypes = [c.c_longlong]
@@ -143,10 +148,13 @@ def solve_poly_a(n: int, s: int, e: np.ndarray) -> np.ndarray:
 
 
 def cyclic_decode_host(n: int, s: int, r: np.ndarray,
-                       rand_factor: np.ndarray, num_threads: int = 0):
+                       rand_factor: np.ndarray, num_threads: int = 0,
+                       present: np.ndarray | None = None):
     """Full native decode of received rows r ((n, d) complex) — returns
     (mean_gradient (d,) float32, honest_mask (n,) bool). Host-side oracle /
-    fallback for draco_tpu.coding.cyclic.decode."""
+    fallback for draco_tpu.coding.cyclic.decode. ``present``: optional (n,)
+    bool erasure mask (False rows known-missing, zero-filled), same budget as
+    the jit decode."""
     if not AVAILABLE:
         raise RuntimeError(f"native library unavailable: {BUILD_ERROR}")
     r = np.asarray(r)
@@ -156,9 +164,13 @@ def cyclic_decode_host(n: int, s: int, r: np.ndarray,
     f = np.ascontiguousarray(rand_factor, dtype=np.float64)
     out = np.zeros(d, np.float32)
     honest = np.zeros(n, np.int32)
-    rc = _lib.draco_cyclic_decode(
+    pres_ptr = None
+    if present is not None:
+        pres = np.ascontiguousarray(present, dtype=np.int32)
+        pres_ptr = _ptr(pres, ctypes.c_int32)
+    rc = _lib.draco_cyclic_decode_present(
         n, s, d, _ptr(r_re, ctypes.c_float), _ptr(r_im, ctypes.c_float),
-        _ptr(f, ctypes.c_double), _ptr(out, ctypes.c_float),
+        _ptr(f, ctypes.c_double), pres_ptr, _ptr(out, ctypes.c_float),
         _ptr(honest, ctypes.c_int32), num_threads,
     )
     if rc != 0:

@@ -138,13 +138,18 @@ int draco_solve_poly_a(int n, int s, const double* e_re, const double* e_im,
 // fixed-shape jnp decode in draco_tpu/coding/cyclic.py):
 //   r_re/r_im: (n, d) row-major received rows, <= s arbitrarily corrupt.
 //   rand_factor: (d,) projection.
+//   present: optional (n,) 0/1 — 0 rows are erasures (known-missing,
+//     zero-filled by the caller); pass null for all-present. Same budget as
+//     the jit decode: erasure-only e <= 2s, or errors + erasures <= s.
 //   out: (d,) = Re(v^T R) / n, i.e. the mean of the n batch gradients.
-//   honest_out: (n,) 0/1 located-honest mask (may be null).
+//   honest_out: (n,) 0/1 mask of rows the recombination used (may be null).
 // Returns 0 on success.
-int draco_cyclic_decode(int n, int s, long long d,
-                        const float* r_re, const float* r_im,
-                        const double* rand_factor,
-                        float* out, int32_t* honest_out, int num_threads) {
+int draco_cyclic_decode_present(int n, int s, long long d,
+                                const float* r_re, const float* r_im,
+                                const double* rand_factor,
+                                const int32_t* present,
+                                float* out, int32_t* honest_out,
+                                int num_threads) {
   if (n <= 4 * s || s < 0 || d <= 0) return 1;
   int m = n - 2 * s;
   if (num_threads < 1) num_threads = (int)std::thread::hardware_concurrency();
@@ -198,7 +203,10 @@ int draco_cyclic_decode(int n, int s, long long d,
   //    rows are locator roots, so they rank in the bottom s; top-m selection
   //    stays full-rank even under fewer-than-s actual corruptions — same
   //    policy as the jit decode), solve C1[idx]^T v = e1. honest_out marks
-  //    exactly the rows used.
+  //    exactly the rows used. Absent rows are never eligible.
+  if (present)
+    for (int i = 0; i < n; ++i)
+      if (!present[i]) mag[i] = -1.0;
   std::vector<int> order(n);
   for (int i = 0; i < n; ++i) order[i] = i;
   std::stable_sort(order.begin(), order.end(),
@@ -238,6 +246,15 @@ int draco_cyclic_decode(int n, int s, long long d,
     for (auto& th : ts) th.join();
   }
   return 0;
+}
+
+// Back-compat entry without erasure support.
+int draco_cyclic_decode(int n, int s, long long d,
+                        const float* r_re, const float* r_im,
+                        const double* rand_factor,
+                        float* out, int32_t* honest_out, int num_threads) {
+  return draco_cyclic_decode_present(n, s, d, r_re, r_im, rand_factor, nullptr,
+                                     out, honest_out, num_threads);
 }
 
 }  // extern "C"

@@ -157,3 +157,36 @@ def test_prefetcher_matches_sync_batches():
             assert np.array_equal(x, xr) and np.array_equal(y, yr)
     finally:
         pf.close()
+
+
+@needs_native
+@pytest.mark.parametrize("n,s,adv,missing", [
+    (9, 2, (), (1, 5, 7)),     # erasure-only, e <= 2s
+    (9, 2, (3,), (7,)),        # joint t + e <= s
+])
+def test_native_erasure_decode_matches_jnp(n, s, adv, missing):
+    from draco_tpu.attacks import inject_cyclic
+
+    rng = np.random.default_rng(9)
+    d = 2000
+    code = build_cyclic_code(n, s)
+    g = rng.normal(size=(n, d)).astype(np.float32)
+    from draco_tpu.coding.cyclic import encode
+    enc_re, enc_im = encode(code, jnp.asarray(g[code.batch_ids]))
+    adv_mask = np.zeros(n, dtype=bool); adv_mask[list(adv)] = True
+    enc_re, enc_im = inject_cyclic(enc_re, enc_im, jnp.asarray(adv_mask), "rev_grad")
+    present = np.ones(n, dtype=bool); present[list(missing)] = False
+    R = (np.asarray(enc_re) + 1j * np.asarray(enc_im)) * present[:, None]
+    f = rng.normal(size=d)
+
+    out_c, used_c = native.cyclic_decode_host(n, s, R, f, present=present)
+    out_j, used_j = decode(
+        code,
+        jnp.asarray(R.real, jnp.float32), jnp.asarray(R.imag, jnp.float32),
+        jnp.asarray(f, jnp.float32), present=jnp.asarray(present),
+    )
+    truth = g.sum(0) / n
+    np.testing.assert_allclose(out_c, truth, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(out_j), truth, atol=1e-4)
+    for r in (*adv, *missing):
+        assert not used_c[r] and not np.asarray(used_j)[r]
