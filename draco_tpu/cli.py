@@ -136,6 +136,9 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
 
 def main(argv=None):
     parser = add_fit_args(argparse.ArgumentParser(description="draco_tpu trainer"))
+    parser.add_argument("--preset", type=str, default="",
+                        help="named BASELINE.json config (draco_tpu.presets); "
+                             "other flags still override max-steps/eval/etc.")
     args = parser.parse_args(argv)
 
     maybe_force_cpu_mesh(args)
@@ -144,7 +147,17 @@ def main(argv=None):
     from draco_tpu.training.trainer import Trainer
 
     init_distributed()
-    cfg = config_from_args(args)
+    if args.preset:
+        from draco_tpu.presets import get_preset
+
+        cfg = get_preset(
+            args.preset, max_steps=args.max_steps, eval_freq=args.eval_freq,
+            train_dir=args.train_dir, checkpoint_step=args.checkpoint_step,
+            log_every=args.log_every, compute_dtype=args.compute_dtype,
+            data_dir=args.data_dir,
+        )
+    else:
+        cfg = config_from_args(args)
     if cfg.network == "TransformerLM":
         # long-context path: 2-D (w × sp) mesh, ring attention, coded DP on w
         from draco_tpu.parallel import make_mesh_2d

@@ -33,7 +33,15 @@ TILE_D = 4096
 
 
 def use_pallas() -> bool:
-    return jax.default_backend() == "tpu"
+    if jax.default_backend() == "tpu":
+        return True
+    # TPU chips reached through plugin backends (e.g. the dev tunnel) report
+    # a non-"tpu" platform name but a TPU device kind
+    try:
+        kind = jax.devices()[0].device_kind or ""
+    except Exception:
+        return False
+    return "tpu" in kind.lower()
 
 
 def _pad_d(x: jnp.ndarray, tile: int) -> jnp.ndarray:

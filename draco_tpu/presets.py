@@ -1,0 +1,59 @@
+"""The five benchmark configurations of BASELINE.json, as named presets.
+
+These are the runs the reference pins down (BASELINE.md: run_pytorch.sh's
+canonical cyclic config plus the paper's ResNet/VGG robustness grids); the
+capability-parity checklist (SURVEY.md §7.5) requires each to run end-to-end.
+
+  python -m draco_tpu.cli --preset cyclic-resnet18 --max-steps 2000
+  python tools/run_baselines.py --smoke     # all five, short, any hardware
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from draco_tpu.config import TrainConfig
+
+PRESETS: dict[str, TrainConfig] = {
+    # 1. LeNet/MNIST single-machine vanilla SGD (no coding, no adversary)
+    "single-lenet": TrainConfig(
+        network="LeNet", dataset="MNIST", approach="baseline", mode="normal",
+        num_workers=1, worker_fail=0, batch_size=128, lr=0.01, momentum=0.9,
+    ),
+    # 2. ResNet-18/CIFAR-10, repetition code r=3, no adversary
+    "rep-resnet18": TrainConfig(
+        network="ResNet18", dataset="Cifar10", approach="maj_vote",
+        group_size=3, num_workers=9, worker_fail=0, batch_size=32,
+        lr=0.01, momentum=0.9,
+    ),
+    # 3. ResNet-18/CIFAR-10, cyclic code r=3 (s=1), reverse-gradient adversary
+    "cyclic-resnet18": TrainConfig(
+        network="ResNet18", dataset="Cifar10", approach="cyclic",
+        num_workers=9, worker_fail=1, err_mode="rev_grad", batch_size=32,
+        lr=0.01, momentum=0.9,
+    ),
+    # 4. VGG-11/CIFAR-10, cyclic code r=5 (s=2), constant attack (the
+    # reference's "random" mode is a passthrough, model_ops/utils.py:20-21)
+    "cyclic-vgg11": TrainConfig(
+        network="VGG11", dataset="Cifar10", approach="cyclic",
+        num_workers=9, worker_fail=2, err_mode="constant", batch_size=32,
+        lr=0.01, momentum=0.9,
+    ),
+    # 5a/5b. robust-aggregation baselines under the same adversary schedule
+    "geomedian-resnet18": TrainConfig(
+        network="ResNet18", dataset="Cifar10", approach="baseline",
+        mode="geometric_median", num_workers=9, worker_fail=1,
+        err_mode="rev_grad", batch_size=32, lr=0.01, momentum=0.9,
+    ),
+    "krum-resnet18": TrainConfig(
+        network="ResNet18", dataset="Cifar10", approach="baseline",
+        mode="krum", num_workers=9, worker_fail=1, err_mode="rev_grad",
+        batch_size=32, lr=0.01, momentum=0.9,
+    ),
+}
+
+
+def get_preset(name: str, **overrides) -> TrainConfig:
+    if name not in PRESETS:
+        raise ValueError(f"unknown preset {name!r} (have {sorted(PRESETS)})")
+    return dataclasses.replace(PRESETS[name], **overrides).validate()
