@@ -55,6 +55,10 @@ def main(argv=None) -> int:
                 overrides.update(
                     dataset="synthetic-mnist" if "lenet" in name else "synthetic-cifar10",
                     batch_size=4, max_steps=min(args.max_steps, 12),
+                    # shared: algebraically identical to the r× redundant
+                    # compute (see config.redundancy) at 1/r the FLOPs —
+                    # keeps the smoke grid tractable on CPU
+                    redundancy="shared",
                 )
             cfg = get_preset(name, **overrides)
             ds = load_dataset(cfg.dataset, cfg.data_dir,
