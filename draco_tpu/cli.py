@@ -75,6 +75,8 @@ def add_fit_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                    choices=["float32", "bfloat16"],
                    help="forward/backward dtype; bfloat16 runs the MXU at "
                         "full rate (params/BN stats/logits stay float32)")
+    p.add_argument("--remat", action="store_true",
+                   help="rematerialise activations in backward (jax.checkpoint)")
     p.add_argument("--profile-dir", type=str, default="",
                    help="capture a jax.profiler trace of a few steps into "
                         "this directory (SURVEY.md §5.1)")
@@ -120,6 +122,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         straggle_count=args.straggle_count,
         redundancy=args.redundancy,
         compute_dtype=args.compute_dtype,
+        remat=args.remat,
         eval_freq=args.eval_freq,
         train_dir=args.train_dir,
         checkpoint_step=args.checkpoint_step,

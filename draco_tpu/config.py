@@ -97,6 +97,9 @@ class TrainConfig:
     train_dir: str = "./train_out/"
     checkpoint_step: int = 0  # resume from this step if >0
 
+    # rematerialise activations in backward (jax.checkpoint) — memory for FLOPs
+    remat: bool = False
+
     # --- misc ---
     seed: int = SEED
     geomedian_iters: int = 80  # Weiszfeld iterations (replaces hdmedians dep)
