@@ -8,9 +8,23 @@ s=1 under reverse-gradient attack — on the available accelerator.
 paper's headline comparison is reported instead: speedup of the cyclic-decode
 step over the geometric-median robust-aggregation step at identical model /
 batch / adversary schedule (Draco's core claim — reference README.md:2,
-baseline_master.py:271-276). Values > 1 mean decode beats geo-median.
+baseline_master.py:271-276). Values > 1 mean decode beats geo-median. The
+geo-median cost is linear in ``geomedian_iters``; 80 iterations is pinned to
+hdmedians-level accuracy by tests/test_repetition_and_aggregation.py
+(TestWeiszfeldIterationBudget), so the ratio is apples-to-apples.
 
-Flags: --steps N --warmup N --batch-size B --network NAME --cpu-mesh N (debug)
+Failure discipline: the dev-tunnel TPU admits one client and a wedged lease
+can stay Unavailable for tens of minutes, so backend init is retried with
+backoff; if the accelerator never comes up the harness emits a *structured*
+error record (optionally with a clearly-labelled CPU-fallback measurement)
+instead of a traceback.
+
+MFU: FLOPs per train step come from XLA's static cost analysis of the
+compiled step (an analytic model of the whole program — fwd/bwd, encode,
+gather, decode, update), divided by wall-clock and the chip's bf16 peak.
+
+Flags: --steps N --warmup N --batch-size B --network NAME --cpu-mesh N
+       --init-retries K --retry-wait SEC --no-cpu-fallback
 """
 
 import argparse
@@ -18,8 +32,95 @@ import json
 import sys
 import time
 
+# bf16 systolic-array peak per chip, by device_kind substring (public specs).
+# MFU is reported against bf16 peak even for f32 runs (stated in the record).
+_PEAK_BF16 = [
+    ("v6", 918e12),
+    ("v5p", 459e12),
+    ("v5 lite", 197e12),
+    ("v5litepod", 197e12),
+    ("v5e", 197e12),
+    ("v5", 459e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+]
 
-def run(cfg_kwargs, ds, mesh, steps, warmup):
+
+def _peak_flops(device_kind: str):
+    kind = device_kind.lower()
+    for key, peak in _PEAK_BF16:
+        if key in kind:
+            return peak
+    return None
+
+
+def _probe_ok(timeout: float = 300.0) -> bool:
+    """Probe accelerator availability in a clean subprocess (which exits and
+    releases the one-client tunnel lease)."""
+    import subprocess
+
+    code = (
+        "import sys, jax\n"
+        "d = jax.devices()\n"
+        "sys.exit(0 if d and d[0].platform != 'cpu' else 3)\n"
+    )
+    try:
+        r = subprocess.run([sys.executable, "-c", code], timeout=timeout,
+                           capture_output=True)
+        return r.returncode == 0
+    except Exception:
+        return False
+
+
+def _try_backend(retries: int, wait: float):
+    """Initialize the accelerator backend, retrying a wedged tunnel lease.
+
+    Returns (devices, None) or (None, last_error_string). A failed in-process
+    init is sticky — xla_bridge caches the surviving CPU backend and never
+    re-probes the accelerator plugin — so jax.devices() returning only CPU
+    counts as failure, retries probe in subprocesses, and on recovery the
+    script re-execs itself for a fresh init (guarded by DRACO_BENCH_REEXEC
+    so a flapping backend can't loop forever).
+    """
+    import os
+
+    import jax
+
+    last = ""
+    try:
+        devs = jax.devices()
+        if devs and devs[0].platform != "cpu":
+            return devs, None
+        last = f"only cpu devices visible: {devs}"
+    except RuntimeError as e:  # backend init failure (UNAVAILABLE etc.)
+        last = f"{type(e).__name__}: {e}"
+    if os.environ.get("DRACO_BENCH_REEXEC"):
+        return None, last
+    for _ in range(max(retries - 1, 0)):
+        time.sleep(wait)
+        if _probe_ok():
+            os.environ["DRACO_BENCH_REEXEC"] = "1"
+            sys.stdout.flush()
+            os.execv(sys.executable, [sys.executable] + sys.argv)
+    return None, last
+
+
+def _compiled_flops(compiled):
+    """Analytic FLOPs from XLA's cost analysis of the *optimized* program
+    (the unoptimized-HLO figure over-counts ops the compiler fuses away,
+    which would inflate MFU)."""
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, list):  # older jax returns [dict]
+            cost = cost[0] if cost else {}
+        flops = float(cost.get("flops", 0.0)) if cost else 0.0
+        return flops if flops > 0 else None
+    except Exception:
+        return None
+
+
+def run(cfg_kwargs, ds, mesh, steps, warmup, want_flops=False):
     """Per-step wall-clock of the jitted train step.
 
     Batches are staged into HBM before the timed loop: the metric is the
@@ -41,39 +142,37 @@ def run(cfg_kwargs, ds, mesh, steps, warmup):
     staged = [tr._device_batch(step) for step in range(1, total + 1)]
     masks = [jnp.asarray(tr._adv_schedule[step]) for step in range(1, total + 1)]
     jax.block_until_ready(staged)
-    for step in range(1, warmup + 1):  # compile + settle
+    # AOT-compile once and drive the same executable for cost analysis,
+    # warmup and the timed loop (going through the jit wrapper after an AOT
+    # compile would compile the identical program a second time)
+    x0, y0 = staged[0]
+    compiled = tr.setup.train_step.lower(state, x0, y0, masks[0]).compile()
+    flops = _compiled_flops(compiled) if want_flops else None
+    for step in range(1, warmup + 1):  # settle
         x, y = staged[step - 1]
-        state, m = tr.setup.train_step(state, x, y, masks[step - 1])
+        state, m = compiled(state, x, y, masks[step - 1])
     jax.block_until_ready(state.params)
     t0 = time.perf_counter()
     for step in range(warmup + 1, total + 1):
         x, y = staged[step - 1]
-        state, m = tr.setup.train_step(state, x, y, masks[step - 1])
+        state, m = compiled(state, x, y, masks[step - 1])
     jax.block_until_ready(state.params)
     dt = (time.perf_counter() - t0) / steps
     tr.close()
-    return dt, float(m["loss"])
+    return dt, float(m["loss"]), flops
 
 
-def main():
-    p = argparse.ArgumentParser()
-    p.add_argument("--steps", type=int, default=20)
-    p.add_argument("--warmup", type=int, default=3)
-    p.add_argument("--batch-size", type=int, default=32)
-    p.add_argument("--network", type=str, default="ResNet18")
-    p.add_argument("--num-workers", type=int, default=8)
-    p.add_argument("--cpu-mesh", type=int, default=0)
-    args = p.parse_args()
-
-    from draco_tpu.cli import maybe_force_cpu_mesh
-
-    maybe_force_cpu_mesh(args)
-
+def measure(args, metric_name):
     from draco_tpu.data.datasets import load_dataset
     from draco_tpu.runtime import make_mesh
 
+    import jax
+
     ds = load_dataset("Cifar10", data_dir="./data")
     mesh = make_mesh(args.num_workers)
+    dev = jax.devices()[0]
+    platform = dev.platform
+    device_kind = getattr(dev, "device_kind", platform)
 
     common = dict(
         network=args.network,
@@ -91,32 +190,100 @@ def main():
     )
 
     # the contender: cyclic code, r=2s+1 redundant compute like the reference
-    t_cyclic, loss_c = run(
+    t_cyclic, loss_c, flops_c = run(
         dict(common, approach="cyclic", redundancy="simulate"),
-        ds, mesh, args.steps, args.warmup,
+        ds, mesh, args.steps, args.warmup, want_flops=True,
     )
     # the baseline robust aggregator Draco positions against
-    t_geomed, loss_g = run(
+    t_geomed, loss_g, _ = run(
         dict(common, approach="baseline", mode="geometric_median"),
         ds, mesh, args.steps, args.warmup,
     )
 
-    out = {
-        "metric": f"{args.network.lower()}_cifar10_cyclic_s1_revgrad_step_wallclock",
+    peak = _peak_flops(device_kind)
+    mfu = (
+        round(flops_c / t_cyclic / peak, 4)
+        if (flops_c and peak and t_cyclic > 0)
+        else None
+    )
+
+    return {
+        "metric": metric_name,
         "value": round(t_cyclic * 1000.0, 3),
         "unit": "ms/step",
         "vs_baseline": round(t_geomed / t_cyclic, 4),
         "extra": {
             "geomedian_step_ms": round(t_geomed * 1000.0, 3),
+            "geomedian_iters": 80,
             "num_workers": args.num_workers,
             "batch_size_per_worker": args.batch_size,
             "dataset": ds.name,
             "loss_cyclic": round(loss_c, 4),
             "loss_geomedian": round(loss_g, 4),
+            "platform": platform,
+            "device_kind": device_kind,
+            "flops_per_step": flops_c,
+            "peak_bf16_flops": peak,
+            "mfu_vs_bf16_peak": mfu,
+            "compute_dtype": "float32",
         },
     }
-    print(json.dumps(out))
-    return out
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--warmup", type=int, default=3)
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--network", type=str, default="ResNet18")
+    p.add_argument("--num-workers", type=int, default=8)
+    p.add_argument("--cpu-mesh", type=int, default=0)
+    p.add_argument("--init-retries", type=int, default=4,
+                   help="accelerator backend init attempts (wedged-lease weather)")
+    p.add_argument("--retry-wait", type=float, default=120.0,
+                   help="seconds between init attempts")
+    p.add_argument("--no-cpu-fallback", action="store_true",
+                   help="emit only the error record if the accelerator is down")
+    args = p.parse_args()
+
+    from draco_tpu.cli import maybe_force_cpu_mesh
+
+    maybe_force_cpu_mesh(args)
+
+    metric_name = (
+        f"{args.network.lower()}_cifar10_cyclic_s1_revgrad_step_wallclock"
+    )
+
+    if not args.cpu_mesh:
+        devs, err = _try_backend(args.init_retries, args.retry_wait)
+        if devs is None:
+            # structured failure instead of a traceback; optionally still
+            # measure on a CPU mesh, clearly labelled — a relative
+            # cyclic-vs-geomedian ratio survives, wall-clock does not.
+            record = {
+                "metric": metric_name,
+                "value": None,
+                "unit": "ms/step",
+                "vs_baseline": None,
+                "error": "tpu_unavailable",
+                "detail": (err or "")[-500:],
+            }
+            if not args.no_cpu_fallback:
+                try:
+                    import jax
+
+                    jax.config.update("jax_platforms", "cpu")
+                    fb = measure(args, metric_name)
+                    fb["error"] = "tpu_unavailable_cpu_fallback"
+                    fb["detail"] = (err or "")[-500:]
+                    record = fb
+                except Exception as e:  # keep the structured record at all costs
+                    record["fallback_error"] = f"{type(e).__name__}: {e}"[:300]
+            print(json.dumps(record))
+            return record
+    record = measure(args, metric_name)
+    print(json.dumps(record))
+    return record
 
 
 if __name__ == "__main__":

@@ -66,8 +66,13 @@ def krum(grads: jnp.ndarray, s: int,
     gram = jnp.matmul(grads, grads.T, precision=jax.lax.Precision.HIGHEST)
     norms = jnp.diag(gram)
     sq = jnp.maximum(norms[:, None] + norms[None, :] - 2.0 * gram, 0.0)
-    big = jnp.asarray(jnp.finfo(grads.dtype).max / 4, grads.dtype)
-    sq = sq + jnp.diag(jnp.full((n,), big, dtype=grads.dtype))
+    # penalty for self/absent entries: must outrank every real distance but
+    # stay bounded — n of them can land inside one row's k nearest slots
+    # (straggle_count > s+1 is valid baseline config) and a finfo.max-scale
+    # constant would overflow the score sum to inf for every row, degenerating
+    # argmin to index 0
+    big = 2.0 * jnp.max(sq) + 1.0
+    sq = sq + jnp.diag(jnp.full((n,), 1.0, dtype=grads.dtype)) * big
     if present is not None:
         absent = ~present
         sq = sq + big * absent[None, :].astype(grads.dtype)

@@ -25,7 +25,6 @@ def add_fit_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     p.add_argument("--momentum", type=float, default=0.9)
     p.add_argument("--optimizer", type=str, default="sgd", choices=["sgd", "adam"])
     p.add_argument("--max-steps", type=int, default=10000)
-    p.add_argument("--epochs", type=int, default=100)
     p.add_argument("--network", type=str, default="LeNet")
     p.add_argument("--dataset", type=str, default="MNIST")
     p.add_argument("--data-dir", type=str, default="./data")
@@ -55,9 +54,18 @@ def add_fit_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                    choices=["simulate", "shared"],
                    help="simulate: r-times redundant compute like the reference; "
                         "shared: algebraically identical compute-once fast path")
+    p.add_argument("--decode-granularity", type=str, default="global",
+                   choices=["global", "layer"],
+                   help="cyclic decode: one locator on the flat gradient, or "
+                        "one per parameter tensor like the reference "
+                        "(cyclic_master.py:125-129)")
     p.add_argument("--eval-freq", type=int, default=50)
     p.add_argument("--train-dir", type=str, default="./train_out/")
     p.add_argument("--checkpoint-step", type=int, default=0)
+    p.add_argument("--compress-ckpt", action="store_true",
+                   help="write compressed .dcg checkpoints (the reference's "
+                        "--compress-grad, applied where bytes still cross a "
+                        "slow link in the SPMD design)")
     p.add_argument("--seed", type=int, default=SEED)
     p.add_argument("--log-every", type=int, default=10)
     # long-context / sequence parallelism (TPU-native addition; no reference
@@ -109,7 +117,6 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         lr=args.lr,
         momentum=args.momentum,
         max_steps=args.max_steps,
-        epochs=args.epochs,
         num_workers=args.num_workers,
         approach=args.approach,
         mode=args.mode,
@@ -121,11 +128,13 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         straggle_mode=args.straggle_mode,
         straggle_count=args.straggle_count,
         redundancy=args.redundancy,
+        decode_granularity=args.decode_granularity,
         compute_dtype=args.compute_dtype,
         remat=args.remat,
         eval_freq=args.eval_freq,
         train_dir=args.train_dir,
         checkpoint_step=args.checkpoint_step,
+        compress_ckpt=args.compress_ckpt,
         seed=args.seed,
         log_every=args.log_every,
         seq_shards=args.seq_shards,

@@ -39,6 +39,13 @@ from draco_tpu.ops import coded as ops_coded
 
 PREC = jax.lax.Precision.HIGHEST
 
+# Ridge for the error-locator Hankel solve, shared by the jit decode below and
+# the native oracle (native/coding.cpp locator_alpha) so borderline
+# rank-deficient cases (< s actually-corrupt rows) rank rows identically on
+# both paths. Must sit well above float32 epsilon — see the normalisation
+# comment in decode().
+LOCATOR_RIDGE = 1e-4
+
 
 # --------------------------------------------------------------------------
 # Construction (host-side numpy, run identically by every participant at
@@ -203,33 +210,18 @@ def _complex_solve(a_re, a_im, b_re, b_im, ridge: float = 0.0):
     return x[:m], x[m:]
 
 
-def decode(code: CyclicCode, r_re: jnp.ndarray, r_im: jnp.ndarray, rand_factor: jnp.ndarray,
-           present: Optional[jnp.ndarray] = None):
-    """Recover the exact sum of the n batch gradients from corrupt rows.
+def _locate_v(code: CyclicCode, e_re: jnp.ndarray, e_im: jnp.ndarray,
+              present: Optional[jnp.ndarray] = None):
+    """Locator + recombination vector from one projected column e (n,).
 
-    r_re, r_im: (n, d) received encoded rows (≤ s rows arbitrarily corrupt).
-    rand_factor: (d,) random projection (reference: cyclic_master.py:58-61).
-    present: optional (n,) bool — False rows never arrived (stragglers /
-    crashed workers; they must be zero-filled by the caller). Known-missing
-    rows are *erasures*: they cost one redundancy unit instead of two, so the
-    decode is exact when either (a) no adversary is live and ≤ 2s rows are
-    missing, or (b) adversaries + missing ≤ s (the locator treats each
-    zero-filled row as one located error). No reference counterpart — the
-    reference PS simply blocks forever on a missing worker
-    (baseline_master.py:112-116).
-
-    Returns (n·mean-gradient, honest_mask): the (d,) real decoded sum / n and
-    the (n,) mask of rows the recombination actually used (True = treated as
-    honest; exactly n-2s rows are True, every located adversary and every
-    absent row is False).
+    Steps 2–5 of the decode: syndrome → error-locator solve → honest-row
+    top-k → recombination vector v with vᵀC1 = e1ᵀ supported on those rows.
+    Shape-static and vmap-able (layer-granularity decode maps this over the
+    per-layer projected columns). Returns (v_re, v_im, honest), all (n,).
     """
     n, s = code.n, code.s
     c2h_re = jnp.asarray(code.c2h_re)
     c2h_im = jnp.asarray(code.c2h_im)
-
-    # 1. project to one column: e = R @ f  (the only O(n·d) work besides the
-    #    final recombination — one fused pass over (R_re, R_im))
-    e_re, e_im = ops_coded.complex_project(r_re, r_im, rand_factor)
 
     # 2. syndrome E2 = C2^H e, shape (2s,)
     e2_re = jnp.matmul(c2h_re, e_re, precision=PREC) - jnp.matmul(c2h_im, e_im, precision=PREC)
@@ -253,7 +245,8 @@ def decode(code: CyclicCode, r_re: jnp.ndarray, r_im: jnp.ndarray, rand_factor: 
         # harmless: corrupt-row magnitudes stay ~1e-8 vs honest ~1.
         scale = jnp.maximum(jnp.max(e2_re**2 + e2_im**2) ** 0.5, 1e-30)
         alpha_re, alpha_im = _complex_solve(
-            a_re / scale, a_im / scale, b_re / scale, b_im / scale, ridge=1e-4
+            a_re / scale, a_im / scale, b_re / scale, b_im / scale,
+            ridge=LOCATOR_RIDGE,
         )
 
         # 4. locator polynomial p(z) = z^s - Σ α_j z^j, roots at corrupt rows
@@ -292,7 +285,76 @@ def decode(code: CyclicCode, r_re: jnp.ndarray, r_im: jnp.ndarray, rand_factor: 
 
     v_full_re = jnp.zeros((n,), rec_re.dtype).at[idx].set(v_re)
     v_full_im = jnp.zeros((n,), rec_re.dtype).at[idx].set(v_im)
+    return v_full_re, v_full_im, honest
+
+
+def decode(code: CyclicCode, r_re: jnp.ndarray, r_im: jnp.ndarray, rand_factor: jnp.ndarray,
+           present: Optional[jnp.ndarray] = None):
+    """Recover the exact sum of the n batch gradients from corrupt rows.
+
+    r_re, r_im: (n, d) received encoded rows (≤ s rows arbitrarily corrupt).
+    rand_factor: (d,) random projection (reference: cyclic_master.py:58-61).
+    present: optional (n,) bool — False rows never arrived (stragglers /
+    crashed workers; they must be zero-filled by the caller). Known-missing
+    rows are *erasures*: they cost one redundancy unit instead of two, so the
+    decode is exact when either (a) no adversary is live and ≤ 2s rows are
+    missing, or (b) adversaries + missing ≤ s (the locator treats each
+    zero-filled row as one located error). No reference counterpart — the
+    reference PS simply blocks forever on a missing worker
+    (baseline_master.py:112-116).
+
+    Returns (n·mean-gradient, honest_mask): the (d,) real decoded sum / n and
+    the (n,) mask of rows the recombination actually used (True = treated as
+    honest; exactly n-2s rows are True, every located adversary and every
+    absent row is False).
+    """
+    n = code.n
+    # 1. project to one column: e = R @ f  (the only O(n·d) work besides the
+    #    final recombination — one fused pass over (R_re, R_im))
+    e_re, e_im = ops_coded.complex_project(r_re, r_im, rand_factor)
+    v_full_re, v_full_im, honest = _locate_v(code, e_re, e_im, present)
 
     # 6. recombine: Re(v^T R) / n — the second O(n·d) pass, fused
     decoded = ops_coded.complex_recombine(v_full_re, v_full_im, r_re, r_im) / n
     return decoded, honest
+
+
+def decode_layers(code: CyclicCode, r_re: jnp.ndarray, r_im: jnp.ndarray,
+                  rand_factor: jnp.ndarray, offsets,
+                  present: Optional[jnp.ndarray] = None):
+    """Layer-granularity decode — one locator per parameter tensor.
+
+    The reference decodes each layer independently with its own random
+    projection factor (cyclic_master.py:125-129 loops layers, :58-61 draws a
+    factor per layer); this is that semantics on the flattened (n, d) matrix:
+    ``offsets`` are the static leaf boundaries (len L+1), segment ℓ =
+    [offsets[ℓ], offsets[ℓ+1]). Each segment gets its own projection (a slice
+    of the same (d,) factor vector), its own locator solve and its own
+    recombination vector; the tiny per-layer solves run batched under one
+    vmap. When corruption is per-worker (a whole row is attacked — the only
+    kind the wire protocol admits) every layer locates the same set, and this
+    agrees with the global decode; the per-layer locators additionally catch
+    corruption confined to a single layer's coordinates, which a single
+    global projection could only see through that layer's contribution.
+
+    Returns (decoded (d,), honest (L, n)).
+    """
+    n = code.n
+    bounds = [int(o) for o in offsets]
+    e_res, e_ims = [], []
+    for a, b in zip(bounds[:-1], bounds[1:]):
+        e_re, e_im = ops_coded.complex_project(
+            r_re[:, a:b], r_im[:, a:b], rand_factor[a:b]
+        )
+        e_res.append(e_re)
+        e_ims.append(e_im)
+    e_re_l = jnp.stack(e_res)  # (L, n)
+    e_im_l = jnp.stack(e_ims)
+    v_re_l, v_im_l, honest_l = jax.vmap(
+        lambda er, ei: _locate_v(code, er, ei, present)
+    )(e_re_l, e_im_l)
+    parts = [
+        ops_coded.complex_recombine(v_re_l[i], v_im_l[i], r_re[:, a:b], r_im[:, a:b])
+        for i, (a, b) in enumerate(zip(bounds[:-1], bounds[1:]))
+    ]
+    return jnp.concatenate(parts) / n, honest_l

@@ -31,7 +31,6 @@ class TrainConfig:
     lr: float = 0.01
     momentum: float = 0.9
     max_steps: int = 10000
-    epochs: int = 100
 
     # --- distributed topology ---
     num_workers: int = 8  # n logical workers = size of mesh axis `w`
@@ -90,12 +89,16 @@ class TrainConfig:
 
     # --- precision ---
     compute_dtype: str = "float32"  # forward/backward dtype (bfloat16|float32)
-    code_dtype: str = "float32"  # encode/decode arithmetic dtype
 
     # --- eval / checkpoint (reference: distributed_nn.py:56-75) ---
     eval_freq: int = 50
     train_dir: str = "./train_out/"
     checkpoint_step: int = 0  # resume from this step if >0
+    # write checkpoints as shuffled-deflate .dcg archives instead of Orbax
+    # dirs — the descendant of the reference's --compress-grad wire toggle
+    # (compress_gradient.py:7-15), for train_dirs crossing a slow link.
+    # Single-host only (utils/checkpoint.py).
+    compress_ckpt: bool = False
 
     # rematerialise activations in backward (jax.checkpoint) — memory for FLOPs
     remat: bool = False
@@ -161,6 +164,12 @@ class TrainConfig:
             raise ValueError(f"compute_dtype must be float32|bfloat16, got {self.compute_dtype}")
         if self.straggle_mode not in ("none", "drop"):
             raise ValueError(f"unknown straggle_mode: {self.straggle_mode}")
+        if self.decode_granularity not in ("global", "layer"):
+            raise ValueError(
+                f"decode_granularity must be global|layer, got {self.decode_granularity}"
+            )
+        if self.redundancy not in ("simulate", "shared"):
+            raise ValueError(f"redundancy must be simulate|shared, got {self.redundancy}")
         if self.adversary_count is not None and self.adversary_count > self.worker_fail:
             raise ValueError(
                 "adversary_count cannot exceed worker_fail (the code is only "
@@ -181,13 +190,32 @@ class TrainConfig:
                         f"({t}+{e} <= {s}), or adversary_count == 0 with "
                         f"straggle_count <= 2*worker_fail ({e} <= {2 * s})"
                     )
-            if self.approach == "maj_vote" and e >= self.group_size:
-                raise ValueError(
-                    f"straggle_count {e} >= group_size {self.group_size} can "
-                    "silence an entire repetition group"
-                )
-            if self.approach == "baseline" and e >= n:
-                raise ValueError("straggle_count must leave at least one worker")
+            if self.approach == "maj_vote":
+                if e >= self.group_size:
+                    raise ValueError(
+                        f"straggle_count {e} >= group_size {self.group_size} can "
+                        "silence an entire repetition group"
+                    )
+                # Worst case all e stragglers AND all t adversaries land in one
+                # group (the schedules are independent): the vote among the
+                # group_size - e present members needs an honest majority,
+                # i.e. group_size - e > 2t — the joint budget, mirroring the
+                # cyclic t + e <= s check above.
+                if t > 0 and self.group_size - e <= 2 * t:
+                    raise ValueError(
+                        f"maj_vote joint budget exceeded: group_size - "
+                        f"straggle_count must exceed 2*adversaries "
+                        f"({self.group_size} - {e} <= {2 * t}); an unlucky "
+                        "group could be voted over by adversarial rows"
+                    )
+            if self.approach == "baseline":
+                if e >= n:
+                    raise ValueError("straggle_count must leave at least one worker")
+                if self.mode == "krum" and n - e < s + 3:
+                    raise ValueError(
+                        f"krum needs num_workers - straggle_count >= worker_fail + 3 "
+                        f"({n} - {e} < {s} + 3)"
+                    )
         if self.network == "TransformerLM":
             if self.approach == "maj_vote":
                 raise ValueError(

@@ -83,7 +83,7 @@ def build_sp_train_setup(cfg: TrainConfig, mesh) -> SPTrainSetup:
     params = init_model.init({"params": root}, init_toks, train=True)["params"]
 
     opt = optim.build_optimizer(cfg.optimizer, cfg.lr, cfg.momentum)
-    unravel, dim = _make_unravel(params)
+    unravel, dim, _ = _make_unravel(params)
 
     repl = NamedSharding(mesh, P())
     shard_w = NamedSharding(mesh, P(WORKER_AXIS))

@@ -83,6 +83,10 @@ def _flatten_tree(tree) -> jnp.ndarray:
 
 
 def _make_unravel(params):
+    """Returns (unravel, dim, offsets) — offsets are the per-leaf segment
+    boundaries in the flat vector, the "layers" of layer-granularity decode
+    (the reference decodes each parameter tensor separately,
+    cyclic_master.py:125-129)."""
     leaves, treedef = jax.tree.flatten(params)
     shapes = [l.shape for l in leaves]
     sizes = [int(np.prod(s)) for s in shapes]
@@ -95,7 +99,7 @@ def _make_unravel(params):
         ]
         return jax.tree.unflatten(treedef, parts)
 
-    return unravel, int(offsets[-1])
+    return unravel, int(offsets[-1]), offsets
 
 
 def build_train_setup(cfg: TrainConfig, mesh, dataset_name: Optional[str] = None) -> TrainSetup:
@@ -121,7 +125,7 @@ def build_train_setup(cfg: TrainConfig, mesh, dataset_name: Optional[str] = None
 
     opt = optim.build_optimizer(cfg.optimizer, cfg.lr, cfg.momentum)
     opt_state = opt.init(params)
-    unravel, dim = _make_unravel(params)
+    unravel, dim, leaf_offsets = _make_unravel(params)
 
     repl = NamedSharding(mesh, P())
     shard_w = NamedSharding(mesh, P(WORKER_AXIS))
@@ -313,8 +317,17 @@ def build_train_setup(cfg: TrainConfig, mesh, dataset_name: Optional[str] = None
                 enc_im = enc_im * pw
             enc_re = jax.lax.with_sharding_constraint(enc_re, shard_w)
             enc_im = jax.lax.with_sharding_constraint(enc_im, shard_w)
-            decoded, honest = cyclic_mod.decode(code, enc_re, enc_im, rand_factor,
-                                                present=present)
+            if cfg.decode_granularity == "layer":
+                # per-parameter-tensor locator + projection, like the
+                # reference's per-layer decode loop (cyclic_master.py:125-129)
+                decoded, honest_l = cyclic_mod.decode_layers(
+                    code, enc_re, enc_im, rand_factor, leaf_offsets,
+                    present=present,
+                )
+                honest = jnp.all(honest_l, axis=0)
+            else:
+                decoded, honest = cyclic_mod.decode(code, enc_re, enc_im,
+                                                    rand_factor, present=present)
             new_state = apply_update(state, decoded, new_stats)
             out = _metrics(losses, precs, present)
             out["honest_located"] = jnp.sum(honest.astype(jnp.int32))

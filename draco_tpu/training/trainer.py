@@ -131,7 +131,8 @@ class Trainer:
             if cfg.eval_freq and step % cfg.eval_freq == 0:
                 self.evaluate(step)
                 if cfg.train_dir:
-                    ckpt.save(cfg.train_dir, step, self.state)
+                    ckpt.save(cfg.train_dir, step, self.state,
+                              compress=cfg.compress_ckpt)
         return last
 
     # ---- eval ------------------------------------------------------------
@@ -159,8 +160,12 @@ class Trainer:
 
     # ---- checkpoint ------------------------------------------------------
     def restore(self, step: int):
+        # abstract tree must carry each leaf's sharding: on multi-host, save()
+        # writes global jax.Arrays collectively, and a sharding-less restore
+        # would fail (or come back host-local) exactly there
         abstract = jax.tree.map(
-            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), jax.device_get(self.state)
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding),
+            self.state,
         )
         self.state = ckpt.load(self.cfg.train_dir, step, abstract)
         self._start_step = step + 1

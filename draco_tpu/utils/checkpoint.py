@@ -3,25 +3,57 @@ torch.save of whole modules / state_dicts every eval_freq steps,
 baseline_master.py:237-248, and the hardcoded ../checkpoints resume path,
 baseline_master.py:54-57). Layout: ``{train_dir}/model_step_{k}/`` — the same
 naming contract the reference's evaluator polls for
-(distributed_evaluator.py:83)."""
+(distributed_evaluator.py:83).
+
+``compress=True`` writes ``model_step_{k}.dcg`` instead: one file of
+byte-shuffled deflate payloads (draco_tpu.utils.compress — the wire-format
+successor of the reference's ``--compress-grad`` blosc path,
+compress_gradient.py:7-15), for train_dirs that cross a slow link (the
+reference shipped checkpoints over NFS to the evaluator). ``load`` and the
+evaluator auto-detect either format. Compressed saves are single-host only:
+gathering non-addressable shards is exactly what Orbax's collective save is
+for, so multi-host runs must keep the Orbax path.
+"""
 
 from __future__ import annotations
 
 import os
 import re
-from typing import Any, Optional
+import struct
+from typing import Any
 
 import jax
+import numpy as np
 import orbax.checkpoint as ocp
+
+from draco_tpu.utils import compress as compress_mod
+
+_DCG_MAGIC = b"DCKP"
 
 
 def _path(train_dir: str, step: int) -> str:
     return os.path.abspath(os.path.join(train_dir, f"model_step_{step}"))
 
 
-def save(train_dir: str, step: int, state: Any) -> str:
+def save(train_dir: str, step: int, state: Any, compress: bool = False) -> str:
     os.makedirs(train_dir, exist_ok=True)
     path = _path(train_dir, step)
+    if compress:
+        if jax.process_count() > 1:
+            raise ValueError(
+                "compressed checkpoints are single-host only (multi-host saves "
+                "need Orbax's collective gather of non-addressable shards)"
+            )
+        leaves = jax.tree.leaves(jax.device_get(state))
+        blobs = [compress_mod.compress(np.asarray(leaf)) for leaf in leaves]
+        tmp = path + ".dcg.tmp"
+        with open(tmp, "wb") as f:
+            f.write(_DCG_MAGIC + struct.pack("<I", len(blobs)))
+            for blob in blobs:
+                f.write(struct.pack("<Q", len(blob)))
+                f.write(blob)
+        os.replace(tmp, path + ".dcg")
+        return path + ".dcg"
     # single-host: plain numpy payload. Multi-host: keep global jax.Arrays —
     # device_get cannot materialise non-addressable shards; Orbax gathers
     # them collectively (all processes must call save).
@@ -31,13 +63,42 @@ def save(train_dir: str, step: int, state: Any) -> str:
     return path
 
 
+def _load_dcg(path: str, abstract_state: Any) -> Any:
+    leaves_abs, treedef = jax.tree.flatten(abstract_state)
+    with open(path, "rb") as f:
+        head = f.read(8)
+        if head[:4] != _DCG_MAGIC:
+            raise ValueError(f"not a draco_tpu compressed checkpoint: {path}")
+        (count,) = struct.unpack("<I", head[4:])
+        if count != len(leaves_abs):
+            raise ValueError(
+                f"checkpoint holds {count} arrays, abstract state has {len(leaves_abs)}"
+            )
+        out = []
+        for leaf in leaves_abs:
+            (blen,) = struct.unpack("<Q", f.read(8))
+            arr = compress_mod.decompress(f.read(blen))
+            if tuple(arr.shape) != tuple(leaf.shape) or arr.dtype != leaf.dtype:
+                raise ValueError(
+                    f"checkpoint leaf {arr.shape}/{arr.dtype} does not match "
+                    f"abstract {leaf.shape}/{leaf.dtype}"
+                )
+            sharding = getattr(leaf, "sharding", None)
+            out.append(jax.device_put(arr, sharding) if sharding is not None else arr)
+    return jax.tree.unflatten(treedef, out)
+
+
 def load(train_dir: str, step: int, abstract_state: Any) -> Any:
+    path = _path(train_dir, step)
+    if os.path.isfile(path + ".dcg"):
+        return _load_dcg(path + ".dcg", abstract_state)
     with ocp.StandardCheckpointer() as ckptr:
-        return ckptr.restore(_path(train_dir, step), abstract_state)
+        return ckptr.restore(path, abstract_state)
 
 
 def exists(train_dir: str, step: int) -> bool:
-    return os.path.isdir(_path(train_dir, step))
+    path = _path(train_dir, step)
+    return os.path.isdir(path) or os.path.isfile(path + ".dcg")
 
 
 def available_steps(train_dir: str):
@@ -45,7 +106,7 @@ def available_steps(train_dir: str):
         return []
     steps = []
     for name in os.listdir(train_dir):
-        m = re.fullmatch(r"model_step_(\d+)", name)
+        m = re.fullmatch(r"model_step_(\d+)(\.dcg)?", name)
         if m:
             steps.append(int(m.group(1)))
-    return sorted(steps)
+    return sorted(set(steps))

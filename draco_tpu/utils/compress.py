@@ -3,9 +3,10 @@
 src/compress_gradient.py:7-15, blosc.pack_array with the 'snappy' codec).
 
 On-ICI gradient traffic needs no host compression in the SPMD design
-(SURVEY.md §5.8), so this serves the places bytes still cross a slow link:
-checkpoint payloads, host<->host DCN sidecars, and the evaluator's NFS-like
-train_dir. Format: a fixed header (dtype/shape/elem-size) + byte-shuffled
+(SURVEY.md §5.8), so this serves where bytes still cross a slow link:
+compressed ``.dcg`` checkpoints (utils/checkpoint.py, ``--compress-ckpt``),
+which the evaluator's train_dir polling auto-detects.
+Format: a fixed header (dtype/shape/elem-size) + byte-shuffled
 deflate payload — blosc's SHUFFLE filter re-implemented natively
 (native/compress.cpp), with a numpy+zlib fallback that produces byte-identical
 streams (same shuffle, same zlib), so archives are portable across backends.
@@ -39,7 +40,10 @@ def _unshuffle_np(raw: bytes, elem: int) -> bytes:
 
 def compress(grad: np.ndarray, level: int = 1) -> bytes:
     """Pack an ndarray (reference: compress_gradient.py:7-10)."""
-    arr = np.ascontiguousarray(grad)
+    arr = np.asarray(grad)
+    # ascontiguousarray promotes 0-d to (1,), losing the scalar shape
+    if arr.ndim:
+        arr = np.ascontiguousarray(arr)
     elem = arr.dtype.itemsize
     dt = arr.dtype.str.encode()
     header = _MAGIC + struct.pack(

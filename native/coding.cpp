@@ -113,7 +113,9 @@ bool locator_alpha(int n, int s, const cd* e, std::vector<cd>& alpha) {
     for (int j = 0; j < s; ++j) a[i * s + j] = e2[s - 1 - i + j] / scale;
     b[i] = e2[2 * s - 1 - i] / scale;
   }
-  return solve_ridge(a, b, alpha, s, 1e-8);
+  // kept identical to draco_tpu.coding.cyclic.LOCATOR_RIDGE so native and
+  // jit decodes rank borderline (rank-deficient) rows the same way
+  return solve_ridge(a, b, alpha, s, 1e-4);
 }
 
 }  // namespace

@@ -140,3 +140,121 @@ def test_decode_is_jittable():
     jitted = jax.jit(lambda a, b, c: cyclic.decode(code, a, b, c))
     dec, honest = jitted(r_re, r_im, rf)
     assert dec.shape == (d,)
+
+
+@pytest.mark.parametrize("n,s", [(7, 1), (11, 2)])
+def test_decode_layers_matches_global(n, s, rng):
+    """Per-layer locators (reference: cyclic_master.py:125-129) agree with the
+    global decode when corruption is per-worker — whole rows attacked, the
+    only corruption the wire protocol admits."""
+    from draco_tpu.attacks import inject_cyclic
+
+    d = 96
+    code = cyclic.build_cyclic_code(n, s)
+    batch_grads = rng.randn(n, d).astype(np.float32)
+    g = batch_grads[code.batch_ids]
+    enc_re, enc_im = cyclic.encode(code, jnp.asarray(g))
+    adv = np.zeros(n, dtype=bool)
+    adv[rng.choice(n, size=s, replace=False)] = True
+    enc_re, enc_im = inject_cyclic(enc_re, enc_im, jnp.asarray(adv), "rev_grad")
+    rf = rng.normal(loc=1.0, size=d).astype(np.float32)
+    offsets = [0, 17, 40, d]  # three unequal "layers"
+    dec_g, honest_g = cyclic.decode(code, enc_re, enc_im, jnp.asarray(rf))
+    dec_l, honest_l = cyclic.decode_layers(code, enc_re, enc_im, jnp.asarray(rf),
+                                           offsets)
+    np.testing.assert_allclose(np.asarray(dec_l), np.asarray(dec_g),
+                               rtol=5e-3, atol=5e-3)
+    # every layer locates the same honest set, and none admits an adversary
+    assert (np.asarray(honest_l) == np.asarray(honest_g)[None, :]).all()
+    want = batch_grads.sum(axis=0) / n
+    np.testing.assert_allclose(np.asarray(dec_l), want, rtol=5e-3, atol=5e-3)
+
+
+def test_decode_layers_erasures(rng):
+    """Layer decode honours the present mask (stragglers) per layer."""
+    n, s, d = 9, 2, 64
+    code = cyclic.build_cyclic_code(n, s)
+    batch_grads = rng.randn(n, d).astype(np.float32)
+    enc_re, enc_im = cyclic.encode(code, jnp.asarray(batch_grads[code.batch_ids]))
+    present = np.ones(n, dtype=bool)
+    present[[2, 6]] = False
+    enc_re = jnp.asarray(np.asarray(enc_re) * present[:, None])
+    enc_im = jnp.asarray(np.asarray(enc_im) * present[:, None])
+    rf = rng.normal(loc=1.0, size=d).astype(np.float32)
+    dec, honest_l = cyclic.decode_layers(code, enc_re, enc_im, jnp.asarray(rf),
+                                         [0, 20, d], present=jnp.asarray(present))
+    want = batch_grads.sum(axis=0) / n
+    np.testing.assert_allclose(np.asarray(dec), want, rtol=2e-3, atol=2e-3)
+    assert not np.asarray(honest_l)[:, [2, 6]].any()
+
+
+def test_decode_layers_jittable():
+    code = cyclic.build_cyclic_code(7, 1)
+    d = 24
+    jitted = jax.jit(
+        lambda a, b, c: cyclic.decode_layers(code, a, b, c, [0, 10, 24])
+    )
+    dec, honest_l = jitted(jnp.zeros((7, d)), jnp.zeros((7, d)), jnp.ones((d,)))
+    assert dec.shape == (d,)
+    assert honest_l.shape == (2, 7)
+
+
+# ---------------------------------------------------------------------------
+# scale envelope: larger n and s than the reference cluster ever ran
+# (reference: 8 workers, README.md:39-47)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("n,s", [(16, 3), (21, 3), (32, 3), (32, 5)])
+def test_construction_at_scale(n, s):
+    code = cyclic.build_cyclic_code(n, s)
+    assert (code.support.sum(axis=1) == 2 * s + 1).all()
+    c2h = code.c2h_re + 1j * code.c2h_im
+    assert np.abs(c2h @ code.w_full).max() < 1e-4
+
+
+@pytest.mark.parametrize("n,s", [(16, 3), (32, 3)])
+@pytest.mark.parametrize("attack", ["rev_grad", "constant"])
+def test_exact_recovery_under_attack_at_scale(n, s, attack, rng):
+    from draco_tpu.attacks import inject_cyclic
+
+    code = cyclic.build_cyclic_code(n, s)
+    d = 128
+    batch_grads = rng.randn(n, d).astype(np.float32)
+    enc_re, enc_im = cyclic.encode(code, jnp.asarray(batch_grads[code.batch_ids]))
+    adv = np.zeros(n, dtype=bool)
+    adv[rng.choice(n, size=s, replace=False)] = True
+    enc_re, enc_im = inject_cyclic(enc_re, enc_im, jnp.asarray(adv), attack)
+    rf = rng.normal(loc=1.0, size=d).astype(np.float32)
+    dec, honest = cyclic.decode(code, enc_re, enc_im, jnp.asarray(rf))
+    want = batch_grads.sum(axis=0) / n
+    np.testing.assert_allclose(np.asarray(dec), want, rtol=1e-2, atol=1e-2)
+    assert not np.asarray(honest)[adv].any()
+    assert np.asarray(honest).sum() == n - 2 * s
+
+
+@pytest.mark.parametrize("n,s,t,e", [(16, 3, 2, 1), (16, 3, 1, 2), (32, 3, 2, 1)])
+def test_joint_adversary_and_erasure_at_scale(n, s, t, e, rng):
+    """t live adversaries + e stragglers, t + e <= s, at n the reference
+    never reached."""
+    from draco_tpu.attacks import inject_cyclic
+
+    code = cyclic.build_cyclic_code(n, s)
+    d = 128
+    batch_grads = rng.randn(n, d).astype(np.float32)
+    enc_re, enc_im = cyclic.encode(code, jnp.asarray(batch_grads[code.batch_ids]))
+    picks = rng.choice(n, size=t + e, replace=False)
+    adv, missing = picks[:t], picks[t:]
+    adv_mask = np.zeros(n, dtype=bool)
+    adv_mask[adv] = True
+    enc_re, enc_im = inject_cyclic(enc_re, enc_im, jnp.asarray(adv_mask), "rev_grad")
+    present = np.ones(n, dtype=bool)
+    present[missing] = False
+    enc_re = jnp.asarray(np.asarray(enc_re) * present[:, None])
+    enc_im = jnp.asarray(np.asarray(enc_im) * present[:, None])
+    rf = rng.normal(loc=1.0, size=d).astype(np.float32)
+    dec, used = cyclic.decode(code, enc_re, enc_im, jnp.asarray(rf),
+                              present=jnp.asarray(present))
+    want = batch_grads.sum(axis=0) / n
+    np.testing.assert_allclose(np.asarray(dec), want, rtol=1e-2, atol=1e-2)
+    used = np.asarray(used)
+    assert not used[adv].any() and not used[missing].any()

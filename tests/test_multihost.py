@@ -80,3 +80,40 @@ def test_launcher_propagates_failure():
 def test_free_port_is_usable():
     port = local_cluster._free_port()
     assert 0 < port < 65536
+
+
+def test_launcher_survives_large_child_output():
+    """Regression: children used to write to pipes drained sequentially in pid
+    order; a child emitting more than the OS pipe buffer (~64KB) deadlocked
+    the launcher. Files have no backpressure."""
+    proc = _run_cluster(
+        [sys.executable, "-c",
+         "import sys\n"
+         "for _ in range(4000): print('x' * 120)\n"
+         "sys.exit(0)"],
+        n=2, d=1, timeout=120,
+    )
+    assert proc.returncode == 0
+    assert proc.stdout.count("x" * 120) >= 8000
+
+
+@pytest.mark.slow
+def test_cli_checkpoint_resume_two_processes(tmp_path):
+    """Multi-host save -> restore roundtrip: save() writes global jax.Arrays
+    collectively; restore must rebuild them with sharding info (the abstract
+    tree carries each leaf's sharding)."""
+    train_dir = str(tmp_path / "ckpt_run")
+    common = [
+        sys.executable, "-m", "draco_tpu.cli",
+        "--approach", "baseline", "--network", "FC",
+        "--dataset", "synthetic-mnist",
+        "--num-workers", "4", "--batch-size", "4",
+        "--eval-freq", "4", "--train-dir", train_dir,
+        "--log-every", "1", "--cpu-mesh", "2",
+    ]
+    proc = _run_cluster(common + ["--max-steps", "4"])
+    assert proc.returncode == 0, proc.stdout[-3000:] + proc.stderr[-2000:]
+    proc = _run_cluster(common + ["--max-steps", "8", "--checkpoint-step", "4"])
+    assert proc.returncode == 0, proc.stdout[-3000:] + proc.stderr[-2000:]
+    steps = [int(m) for m in re.findall(r"Step: (\d+)", proc.stdout)]
+    assert steps and min(steps) >= 5  # resumed past the checkpoint

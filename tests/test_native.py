@@ -190,3 +190,15 @@ def test_native_erasure_decode_matches_jnp(n, s, adv, missing):
     np.testing.assert_allclose(np.asarray(out_j), truth, atol=1e-4)
     for r in (*adv, *missing):
         assert not used_c[r] and not np.asarray(used_j)[r]
+
+
+def test_compress_preserves_scalar_and_noncontiguous_shapes():
+    """Regression: ascontiguousarray promotes 0-d arrays to (1,), which broke
+    compressed checkpoints of scalar leaves (e.g. the step counter)."""
+    from draco_tpu.utils import compress as c
+
+    for a in [np.asarray(True), np.asarray(3, np.int32),
+              np.arange(6, dtype=np.float32).reshape(2, 3)[:, ::2]]:
+        b = c.decompress(c.compress(a))
+        assert b.shape == a.shape and b.dtype == a.dtype
+        np.testing.assert_array_equal(b, a)

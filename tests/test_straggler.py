@@ -203,3 +203,26 @@ def test_baseline_mean_with_stragglers():
     last = tr.run(max_steps=20)
     assert last["loss"] < first["loss"]
     tr.close()
+
+
+def test_config_rejects_maj_vote_joint_budget():
+    # one straggler + one adversary can land in the same size-3 group:
+    # 3 - 1 = 2 present members, no honest majority over 1 adversary
+    with pytest.raises(ValueError, match="joint budget"):
+        TrainConfig(approach="maj_vote", num_workers=9, group_size=3,
+                    worker_fail=1, straggle_mode="drop",
+                    straggle_count=1).validate()
+    # group_size=5 leaves 4 present > 2*1 — within budget
+    TrainConfig(approach="maj_vote", num_workers=10, group_size=5,
+                worker_fail=1, straggle_mode="drop",
+                straggle_count=1).validate()
+
+
+def test_config_rejects_krum_with_too_many_stragglers():
+    with pytest.raises(ValueError, match="krum"):
+        TrainConfig(approach="baseline", mode="krum", num_workers=8,
+                    worker_fail=2, straggle_mode="drop",
+                    straggle_count=4).validate()
+    TrainConfig(approach="baseline", mode="krum", num_workers=8,
+                worker_fail=2, straggle_mode="drop",
+                straggle_count=3).validate()

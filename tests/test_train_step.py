@@ -128,6 +128,22 @@ class TestCyclic:
         flat_b = np.concatenate([np.ravel(x) for x in jax.tree.leaves(out["shared"])])
         np.testing.assert_allclose(flat_a, flat_b, rtol=2e-3, atol=2e-5)
 
+    def test_layer_granularity_agrees_with_global(self, ds, mesh):
+        """decode_granularity=layer runs one locator per parameter tensor
+        (reference: cyclic_master.py:125-129); with per-worker corruption it
+        must land on the same honest set, hence the same parameters."""
+        out = {}
+        for gran in ("global", "layer"):
+            cfg = make_cfg(approach="cyclic", worker_fail=1, err_mode="rev_grad",
+                           redundancy="shared", decode_granularity=gran,
+                           max_steps=6)
+            tr, _, last = run_steps(cfg, ds, mesh, 6)
+            assert last["honest_located"] == 6.0
+            out[gran] = jax.device_get(tr.state.params)
+        flat_g = np.concatenate([np.ravel(x) for x in jax.tree.leaves(out["global"])])
+        flat_l = np.concatenate([np.ravel(x) for x in jax.tree.leaves(out["layer"])])
+        np.testing.assert_allclose(flat_g, flat_l, rtol=2e-3, atol=2e-5)
+
     def test_cyclic_matches_plain_mean_without_adversary(self, ds, mesh):
         """Decode of honest encodings == plain averaging of the same batches:
         run cyclic s=0... not allowed (s>=0 ok) — use s=1 with no actual

@@ -27,6 +27,7 @@ import os
 import socket
 import subprocess
 import sys
+import tempfile
 
 
 def _free_port() -> int:
@@ -47,23 +48,33 @@ def launch(num_processes: int, devices_per_process: int, cmd: list[str],
     ).strip()
     base.setdefault("JAX_PLATFORMS", "cpu")
 
-    procs = []
+    # Each child writes to its own temp file, never a pipe: collectives keep
+    # all children in lock-step, so a child blocked on a full pipe buffer
+    # stalls the whole cluster while the launcher drains children in pid
+    # order — the classic launcher deadlock. Files have no backpressure.
+    procs, logs = [], []
     for pid in range(num_processes):
         child_env = dict(base, DRACO_PROCESS_ID=str(pid))
+        log = tempfile.TemporaryFile(mode="w+b", prefix=f"draco_proc{pid}_") if prefix_output else None
+        logs.append(log)
         procs.append(
             subprocess.Popen(
                 cmd, env=child_env,
-                stdout=subprocess.PIPE if prefix_output else None,
+                stdout=log if prefix_output else None,
                 stderr=subprocess.STDOUT if prefix_output else None,
-                text=prefix_output,
             )
         )
     rc = 0
     for pid, p in enumerate(procs):
-        out, _ = p.communicate() if prefix_output else (None, None)
-        if prefix_output and out:
-            for line in out.splitlines():
+        p.wait()
+        if prefix_output:
+            logs[pid].seek(0)
+            # children can emit non-UTF-8 bytes (native/libtpu log garbage);
+            # never let a decode error eat the other children's logs
+            text = logs[pid].read().decode("utf-8", errors="replace")
+            for line in text.splitlines():
                 print(f"[proc {pid}] {line}", flush=True)
+            logs[pid].close()
         if p.returncode != 0 and rc == 0:
             rc = p.returncode
     return rc

@@ -28,6 +28,9 @@ def main(argv=None) -> int:
     ap.add_argument("--max-steps", type=int, default=50)
     ap.add_argument("--cpu-mesh", type=int, default=0)
     ap.add_argument("--out-dir", type=str, default="baselines_out")
+    ap.add_argument("--fresh", action="store_true",
+                    help="truncate results.jsonl first (default appends), so "
+                         "stale rows from older code can't shadow a re-run")
     args = ap.parse_args(argv)
 
     if args.cpu_mesh:
@@ -47,7 +50,7 @@ def main(argv=None) -> int:
     os.makedirs(args.out_dir, exist_ok=True)
     results_path = os.path.join(args.out_dir, "results.jsonl")
     rc = 0
-    with open(results_path, "a") as fh:
+    with open(results_path, "w" if args.fresh else "a") as fh:
         for name in PRESETS:
             overrides = dict(max_steps=args.max_steps, eval_freq=0,
                              train_dir="", log_every=10**9)
