@@ -44,7 +44,14 @@ PREC = jax.lax.Precision.HIGHEST
 # rank-deficient cases (< s actually-corrupt rows) rank rows identically on
 # both paths. Must sit well above float32 epsilon — see the normalisation
 # comment in decode().
-LOCATOR_RIDGE = 1e-4
+# Relative singular-value cutoff for the locator least-squares (σ below
+# rcond·σmax truncated). Shared with the native decoder (native/coding.cpp
+# locator_alpha, which applies the equivalent rcond² eigenvalue cutoff on its
+# float64 gram) so jit and host decodes rank borderline rank-deficient rows
+# identically. Sits well above f32 σ noise (~1e-7·σmax) and well below the
+# locator system's genuine σmin (cond(A) is O(1e3) for corrupt-row spreads
+# seen at n≤32).
+LOCATOR_RCOND = 1e-5
 
 
 # --------------------------------------------------------------------------
@@ -186,25 +193,29 @@ def encode_shared(code: CyclicCode, batch_grads: jnp.ndarray):
 # c_coding.cpp:15-84)
 # --------------------------------------------------------------------------
 
-def _complex_solve(a_re, a_im, b_re, b_im, ridge: float = 0.0):
+def _complex_solve(a_re, a_im, b_re, b_im, rcond: float = 0.0):
     """Solve complex A x = b via the real 2m×2m block embedding.
 
     [[Ar, -Ai], [Ai, Ar]] [xr; xi] = [br; bi]. LU-based jnp.linalg.solve is
     supported on TPU; the systems here are at most (n-2s) × (n-2s).
 
-    ridge > 0 switches to regularised normal equations, for systems that can
-    be genuinely rank-deficient — the error-locator Hankel system loses rank
-    when fewer than s rows are actually corrupt; the reference used an SVD
-    least-squares there for the same reason (c_coding.cpp:81).
+    rcond > 0 switches to SVD-truncated least squares (singular values below
+    rcond·σmax zeroed), for systems that can be genuinely rank-deficient —
+    the error-locator Hankel system loses rank when fewer than s rows are
+    actually corrupt; the reference used an SVD least-squares there for the
+    same reason (c_coding.cpp:81). Unlike a fixed ridge, truncation leaves
+    full-rank systems exact, so corrupt-row locator magnitudes stay orders
+    of magnitude below honest rows' instead of being ridge-biased toward
+    them. SVD on the embedded system (not its gram) keeps the threshold
+    meaningful in f32: the gram squares the condition number.
     """
     m = a_re.shape[0]
     top = jnp.concatenate([a_re, -a_im], axis=1)
     bot = jnp.concatenate([a_im, a_re], axis=1)
     big = jnp.concatenate([top, bot], axis=0)
     rhs = jnp.concatenate([b_re, b_im], axis=0)
-    if ridge > 0.0:
-        gram = jnp.matmul(big.T, big, precision=PREC) + ridge * jnp.eye(2 * m, dtype=big.dtype)
-        x = jnp.linalg.solve(gram, jnp.matmul(big.T, rhs, precision=PREC))
+    if rcond > 0.0:
+        x, _, _, _ = jnp.linalg.lstsq(big, rhs, rcond=rcond)
     else:
         x = jnp.linalg.solve(big, rhs)
     return x[:m], x[m:]
@@ -237,16 +248,16 @@ def _locate_v(code: CyclicCode, e_re: jnp.ndarray, e_im: jnp.ndarray,
         b_idx = 2 * s - rows - 1
         b_re, b_im = e2_re[b_idx], e2_im[b_idx]
         # α is invariant to a common scaling of (A, b); normalising by the
-        # syndrome magnitude makes the ridge scale-free. The ridge must sit
-        # well above float32 epsilon: with fewer than s corrupt rows the
-        # Hankel system is genuinely rank-deficient (geometric syndromes) and
-        # a sub-epsilon ridge leaves the float32 gram numerically singular
-        # (NaN locator). α only *ranks* rows, so the O(1e-4) perturbation is
-        # harmless: corrupt-row magnitudes stay ~1e-8 vs honest ~1.
+        # syndrome magnitude makes the truncation threshold scale-free. With
+        # fewer than s corrupt rows the Hankel system is genuinely
+        # rank-deficient (geometric syndromes); the truncated pseudoinverse
+        # keeps the solve NaN-free there while staying exact (f32 exact) on
+        # full-rank systems, so corrupt-row locator magnitudes sit ~1e-5 vs
+        # honest ~1.
         scale = jnp.maximum(jnp.max(e2_re**2 + e2_im**2) ** 0.5, 1e-30)
         alpha_re, alpha_im = _complex_solve(
             a_re / scale, a_im / scale, b_re / scale, b_im / scale,
-            ridge=LOCATOR_RIDGE,
+            rcond=LOCATOR_RCOND,
         )
 
         # 4. locator polynomial p(z) = z^s - Σ α_j z^j, roots at corrupt rows
@@ -260,6 +271,15 @@ def _locate_v(code: CyclicCode, e_re: jnp.ndarray, e_im: jnp.ndarray,
         mag = val_re**2 + val_im**2
     else:
         mag = jnp.ones((n,), jnp.float32)
+
+    # Deterministic tie-break: honest rows equidistant from a locator root
+    # tie exactly (DFT-grid symmetry), and float noise would break the tie
+    # differently per projection — per-layer decodes would then pick
+    # different (all equally valid) honest sets. An index-monotone bias far
+    # above float noise (~1e-7·mean) and far below any honest magnitude
+    # (≳5e-2·mean) pins the choice, identically in the jit and native
+    # decoders (native/coding.cpp draco_cyclic_decode).
+    mag = mag + jnp.arange(n, dtype=mag.dtype) * ((1e-3 / n) * jnp.mean(mag))
 
     # 5. recombination vector v supported on n-2s located-honest rows,
     #    v^T C1[idx] = e1^T  (fixed-shape stand-in for the reference's

@@ -1,0 +1,69 @@
+"""Honest device timing through asynchronous / remote PJRT backends.
+
+On a directly-attached TPU, ``jax.block_until_ready`` is a true execution
+barrier. Behind remote-dispatch backends (e.g. the dev-tunnel plugin used
+for single-chip access here) it only waits for dispatch: timing loops built
+on it report launch latency (~0.02 ms regardless of workload — measured
+implied throughput of 88,000 TFLOPS on a 197-TFLOP chip). The only barrier
+that provably waits for execution everywhere is a device→host fetch of
+result bytes.
+
+Protocol (used by bench.py and tools/tpu_kernel_check.py):
+
+  1. measure the host round-trip latency on an already-ready buffer,
+  2. enqueue all reps (dependency-free launches back-pressure fine; for
+     per-step numbers of a training loop, fold the steps into ONE jitted
+     ``lax.scan`` so Python dispatch is off the timed path entirely),
+  3. synchronise by fetching one scalar of the final output,
+  4. subtract the round-trip latency.
+
+Verified physical on TPU v5e: bf16 4096³ matmul times at 187 TFLOPS (95% of
+peak) under this protocol vs 75,000+ "TFLOPS" under block_until_ready.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def fetch_scalar(out) -> float:
+    """Device→host fetch of one element of the first array leaf — the
+    execution barrier that works on remote backends too."""
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    return float(jnp.ravel(leaf)[0])
+
+
+def measure_rtt(reps: int = 10) -> float:
+    """Seconds of pure host↔device round-trip on an already-ready buffer
+    (median of ``reps`` samples — tunnel RTT has multi-ms outliers)."""
+    tiny = jnp.zeros((1,), jnp.float32)
+    fetch_scalar(tiny)  # materialise + first-fetch path
+    samples = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fetch_scalar(tiny)
+        samples.append(time.perf_counter() - t0)
+    samples.sort()
+    return samples[len(samples) // 2]
+
+
+def timeit_device(fn, *args, reps: int = 30, rtt: float | None = None) -> float:
+    """Average seconds per ``fn(*args)`` call with execution-barrier sync.
+
+    Warms up (compile + first run), enqueues ``reps`` launches, fetches one
+    scalar of the last output, subtracts the measured round trip. For
+    multi-step training loops prefer folding steps into one jitted scan and
+    timing that single call.
+    """
+    if rtt is None:
+        rtt = measure_rtt()
+    out = fn(*args)
+    fetch_scalar(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    fetch_scalar(out)
+    return max((time.perf_counter() - t0 - rtt) / reps, 0.0)

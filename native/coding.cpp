@@ -9,9 +9,10 @@
 // (b) a host fallback when no accelerator is attached.
 //
 // No Eigen: the systems are at most (n-2s)x(n-2s); hand-rolled complex
-// Gaussian elimination with partial pivoting + ridge-regularised normal
-// equations (mirroring the jnp path's rank-deficiency handling, which in
-// turn mirrors the reference's SVD least-squares, c_coding.cpp:81).
+// Gaussian elimination with partial pivoting + a truncated-eigendecomposition
+// pseudoinverse for the rank-deficiency-prone locator solve (mirroring the
+// jnp path's handling, which in turn mirrors the reference's SVD
+// least-squares, c_coding.cpp:81).
 
 #include <algorithm>
 #include <cmath>
@@ -57,25 +58,93 @@ bool solve_ge(std::vector<cd>& a, std::vector<cd>& b, int m) {
   return true;
 }
 
-// Ridge-regularised least squares via normal equations:
-// x = (A^H A + ridge I)^{-1} A^H b.  A is m x m.
-bool solve_ridge(const std::vector<cd>& a, const std::vector<cd>& b,
-                 std::vector<cd>& x, int m, double ridge) {
-  std::vector<cd> gram(m * m);
-  std::vector<cd> rhs(m);
+// Truncated-pseudoinverse least squares via eigendecomposition of the
+// normal-equations gram: x = V f(Λ) V^T A^T b with 1/λ zeroed below
+// (rcond·σmax)².  Matches draco_tpu.coding.cyclic._complex_solve's rcond
+// branch (SVD-truncated lstsq, same relative singular-value threshold —
+// the float64 gram here resolves σ down to ~1e-8·σmax, far below the
+// cutoff): exact on full-rank systems, NaN-free min-norm solve on
+// rank-deficient ones (fewer than s corrupt rows).  A is m x m complex,
+// handled as the real symmetric 2m x 2m embedding; eigendecomposition by
+// cyclic Jacobi (systems are tiny).
+bool solve_trunc(const std::vector<cd>& a, const std::vector<cd>& b,
+                 std::vector<cd>& x, int m, double rcond) {
+  int d = 2 * m;
+  std::vector<double> B(d * d), r(d);
   for (int i = 0; i < m; ++i) {
     for (int j = 0; j < m; ++j) {
-      cd acc(0.0, 0.0);
-      for (int k = 0; k < m; ++k) acc += std::conj(a[k * m + i]) * a[k * m + j];
-      if (i == j) acc += ridge;
-      gram[i * m + j] = acc;
+      double re = a[i * m + j].real(), im = a[i * m + j].imag();
+      B[i * d + j] = re;
+      B[i * d + (m + j)] = -im;
+      B[(m + i) * d + j] = im;
+      B[(m + i) * d + (m + j)] = re;
     }
-    cd acc(0.0, 0.0);
-    for (int k = 0; k < m; ++k) acc += std::conj(a[k * m + i]) * b[k];
-    rhs[i] = acc;
+    r[i] = b[i].real();
+    r[m + i] = b[i].imag();
   }
-  if (!solve_ge(gram, rhs, m)) return false;
-  x = rhs;
+  std::vector<double> G(d * d), atb(d);
+  for (int i = 0; i < d; ++i) {
+    for (int j = 0; j < d; ++j) {
+      double acc = 0.0;
+      for (int k = 0; k < d; ++k) acc += B[k * d + i] * B[k * d + j];
+      G[i * d + j] = acc;
+    }
+    double acc = 0.0;
+    for (int k = 0; k < d; ++k) acc += B[k * d + i] * r[k];
+    atb[i] = acc;
+  }
+  std::vector<double> V(d * d, 0.0);
+  for (int i = 0; i < d; ++i) V[i * d + i] = 1.0;
+  for (int sweep = 0; sweep < 64; ++sweep) {
+    double off = 0.0;
+    for (int p = 0; p < d; ++p)
+      for (int q = p + 1; q < d; ++q) off += G[p * d + q] * G[p * d + q];
+    if (off < 1e-28) break;
+    for (int p = 0; p < d; ++p) {
+      for (int q = p + 1; q < d; ++q) {
+        double apq = G[p * d + q];
+        if (std::abs(apq) < 1e-300) continue;
+        double theta = (G[q * d + q] - G[p * d + p]) / (2.0 * apq);
+        double t = (theta >= 0 ? 1.0 : -1.0) /
+                   (std::abs(theta) + std::sqrt(theta * theta + 1.0));
+        double c = 1.0 / std::sqrt(t * t + 1.0), sn = t * c;
+        for (int k = 0; k < d; ++k) {
+          double gkp = G[k * d + p], gkq = G[k * d + q];
+          G[k * d + p] = c * gkp - sn * gkq;
+          G[k * d + q] = sn * gkp + c * gkq;
+        }
+        for (int k = 0; k < d; ++k) {
+          double gpk = G[p * d + k], gqk = G[q * d + k];
+          G[p * d + k] = c * gpk - sn * gqk;
+          G[q * d + k] = sn * gpk + c * gqk;
+        }
+        for (int k = 0; k < d; ++k) {
+          double vkp = V[k * d + p], vkq = V[k * d + q];
+          V[k * d + p] = c * vkp - sn * vkq;
+          V[k * d + q] = sn * vkp + c * vkq;
+        }
+      }
+    }
+  }
+  double wmax = 0.0;
+  for (int i = 0; i < d; ++i) wmax = std::max(wmax, G[i * d + i]);
+  // rcond is a relative *singular-value* cutoff (σ = sqrt λ of the gram);
+  // squared here so the threshold matches the jit path's SVD lstsq rcond.
+  double cutoff = rcond * rcond * std::max(wmax, 0.0);
+  std::vector<double> tmp(d, 0.0), xr(d, 0.0);
+  for (int i = 0; i < d; ++i) {
+    double acc = 0.0;
+    for (int k = 0; k < d; ++k) acc += V[k * d + i] * atb[k];
+    double w = G[i * d + i];
+    tmp[i] = (w > cutoff && w > 0.0) ? acc / w : 0.0;
+  }
+  for (int k = 0; k < d; ++k) {
+    double acc = 0.0;
+    for (int i = 0; i < d; ++i) acc += V[k * d + i] * tmp[i];
+    xr[k] = acc;
+  }
+  x.resize(m);
+  for (int i = 0; i < m; ++i) x[i] = cd(xr[i], xr[m + i]);
   return true;
 }
 
@@ -113,9 +182,9 @@ bool locator_alpha(int n, int s, const cd* e, std::vector<cd>& alpha) {
     for (int j = 0; j < s; ++j) a[i * s + j] = e2[s - 1 - i + j] / scale;
     b[i] = e2[2 * s - 1 - i] / scale;
   }
-  // kept identical to draco_tpu.coding.cyclic.LOCATOR_RIDGE so native and
+  // kept identical to draco_tpu.coding.cyclic.LOCATOR_RCOND so native and
   // jit decodes rank borderline (rank-deficient) rows the same way
-  return solve_ridge(a, b, alpha, s, 1e-4);
+  return solve_trunc(a, b, alpha, s, 1e-5);
 }
 
 }  // namespace
@@ -199,6 +268,16 @@ int draco_cyclic_decode_present(int n, int s, long long d,
       val += zp;  // z^s
       mag[t] = std::norm(val);
     }
+  }
+
+  // Deterministic tie-break matching draco_tpu.coding.cyclic._locate_v:
+  // index-monotone bias pins the honest-set choice when grid-symmetric rows
+  // tie in exact arithmetic (must stay identical across jit/native paths).
+  {
+    double mean = 0.0;
+    for (int i = 0; i < n; ++i) mean += mag[i];
+    mean /= n;
+    for (int i = 0; i < n; ++i) mag[i] += i * (1e-3 / n) * mean;
   }
 
   // 5. recombination v on the top n-2s rows by locator magnitude (corrupt

@@ -27,17 +27,54 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def _timeit(fn, *args, reps=10, warmup=2):
-    import jax
+def _loop_time(step, carry, consts=(), reps=20):
+    """Per-iteration seconds of ``step(carry, *consts)`` chained ``reps``
+    times inside ONE jitted fori_loop, synchronised by a device→host fetch.
 
-    for _ in range(warmup):
-        out = fn(*args)
-    jax.block_until_ready(out)
+    Per-launch timing is meaningless here twice over: block_until_ready is
+    not an execution barrier on remote-dispatch backends, and the ops under
+    test (sub-ms) drown in the ~70 ms tunnel round trip. The carry gives
+    each iteration a data dependency on the last — it must consume EVERY
+    output element (full-output reductions, which XLA fuses into the
+    producers for free; see the feedback-discipline note at the call sites)
+    so the loop can be neither elided nor partially dead-code-eliminated,
+    and one fetch covers all reps.
+
+    Large operands MUST come in via ``consts`` (jit arguments), not closure:
+    a closed-over concrete array is baked into the HLO as a constant, and on
+    remote-compile backends a 357 MB constant blows the compile-request
+    size limit (observed HTTP 413).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from draco_tpu.utils.timing import fetch_scalar, measure_rtt
+
+    # dynamic trip count: one executable serves the pilot run and the
+    # scaled-up run (fori_loop lowers to while_loop when bounds are traced),
+    # so adapting reps costs no recompile through the slow remote compiler
+    @jax.jit
+    def loop(c, consts, n_iters):
+        return jax.lax.fori_loop(0, n_iters, lambda i, c: step(c, *consts), c)
+
+    n0 = jnp.asarray(reps, jnp.int32)
+    out = loop(carry, consts, n0)
+    fetch_scalar(out)
+    rtt = measure_rtt()
     t0 = time.perf_counter()
-    for _ in range(reps):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / reps
+    out = loop(carry, consts, n0)
+    fetch_scalar(out)
+    total = time.perf_counter() - t0 - rtt
+    # sub-ms ops drown in RTT jitter: scale reps until the loop body is
+    # ≳1.5 s of device time, then re-measure with the same executable
+    if total < 1.5:
+        scale = min(int(1.5 / max(total, 0.01)) + 1, 200)
+        n1 = jnp.asarray(reps * scale, jnp.int32)
+        t0 = time.perf_counter()
+        out = loop(carry, consts, n1)
+        fetch_scalar(out)
+        return max(time.perf_counter() - t0 - rtt, 0.0) / (reps * scale)
+    return max(total, 0.0) / reps
 
 
 def check_kernels(d, n=8, interpret=False, reps=10):
@@ -51,13 +88,23 @@ def check_kernels(d, n=8, interpret=False, reps=10):
     w_re = jnp.asarray(r.randn(n, n).astype(np.float32))
     w_im = jnp.asarray(r.randn(n, n).astype(np.float32))
     g = jnp.asarray(r.randn(n, d).astype(np.float32))
+    # distinct imaginary-part matrix: passing the SAME array for re and im
+    # lets XLA CSE the duplicate read in the transparent jnp path (one HBM
+    # pass instead of two) while the opaque Pallas kernel still streams both
+    # block inputs — which would bias the comparison
+    g2 = jnp.asarray(r.randn(n, d).astype(np.float32))
     f = jnp.asarray(r.randn(d).astype(np.float32))
     v_re = jnp.asarray(r.randn(n).astype(np.float32))
     v_im = jnp.asarray(r.randn(n).astype(np.float32))
-    jax.block_until_ready((w_re, w_im, g, f, v_re, v_im))
+    jax.block_until_ready((w_re, w_im, g, g2, f, v_re, v_im))
 
     fused = dict(force=True, interpret=interpret) if interpret else dict(force=True)
     out = {"d": d, "n": n, "interpret": interpret, "kernels": {}}
+
+    def bench_pair(fused_step, unfused_step, carry, consts):
+        t_f = _loop_time(fused_step, carry, consts, reps=reps)
+        t_u = _loop_time(unfused_step, carry, consts, reps=reps)
+        return t_f, t_u
 
     # ---- complex_matmul (encode) ----
     a_re, a_im = coded.complex_matmul(w_re, w_im, g, **fused)
@@ -67,8 +114,21 @@ def check_kernels(d, n=8, interpret=False, reps=10):
         float(jnp.max(jnp.abs(a_im - b_im))),
     )
     scale = float(jnp.max(jnp.abs(b_re))) or 1.0
-    t_f = _timeit(lambda: coded.complex_matmul(w_re, w_im, g, **fused), reps=reps)
-    t_u = _timeit(lambda: coded.complex_matmul(w_re, w_im, g, force=False), reps=reps)
+
+    # Feedback discipline: the carry must depend on EVERY element of every
+    # output, or XLA dead-code-eliminates the unused part of the transparent
+    # jnp path (observed: a [:, :n]-slice feedback let XLA shrink the whole
+    # (n,d) matmul to n columns, reporting 0.0 ms) while the opaque Pallas
+    # custom call cannot be pruned — full-output reductions (which XLA fuses
+    # into the producer) keep the comparison fair.
+    def _mm_step(kw):
+        def step(gc, wr, wi):
+            o_re, o_im = coded.complex_matmul(wr, wi, gc, **kw)
+            return o_re + 1e-30 * o_im  # full outputs feed the next iter
+        return step
+
+    t_f, t_u = bench_pair(_mm_step(fused), _mm_step(dict(force=False)),
+                          g, (w_re, w_im))
     out["kernels"]["complex_matmul"] = {
         "max_abs_err": err, "rel_err": err / scale,
         "fused_ms": round(t_f * 1e3, 4), "unfused_ms": round(t_u * 1e3, 4),
@@ -76,15 +136,21 @@ def check_kernels(d, n=8, interpret=False, reps=10):
     }
 
     # ---- complex_project (decode in) ----
-    p_re, p_im = coded.complex_project(g, g, f, **fused)
-    q_re, q_im = coded.complex_project(g, g, f, force=False)
+    p_re, p_im = coded.complex_project(g, g2, f, **fused)
+    q_re, q_im = coded.complex_project(g, g2, f, force=False)
     err = max(
         float(jnp.max(jnp.abs(p_re - q_re))),
         float(jnp.max(jnp.abs(p_im - q_im))),
     )
     scale = float(jnp.max(jnp.abs(q_re))) or 1.0
-    t_f = _timeit(lambda: coded.complex_project(g, g, f, **fused), reps=reps)
-    t_u = _timeit(lambda: coded.complex_project(g, g, f, force=False), reps=reps)
+
+    def _pj_step(kw):
+        def step(fv, g):
+            e_re, e_im = coded.complex_project(g, g2, fv, **kw)
+            return fv + 1e-30 * (jnp.sum(e_re) + jnp.sum(e_im))
+        return step
+
+    t_f, t_u = bench_pair(_pj_step(fused), _pj_step(dict(force=False)), f, (g,))
     out["kernels"]["complex_project"] = {
         "max_abs_err": err, "rel_err": err / scale,
         "fused_ms": round(t_f * 1e3, 4), "unfused_ms": round(t_u * 1e3, 4),
@@ -92,12 +158,20 @@ def check_kernels(d, n=8, interpret=False, reps=10):
     }
 
     # ---- complex_recombine (decode out) ----
-    c = coded.complex_recombine(v_re, v_im, g, g, **fused)
-    e = coded.complex_recombine(v_re, v_im, g, g, force=False)
+    c = coded.complex_recombine(v_re, v_im, g, g2, **fused)
+    e = coded.complex_recombine(v_re, v_im, g, g2, force=False)
     err = float(jnp.max(jnp.abs(c - e)))
     scale = float(jnp.max(jnp.abs(e))) or 1.0
-    t_f = _timeit(lambda: coded.complex_recombine(v_re, v_im, g, g, **fused), reps=reps)
-    t_u = _timeit(lambda: coded.complex_recombine(v_re, v_im, g, g, force=False), reps=reps)
+
+    def _rc_step(kw):
+        def step(cv, g):
+            vr, vi = cv
+            s = jnp.sum(coded.complex_recombine(vr, vi, g, g2, **kw))
+            return (vr + 1e-30 * s, vi - 1e-30 * s)
+        return step
+
+    t_f, t_u = bench_pair(_rc_step(fused), _rc_step(dict(force=False)),
+                          (v_re, v_im), (g,))
     out["kernels"]["complex_recombine"] = {
         "max_abs_err": err, "rel_err": err / scale,
         "fused_ms": round(t_f * 1e3, 4), "unfused_ms": round(t_u * 1e3, 4),
@@ -114,10 +188,15 @@ def sweep_tile(d, n=8, interpret=False, tiles=(1024, 2048, 4096, 8192, 16384)):
 
     r = np.random.RandomState(0)
     g = jnp.asarray(r.randn(n, d).astype(np.float32))
+    g2 = jnp.asarray(r.randn(n, d).astype(np.float32))
     f = jnp.asarray(r.randn(d).astype(np.float32))
     rows = []
     orig = coded.TILE_D
     kw = dict(force=True, interpret=interpret) if interpret else dict(force=True)
+    def step(fv, g, g2):
+        e_re, e_im = coded.complex_project(g, g2, fv, **kw)
+        return fv + 1e-30 * (jnp.sum(e_re) + jnp.sum(e_im))
+
     try:
         for tile in tiles:
             coded.TILE_D = tile
@@ -125,8 +204,11 @@ def sweep_tile(d, n=8, interpret=False, tiles=(1024, 2048, 4096, 8192, 16384)):
             # clear to force re-trace with the module-level tile)
             coded._project_pallas.clear_cache()
             coded._matmul_pallas.clear_cache()
-            t = _timeit(lambda: coded.complex_project(g, g, f, **kw), reps=5)
-            rows.append({"tile_d": tile, "project_ms": round(t * 1e3, 4)})
+            try:
+                t = _loop_time(step, f, (g, g2), reps=10)
+                rows.append({"tile_d": tile, "project_ms": round(t * 1e3, 4)})
+            except Exception as exc:  # a tile can fail compile (vmem limits)
+                rows.append({"tile_d": tile, "error": repr(exc)[:200]})
     finally:
         coded.TILE_D = orig
         coded._project_pallas.clear_cache()
@@ -158,9 +240,12 @@ def main(argv=None) -> int:
     import jax
 
     dev = jax.devices()[0]
+    from draco_tpu.ops import coded
+
     report = {
         "platform": dev.platform,
         "device_kind": getattr(dev, "device_kind", dev.platform),
+        "pallas_supported": coded.use_pallas(),
         "pallas_interpret": interpret,
         "sizes": [],
     }
