@@ -159,6 +159,29 @@ def run(cfg_kwargs, ds, mesh, steps, warmup=1, reps=2, want_flops=False):
     )
     step_fn = tr.setup.train_step
 
+    if jax.devices()[0].platform == "cpu":
+        # CPU mesh (smoke runs): block_until_ready IS a real execution
+        # barrier locally, and XLA:CPU executes conv thunks inside
+        # while-loop bodies single-threaded — a scanned ResNet step runs
+        # ~40× slower than the same step dispatched eagerly (measured:
+        # 3-step scans timing out at 20 min vs 10 s/step eager). Python
+        # per-step loop is both honest and usable here.
+        x0 = [xs[i] for i in range(steps)]
+        y0 = [ys[i] for i in range(steps)]
+        m0 = [ms[i] for i in range(steps)]
+        compiled = step_fn.lower(state, x0[0], y0[0], m0[0]).compile()
+        flops = _compiled_flops(compiled) if want_flops else None
+        st, metrics = compiled(state, x0[0], y0[0], m0[0])
+        jax.block_until_ready(st.params)  # compile + settle
+        t0 = time.perf_counter()
+        for i in range(steps):
+            st, metrics = compiled(st, x0[i], y0[i], m0[i])
+        jax.block_until_ready(st.params)
+        dt = (time.perf_counter() - t0) / steps
+        loss = float(metrics["loss"])
+        tr.close()
+        return dt, loss, flops
+
     def loop(state, xs, ys, ms):
         def body(st, batch):
             x, y, mask = batch

@@ -50,6 +50,50 @@ def measure_rtt(reps: int = 10) -> float:
     return samples[len(samples) // 2]
 
 
+def timeit_chained(step, carry, consts=(), reps: int = 20,
+                   target_s: float = 1.5) -> float:
+    """Per-iteration seconds of ``step(carry, *consts)`` chained inside ONE
+    jitted fori_loop, synchronised by a device→host fetch minus RTT.
+
+    The one honest protocol for sub-ms ops on remote backends; shared by
+    tools/tpu_kernel_check.py and tools/tpu_perf.py. Requirements on
+    ``step`` (violations produce fantasy numbers):
+
+      * big operands enter via ``consts`` (jit arguments) — a closed-over
+        concrete array bakes into the HLO and 413s the remote compiler;
+      * the carry must depend on every output of the op under test through
+        a NON-LINEAR function (e.g. ``jnp.sum(out**2)``) or by carrying the
+        full output. A slice feedback lets XLA dead-code-eliminate the rest
+        of the op; a *linear* reduction (plain ``sum``) of a linear op lets
+        XLA reassociate (``sum(R@f) == colsum(R)·f``) and hoist the O(n·d)
+        work out of the loop — observed as 0.0 ms readings.
+
+    The trip count is a traced argument (fori_loop lowers to while_loop),
+    so adaptively scaling reps until the loop body is ~``target_s`` of
+    device time costs no recompile.
+    """
+    @jax.jit
+    def loop(c, consts, n_iters):
+        return jax.lax.fori_loop(0, n_iters, lambda i, c: step(c, *consts), c)
+
+    n0 = jnp.asarray(reps, jnp.int32)
+    out = loop(carry, consts, n0)
+    fetch_scalar(out)
+    rtt = measure_rtt()
+    t0 = time.perf_counter()
+    out = loop(carry, consts, n0)
+    fetch_scalar(out)
+    total = time.perf_counter() - t0 - rtt
+    if total < target_s:
+        scale = min(int(target_s / max(total, 0.01)) + 1, 200)
+        n1 = jnp.asarray(reps * scale, jnp.int32)
+        t0 = time.perf_counter()
+        out = loop(carry, consts, n1)
+        fetch_scalar(out)
+        return max(time.perf_counter() - t0 - rtt, 0.0) / (reps * scale)
+    return max(total, 0.0) / reps
+
+
 def timeit_device(fn, *args, reps: int = 30, rtt: float | None = None) -> float:
     """Average seconds per ``fn(*args)`` call with execution-barrier sync.
 

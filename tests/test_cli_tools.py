@@ -183,3 +183,47 @@ def test_compressed_checkpoint_rejects_multihost(monkeypatch):
     monkeypatch.setattr(jax, "process_count", lambda: 2)
     with pytest.raises(ValueError, match="single-host"):
         ckpt.save("/tmp/nowhere", 1, {"a": np.zeros(3)}, compress=True)
+
+
+def test_timing_protocol_helpers():
+    """fetch_scalar syncs through pytrees; timeit_device returns a sane
+    per-call time for a known-cost function (utils/timing.py — the honest
+    protocol bench.py and the TPU tools rely on)."""
+    import jax.numpy as jnp
+
+    from draco_tpu.utils import timing
+
+    out = {"a": jnp.arange(4.0), "b": (jnp.ones((2, 2)),)}
+    assert timing.fetch_scalar(out) == 0.0
+
+    rtt = timing.measure_rtt(reps=5)
+    assert 0.0 <= rtt < 5.0
+
+    def f(x):
+        return x * 2.0
+
+    dt = timing.timeit_device(f, jnp.ones((8, 8)), reps=5, rtt=rtt)
+    assert 0.0 <= dt < 5.0
+
+
+def test_time_to_acc_tool(tmp_path):
+    """tools/time_to_acc.py converges on the synthetic set and records a
+    monotone wall-clock curve (stand-in for the reference's evaluator
+    convergence oracle, distributed_evaluator.py:92-110)."""
+    import json
+
+    from tools import time_to_acc
+
+    out = tmp_path / "tta.json"
+    rc = time_to_acc.main([
+        "--out", str(out), "--network", "FC", "--dataset", "synthetic-mnist",
+        "--approach", "baseline", "--worker-fail", "0", "--err-mode", "rev_grad",
+        "--num-workers", "4", "--batch-size", "16", "--lr", "0.05",
+        "--target", "0.5", "--eval-every", "10", "--max-steps", "120",
+    ])
+    rep = json.loads(out.read_text())
+    assert rc == 0 and rep["reached"] is not None
+    assert rep["reached"]["prec1_test"] >= 0.5
+    walls = [c["train_wall_s"] for c in rep["curve"]]
+    assert walls == sorted(walls)
+    assert rep["real_data_available"] is False

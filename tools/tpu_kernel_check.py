@@ -28,53 +28,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def _loop_time(step, carry, consts=(), reps=20):
-    """Per-iteration seconds of ``step(carry, *consts)`` chained ``reps``
-    times inside ONE jitted fori_loop, synchronised by a device→host fetch.
+    """Chained in-jit per-iteration timing — see
+    draco_tpu.utils.timing.timeit_chained for the protocol and its
+    feedback-discipline requirements (non-linear full-output feedback,
+    operands via consts, adaptive trip count)."""
+    from draco_tpu.utils.timing import timeit_chained
 
-    Per-launch timing is meaningless here twice over: block_until_ready is
-    not an execution barrier on remote-dispatch backends, and the ops under
-    test (sub-ms) drown in the ~70 ms tunnel round trip. The carry gives
-    each iteration a data dependency on the last — it must consume EVERY
-    output element (full-output reductions, which XLA fuses into the
-    producers for free; see the feedback-discipline note at the call sites)
-    so the loop can be neither elided nor partially dead-code-eliminated,
-    and one fetch covers all reps.
-
-    Large operands MUST come in via ``consts`` (jit arguments), not closure:
-    a closed-over concrete array is baked into the HLO as a constant, and on
-    remote-compile backends a 357 MB constant blows the compile-request
-    size limit (observed HTTP 413).
-    """
-    import jax
-    import jax.numpy as jnp
-
-    from draco_tpu.utils.timing import fetch_scalar, measure_rtt
-
-    # dynamic trip count: one executable serves the pilot run and the
-    # scaled-up run (fori_loop lowers to while_loop when bounds are traced),
-    # so adapting reps costs no recompile through the slow remote compiler
-    @jax.jit
-    def loop(c, consts, n_iters):
-        return jax.lax.fori_loop(0, n_iters, lambda i, c: step(c, *consts), c)
-
-    n0 = jnp.asarray(reps, jnp.int32)
-    out = loop(carry, consts, n0)
-    fetch_scalar(out)
-    rtt = measure_rtt()
-    t0 = time.perf_counter()
-    out = loop(carry, consts, n0)
-    fetch_scalar(out)
-    total = time.perf_counter() - t0 - rtt
-    # sub-ms ops drown in RTT jitter: scale reps until the loop body is
-    # ≳1.5 s of device time, then re-measure with the same executable
-    if total < 1.5:
-        scale = min(int(1.5 / max(total, 0.01)) + 1, 200)
-        n1 = jnp.asarray(reps * scale, jnp.int32)
-        t0 = time.perf_counter()
-        out = loop(carry, consts, n1)
-        fetch_scalar(out)
-        return max(time.perf_counter() - t0 - rtt, 0.0) / (reps * scale)
-    return max(total, 0.0) / reps
+    return timeit_chained(step, carry, consts, reps=reps)
 
 
 def check_kernels(d, n=8, interpret=False, reps=10):
@@ -115,12 +75,13 @@ def check_kernels(d, n=8, interpret=False, reps=10):
     )
     scale = float(jnp.max(jnp.abs(b_re))) or 1.0
 
-    # Feedback discipline: the carry must depend on EVERY element of every
-    # output, or XLA dead-code-eliminates the unused part of the transparent
-    # jnp path (observed: a [:, :n]-slice feedback let XLA shrink the whole
-    # (n,d) matmul to n columns, reporting 0.0 ms) while the opaque Pallas
-    # custom call cannot be pruned — full-output reductions (which XLA fuses
-    # into the producer) keep the comparison fair.
+    # Feedback discipline (timing.timeit_chained): carry the full output or
+    # feed back a NON-LINEAR reduction of every output. Slice feedbacks get
+    # the op partially dead-code-eliminated; plain sums of these *linear*
+    # ops get reassociated and hoisted (sum(R@f) == colsum(R)·f — observed
+    # as 0.0 ms unfused readings). Squared sums force the full computation
+    # each iteration on the transparent XLA path, matching what the opaque
+    # Pallas call is already forced to do.
     def _mm_step(kw):
         def step(gc, wr, wi):
             o_re, o_im = coded.complex_matmul(wr, wi, gc, **kw)
@@ -145,12 +106,13 @@ def check_kernels(d, n=8, interpret=False, reps=10):
     scale = float(jnp.max(jnp.abs(q_re))) or 1.0
 
     def _pj_step(kw):
-        def step(fv, g):
+        def step(fv, g, g2):
             e_re, e_im = coded.complex_project(g, g2, fv, **kw)
-            return fv + 1e-30 * (jnp.sum(e_re) + jnp.sum(e_im))
+            return fv + 1e-30 * (jnp.sum(e_re**2) + jnp.sum(e_im**2))
         return step
 
-    t_f, t_u = bench_pair(_pj_step(fused), _pj_step(dict(force=False)), f, (g,))
+    t_f, t_u = bench_pair(_pj_step(fused), _pj_step(dict(force=False)),
+                          f, (g, g2))
     out["kernels"]["complex_project"] = {
         "max_abs_err": err, "rel_err": err / scale,
         "fused_ms": round(t_f * 1e3, 4), "unfused_ms": round(t_u * 1e3, 4),
@@ -164,14 +126,14 @@ def check_kernels(d, n=8, interpret=False, reps=10):
     scale = float(jnp.max(jnp.abs(e))) or 1.0
 
     def _rc_step(kw):
-        def step(cv, g):
+        def step(cv, g, g2):
             vr, vi = cv
-            s = jnp.sum(coded.complex_recombine(vr, vi, g, g2, **kw))
+            s = jnp.sum(coded.complex_recombine(vr, vi, g, g2, **kw) ** 2)
             return (vr + 1e-30 * s, vi - 1e-30 * s)
         return step
 
     t_f, t_u = bench_pair(_rc_step(fused), _rc_step(dict(force=False)),
-                          (v_re, v_im), (g,))
+                          (v_re, v_im), (g, g2))
     out["kernels"]["complex_recombine"] = {
         "max_abs_err": err, "rel_err": err / scale,
         "fused_ms": round(t_f * 1e3, 4), "unfused_ms": round(t_u * 1e3, 4),
@@ -195,7 +157,7 @@ def sweep_tile(d, n=8, interpret=False, tiles=(1024, 2048, 4096, 8192, 16384)):
     kw = dict(force=True, interpret=interpret) if interpret else dict(force=True)
     def step(fv, g, g2):
         e_re, e_im = coded.complex_project(g, g2, fv, **kw)
-        return fv + 1e-30 * (jnp.sum(e_re) + jnp.sum(e_im))
+        return fv + 1e-30 * (jnp.sum(e_re**2) + jnp.sum(e_im**2))
 
     try:
         for tile in tiles:

@@ -23,6 +23,7 @@ import argparse
 import json
 import os
 import sys
+import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -37,54 +38,35 @@ def step_ms(cfg_kwargs, ds, mesh, steps=10, reps=2):
 
 
 def phase_times(n, d, s, reps=20):
-    """Isolated encode / decode costs at gradient dimension d."""
-    import time
+    """Isolated encode / decode costs at gradient dimension d.
 
-    import jax
+    Timing and feedback discipline per draco_tpu.utils.timing.timeit_chained
+    (non-linear full-output feedback, operands via consts)."""
     import jax.numpy as jnp
     import numpy as np
 
     from draco_tpu.coding import cyclic as cyc
-    from draco_tpu.utils.timing import fetch_scalar, measure_rtt
+    from draco_tpu.utils.timing import timeit_chained
 
     code = cyc.build_cyclic_code(n, s)
     r = np.random.RandomState(0)
     g = jnp.asarray(r.randn(n, d).astype(np.float32))
     rf = jnp.asarray(r.randn(d).astype(np.float32))
 
-    def loop_time(step, carry, consts=()):
-        # big operands enter via jit args (consts), never closure — a
-        # closed-over concrete array becomes an HLO constant, which blows
-        # remote-compile request limits at ResNet-18 size
-        @jax.jit
-        def loop(c, consts):
-            return jax.lax.fori_loop(0, reps, lambda i, c: step(c, *consts), c)
-
-        out = loop(carry, consts)
-        fetch_scalar(out)
-        rtt = measure_rtt()
-        t0 = time.perf_counter()
-        out = loop(carry, consts)
-        fetch_scalar(out)
-        return max(time.perf_counter() - t0 - rtt, 0.0) / reps * 1e3
-
-    # feedback must consume EVERY output element (full reductions, fused by
-    # XLA into the producers) — slice feedbacks let XLA dead-code-eliminate
-    # the rest of the op and report fantasy times
     def enc_step(gc):
         e_re, e_im = cyc.encode_shared(code, gc)
-        return gc.at[0, 0].add(1e-30 * (jnp.sum(e_re) + jnp.sum(e_im)))
+        return gc.at[0, 0].add(1e-30 * (jnp.sum(e_re**2) + jnp.sum(e_im**2)))
 
-    enc_ms = loop_time(enc_step, g)
+    enc_ms = timeit_chained(enc_step, g, reps=reps) * 1e3
 
     e_re, e_im = cyc.encode_shared(code, g)
 
     def dec_step(carry, rf):
         er, ei = carry
         dec, honest = cyc.decode(code, er, ei, rf)
-        return (er.at[0, 0].add(1e-30 * jnp.sum(dec)), ei)
+        return (er.at[0, 0].add(1e-30 * jnp.sum(dec**2)), ei)
 
-    dec_ms = loop_time(dec_step, (e_re, e_im), (rf,))
+    dec_ms = timeit_chained(dec_step, (e_re, e_im), (rf,), reps=reps) * 1e3
     return enc_ms, dec_ms
 
 
@@ -154,7 +136,11 @@ def main(argv=None) -> int:
                                worker_fail=0),
     }
     for name, kw in variants.items():
+        print(f"[tpu_perf] measuring {name} ...", file=sys.stderr, flush=True)
+        t_var = time.time()
         ms, flops = step_ms(kw, ds, mesh, steps=args.steps)
+        print(f"[tpu_perf] {name}: {ms:.3f} ms/step ({time.time()-t_var:.0f}s)",
+              file=sys.stderr, flush=True)
         report[f"{name}_step_ms"] = round(ms, 3)
         if flops:
             report[f"{name}_flops_per_step"] = flops
@@ -170,6 +156,8 @@ def main(argv=None) -> int:
         TrainConfig(**variants["cyclic_s1"]), mesh, dataset_name=ds.name
     )
     d = setup.dim
+    print(f"[tpu_perf] isolated encode/decode phases at d={d} ...",
+          file=sys.stderr, flush=True)
     enc_ms, dec_ms = phase_times(args.num_workers, d, s=1)
     report["grad_dim"] = d
     report["encode_only_ms"] = round(enc_ms, 3)
