@@ -80,7 +80,12 @@ class TrainConfig:
 
     # --- long context / sequence parallelism (TPU-native addition; the
     # reference is CNN-only, SURVEY.md §5.7) ---
-    seq_shards: int = 1  # sp mesh-axis size; ring attention spans these
+    seq_shards: int = 1  # sp mesh-axis size; sequence parallelism spans these
+    # SP strategy: "ring" streams K/V blocks over ppermute hops (O(T·T/sp)
+    # peak scores, sp hops); "a2a" is Ulysses-style head-scatter all_to_all
+    # (2 collectives total, needs model_heads % sp == 0, materialises the
+    # full (T,T) score block per head group)
+    sp_attn: str = "ring"
     seq_len: int = 256  # tokens per sequence (global, pre-sharding)
     vocab: int = 256
     model_dim: int = 128
@@ -237,6 +242,17 @@ class TrainConfig:
             if self.seq_len % max(self.seq_shards, 1) != 0:
                 raise ValueError(
                     f"seq_len {self.seq_len} not divisible by seq_shards {self.seq_shards}"
+                )
+            if self.sp_attn not in ("ring", "a2a"):
+                raise ValueError(f"sp_attn must be ring|a2a, got {self.sp_attn}")
+            if (
+                self.sp_attn == "a2a"
+                and self.seq_shards > 1
+                and self.model_heads % self.seq_shards != 0
+            ):
+                raise ValueError(
+                    f"sp_attn=a2a needs model_heads % seq_shards == 0 "
+                    f"({self.model_heads} % {self.seq_shards})"
                 )
             if self.seq_len < 2 or self.vocab < 2:
                 raise ValueError("TransformerLM needs seq_len >= 2 and vocab >= 2")

@@ -5,15 +5,18 @@ parallelism over MPI ranks (SURVEY.md §2.3); its workloads are fixed-size
 CNNs, so it owes nothing for long sequences. This package makes the axes the
 reference lacks first-class in the TPU build:
 
-  * ``sp`` — sequence/context parallelism: ring attention (blockwise flash
-    attention with K/V blocks rotating over ICI via ``ppermute``) so one
-    logical worker's sequence can span many chips.
+  * ``sp`` — sequence/context parallelism, both standard strategies: ring
+    attention (blockwise flash attention with K/V blocks rotating over ICI
+    via ``ppermute``) and Ulysses-style all-to-all head-scatter attention —
+    so one logical worker's sequence can span many chips
+    (``config.sp_attn`` selects).
   * 2-D meshes ``(w, sp)`` where the coded worker axis composes with
     sequence parallelism: per-worker gradients are psum-reduced over ``sp``
     first, then Draco's coding/aggregation acts on whole per-worker
     gradients over ``w`` — exactly the composition note in SURVEY.md §5.7.
 """
 
+from draco_tpu.parallel.a2a_attention import a2a_attention
 from draco_tpu.parallel.mesh import SEQ_AXIS, make_mesh_2d
 from draco_tpu.parallel.ring_attention import dense_attention, ring_attention
 from draco_tpu.parallel.sp_step import build_sp_train_setup
@@ -21,6 +24,7 @@ from draco_tpu.parallel.sp_step import build_sp_train_setup
 __all__ = [
     "SEQ_AXIS",
     "make_mesh_2d",
+    "a2a_attention",
     "ring_attention",
     "dense_attention",
     "build_sp_train_setup",

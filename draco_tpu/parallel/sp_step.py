@@ -29,6 +29,7 @@ from draco_tpu import aggregation, attacks, optim, rng as drng
 from draco_tpu.coding import cyclic as cyclic_mod
 from draco_tpu.config import TrainConfig
 from draco_tpu.models.transformer import TransformerLM
+from draco_tpu.parallel.a2a_attention import a2a_attention
 from draco_tpu.parallel.mesh import SEQ_AXIS
 from draco_tpu.parallel.ring_attention import ring_attention
 from draco_tpu.runtime import WORKER_AXIS
@@ -67,7 +68,8 @@ def build_sp_train_setup(cfg: TrainConfig, mesh) -> SPTrainSetup:
         raise ValueError(f"seq_len {cfg.seq_len} not divisible by sp={sp}")
     t_local = cfg.seq_len // sp
 
-    attn = functools.partial(ring_attention, axis_name=SEQ_AXIS if sp > 1 else None)
+    attn_impl = ring_attention if cfg.sp_attn == "ring" else a2a_attention
+    attn = functools.partial(attn_impl, axis_name=SEQ_AXIS if sp > 1 else None)
     cdtype = jnp.dtype(cfg.compute_dtype)
     model = TransformerLM(
         vocab=cfg.vocab, dim=cfg.model_dim, heads=cfg.model_heads,

@@ -133,6 +133,10 @@ class Trainer:
                 if cfg.train_dir:
                     ckpt.save(cfg.train_dir, step, self.state,
                               compress=cfg.compress_ckpt)
+        # advance the cursor so a subsequent run(max_steps=...) continues
+        # instead of retraining from step 1 (block-wise callers:
+        # tools/time_to_acc.py)
+        self._start_step = max(self._start_step, n_steps + 1)
         return last
 
     # ---- eval ------------------------------------------------------------

@@ -81,10 +81,11 @@ def main(argv=None) -> int:
         while step <= args.max_steps:
             hi = min(step + args.eval_every - 1, args.max_steps)
             t0 = time.perf_counter()
-            last = tr.run(max_steps=hi)  # trainer resumes from its own cursor
+            # run() advances its cursor on return, so successive calls train
+            # blocks [step, hi] without retraining from step 1
+            last = tr.run(max_steps=hi)
             fetch_scalar(tr.state.params)
             train_s += max(time.perf_counter() - t0 - rtt, 0.0)
-            tr._start_step = hi + 1
             rec = tr.evaluate(hi)
             curve.append({
                 "step": hi,
