@@ -86,6 +86,9 @@ class TrainConfig:
     # (2 collectives total, needs model_heads % sp == 0, materialises the
     # full (T,T) score block per head group)
     sp_attn: str = "ring"
+    # tp mesh-axis size for the GSPMD tensor-parallel path (parallel/
+    # tp_step.py); composes with the coded worker axis on a (w, tp) mesh
+    tensor_shards: int = 1
     seq_len: int = 256  # tokens per sequence (global, pre-sharding)
     vocab: int = 256
     model_dim: int = 128
@@ -245,6 +248,21 @@ class TrainConfig:
                 )
             if self.sp_attn not in ("ring", "a2a"):
                 raise ValueError(f"sp_attn must be ring|a2a, got {self.sp_attn}")
+            if self.tensor_shards > 1:
+                if self.seq_shards > 1:
+                    raise ValueError(
+                        "tensor_shards and seq_shards are separate paths "
+                        "(tp_step vs sp_step); combine is not implemented"
+                    )
+                if (
+                    self.model_dim % self.tensor_shards
+                    or self.model_heads % self.tensor_shards
+                ):
+                    raise ValueError(
+                        f"tensor_shards={self.tensor_shards} must divide "
+                        f"model_dim {self.model_dim} and model_heads "
+                        f"{self.model_heads}"
+                    )
             if (
                 self.sp_attn == "a2a"
                 and self.seq_shards > 1
@@ -258,4 +276,6 @@ class TrainConfig:
                 raise ValueError("TransformerLM needs seq_len >= 2 and vocab >= 2")
         elif self.seq_shards > 1:
             raise ValueError("seq_shards > 1 requires network=TransformerLM")
+        elif self.tensor_shards > 1:
+            raise ValueError("tensor_shards > 1 requires network=TransformerLM")
         return self

@@ -14,18 +14,26 @@ reference lacks first-class in the TPU build:
     sequence parallelism: per-worker gradients are psum-reduced over ``sp``
     first, then Draco's coding/aggregation acts on whole per-worker
     gradients over ``w`` — exactly the composition note in SURVEY.md §5.7.
+  * ``tp`` — Megatron-style tensor parallelism on ``(w, tp)`` meshes,
+    written the GSPMD way (parameter sharding annotations, one plain jit,
+    XLA inserts the collectives) as the counterpart to the SP path's
+    explicit shard_map style (tp_step.py).
 """
 
 from draco_tpu.parallel.a2a_attention import a2a_attention
-from draco_tpu.parallel.mesh import SEQ_AXIS, make_mesh_2d
+from draco_tpu.parallel.mesh import SEQ_AXIS, TP_AXIS, make_mesh_2d, make_mesh_wtp
 from draco_tpu.parallel.ring_attention import dense_attention, ring_attention
 from draco_tpu.parallel.sp_step import build_sp_train_setup
+from draco_tpu.parallel.tp_step import build_tp_train_setup
 
 __all__ = [
     "SEQ_AXIS",
+    "TP_AXIS",
     "make_mesh_2d",
+    "make_mesh_wtp",
     "a2a_attention",
     "ring_attention",
     "dense_attention",
     "build_sp_train_setup",
+    "build_tp_train_setup",
 ]

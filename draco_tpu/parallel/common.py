@@ -1,0 +1,45 @@
+"""Shared tail of the flat-gradient training paths (sp_step / tp_step):
+attack injection → coded decode or robust aggregation → optimizer update.
+
+One implementation so a fix to injection, decode, or the update convention
+cannot silently diverge between the parallelism paths. (The CNN path in
+training/step.py keeps its own tail: it additionally handles straggler
+presence masks, layer-granularity decode, and per-worker batch stats.)
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from draco_tpu import aggregation, attacks
+from draco_tpu.coding import cyclic as cyclic_mod
+
+
+def aggregate_flat_grads(grads: jnp.ndarray, adv_mask, cfg, code, rand_factor):
+    """(n, d) per-worker flat gradients → one aggregated (d,) gradient.
+
+    cyclic: shared-redundancy encode, adversarial injection on the encoded
+    rows, exact decode. Otherwise: injection on the raw rows, then the
+    configured robust aggregation (mean / geo-median / krum).
+    """
+    if cfg.approach == "cyclic":
+        enc_re, enc_im = cyclic_mod.encode_shared(code, grads)
+        enc_re, enc_im = attacks.inject_cyclic(
+            enc_re, enc_im, adv_mask, cfg.err_mode, cfg.adversarial
+        )
+        agg, _honest = cyclic_mod.decode(code, enc_re, enc_im, rand_factor)
+        return agg
+    grads = attacks.inject_plain(grads, adv_mask, cfg.err_mode, cfg.adversarial)
+    return aggregation.aggregate(
+        grads, cfg.mode, s=cfg.worker_fail, geomedian_iters=cfg.geomedian_iters
+    )
+
+
+def apply_flat_update(state, agg: jnp.ndarray, opt, unravel):
+    """Aggregated flat gradient → (new_params, new_opt_state) via the
+    grads-as-argument optimizer convention (reference sgd_modified.py:53)."""
+    grads_tree = unravel(agg)
+    updates, new_opt = opt.update(grads_tree, state.opt_state, state.params)
+    new_params = jax.tree.map(lambda p, u: p + u, state.params, updates)
+    return new_params, new_opt

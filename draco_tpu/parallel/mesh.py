@@ -11,6 +11,7 @@ from jax.sharding import Mesh
 from draco_tpu.runtime import WORKER_AXIS
 
 SEQ_AXIS = "sp"
+TP_AXIS = "tp"
 
 
 def make_mesh_2d(
@@ -32,3 +33,25 @@ def make_mesh_2d(
         )
     grid = np.asarray(devices[:need]).reshape(num_workers, seq_shards)
     return Mesh(grid, (WORKER_AXIS, SEQ_AXIS))
+
+
+def make_mesh_wtp(
+    num_workers: int,
+    tensor_shards: int,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Mesh of shape (num_workers, tensor_shards) with axes (w, tp).
+
+    Tensor-parallel all-reduces fire at every row-parallel layer boundary
+    (several per step), the worker-axis gather once per step — so ``tp``
+    is innermost, riding the fastest ICI links.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    need = num_workers * tensor_shards
+    if len(devices) < need:
+        raise ValueError(
+            f"make_mesh_wtp({num_workers}, {tensor_shards}) needs {need} "
+            f"devices, have {len(devices)}"
+        )
+    grid = np.asarray(devices[:need]).reshape(num_workers, tensor_shards)
+    return Mesh(grid, (WORKER_AXIS, TP_AXIS))

@@ -25,11 +25,12 @@ from jax import lax
 from jax import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from draco_tpu import aggregation, attacks, optim, rng as drng
+from draco_tpu import optim, rng as drng
 from draco_tpu.coding import cyclic as cyclic_mod
 from draco_tpu.config import TrainConfig
 from draco_tpu.models.transformer import TransformerLM
 from draco_tpu.parallel.a2a_attention import a2a_attention
+from draco_tpu.parallel.common import aggregate_flat_grads, apply_flat_update
 from draco_tpu.parallel.mesh import SEQ_AXIS
 from draco_tpu.parallel.ring_attention import ring_attention
 from draco_tpu.runtime import WORKER_AXIS
@@ -156,24 +157,13 @@ def build_sp_train_setup(cfg: TrainConfig, mesh) -> SPTrainSetup:
         rand_factor = jnp.asarray(drng.random_projection_factors(cfg.seed, dim))
     else:
         code = None
+        rand_factor = None
 
     def step_body(state: TrainState, tokens, adv_mask):
         grads, losses = grads_fn(state.params, tokens)
         grads = lax.with_sharding_constraint(grads, shard_w)
-        if cfg.approach == "cyclic":
-            enc_re, enc_im = cyclic_mod.encode_shared(code, grads)
-            enc_re, enc_im = attacks.inject_cyclic(
-                enc_re, enc_im, adv_mask, cfg.err_mode, cfg.adversarial
-            )
-            agg, _honest = cyclic_mod.decode(code, enc_re, enc_im, rand_factor)
-        else:
-            grads = attacks.inject_plain(grads, adv_mask, cfg.err_mode, cfg.adversarial)
-            agg = aggregation.aggregate(
-                grads, cfg.mode, s=cfg.worker_fail, geomedian_iters=cfg.geomedian_iters
-            )
-        grads_tree = unravel(agg)
-        updates, new_opt = opt.update(grads_tree, state.opt_state, state.params)
-        new_params = jax.tree.map(lambda p, u: p + u, state.params, updates)
+        agg = aggregate_flat_grads(grads, adv_mask, cfg, code, rand_factor)
+        new_params, new_opt = apply_flat_update(state, agg, opt, unravel)
         new_state = TrainState(new_params, new_opt, None, state.step + 1)
         return new_state, {"loss": jnp.mean(losses)}
 

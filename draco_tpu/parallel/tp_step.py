@@ -1,0 +1,192 @@
+"""Coded data parallelism × tensor parallelism: the (w, tp) GSPMD step.
+
+Megatron-style tensor parallelism for the TransformerLM, expressed the
+TPU-native way: parameters carry ``NamedSharding`` annotations over mesh
+axis ``tp`` (column-parallel qkv/mlp_in, row-parallel proj/mlp_out) and the
+training step is ONE plain ``jit`` — no manual collectives, no shard_map;
+XLA's SPMD partitioner inserts the all-reduces at the row-parallel
+boundaries and shards every matmul. This is deliberately the other
+idiomatic-JAX parallelism style from the ``sp`` path (sp_step.py uses
+explicit shard_map + ppermute/all_to_all; this path uses sharding
+propagation), so the framework demonstrates both.
+
+Composition with Draco (SURVEY.md §2.3): per-worker gradients inherit the
+``tp`` shardings leaf-by-leaf; flattening to the (n, d) gradient matrix
+re-lays them out over ``w`` (XLA inserts the tp-gather), and the coding /
+robust-aggregation machinery is unchanged. After the update the new
+parameters are constrained back onto their ``tp`` shards.
+
+No reference counterpart (the reference is CNN-only, single-axis DP);
+this axis is part of the TPU build's scale-out surface.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from draco_tpu import optim, rng as drng
+from draco_tpu.coding import cyclic as cyclic_mod
+from draco_tpu.config import TrainConfig
+from draco_tpu.models.transformer import TransformerLM
+from draco_tpu.parallel.common import aggregate_flat_grads, apply_flat_update
+from draco_tpu.parallel.mesh import TP_AXIS
+from draco_tpu.runtime import WORKER_AXIS
+from draco_tpu.training.step import TrainState, _flatten_tree, _make_unravel
+
+
+class TPTrainSetup(NamedTuple):
+    model: TransformerLM
+    state: TrainState
+    train_step: any  # (state, tokens (n,B,T), adv_mask (n,)) -> (state, metrics)
+    eval_step: any  # (params, tokens) -> loss
+    code: Optional[cyclic_mod.CyclicCode]
+    unravel: any
+    dim: int
+
+
+def param_partition_spec(path) -> P:
+    """Megatron partitioning by parameter name.
+
+    Column-parallel (output dim sharded): ``qkv``, ``mlp_in``.
+    Row-parallel (input dim sharded): ``proj``, ``mlp_out`` — XLA inserts
+    the psum over ``tp`` where their outputs meet the residual stream.
+    Everything 1-D or shared (embeddings, layer norms, biases of
+    row-parallel layers) stays replicated.
+    """
+    names = [getattr(k, "key", str(k)) for k in path]
+    leaf = names[-1]
+    layer = names[-2] if len(names) >= 2 else ""
+    if leaf == "kernel" and layer in ("qkv", "mlp_in"):
+        return P(None, TP_AXIS)
+    if leaf == "kernel" and layer in ("proj", "mlp_out"):
+        return P(TP_AXIS, None)
+    if leaf == "bias" and layer == "mlp_in":
+        return P(TP_AXIS)
+    return P()
+
+
+def shard_params(params, mesh):
+    """Annotate a parameter pytree with its (w-replicated, tp-sharded)
+    placement."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, x: jax.device_put(
+            x, NamedSharding(mesh, param_partition_spec(path))
+        ),
+        params,
+    )
+
+
+def _constrain_params(params, mesh):
+    return jax.tree_util.tree_map_with_path(
+        lambda path, x: jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, param_partition_spec(path))
+        ),
+        params,
+    )
+
+
+def build_tp_train_setup(cfg: TrainConfig, mesh) -> TPTrainSetup:
+    """mesh must have axes (w, tp) — see make_mesh_wtp."""
+    cfg.validate()
+    if cfg.approach not in ("baseline", "cyclic"):
+        raise ValueError(f"TP path supports baseline|cyclic, got {cfg.approach}")
+    n = cfg.num_workers
+    assert mesh.shape[WORKER_AXIS] == n, (mesh.shape, n)
+    # the mesh defines the actual shard count — it must be the one the
+    # config's divisibility checks validated, or GSPMD silently pads
+    if mesh.shape[TP_AXIS] != max(cfg.tensor_shards, 1):
+        raise ValueError(
+            f"mesh tp axis is {mesh.shape[TP_AXIS]} but cfg.tensor_shards="
+            f"{cfg.tensor_shards}"
+        )
+
+    cdtype = jnp.dtype(cfg.compute_dtype)
+    model = TransformerLM(
+        vocab=cfg.vocab, dim=cfg.model_dim, heads=cfg.model_heads,
+        layers=cfg.model_layers, attn_fn=None, dtype=cdtype,
+    )
+    root = jax.random.key(cfg.seed)
+    init_toks = jnp.zeros((1, min(cfg.seq_len, 8)), jnp.int32)
+    params = model.init({"params": root}, init_toks, train=True)["params"]
+
+    opt = optim.build_optimizer(cfg.optimizer, cfg.lr, cfg.momentum)
+    unravel, dim, _ = _make_unravel(params)
+
+    repl = NamedSharding(mesh, P())
+    shard_w = NamedSharding(mesh, P(WORKER_AXIS))
+    params = shard_params(params, mesh)
+    state = TrainState(
+        params=params,
+        # opt.init is zeros_like on the sharded params, so the slots inherit
+        # the tp layout with no host round-trip (multi-host safe)
+        opt_state=opt.init(params),
+        batch_stats=None,
+        step=jax.device_put(jnp.asarray(1, jnp.int32), repl),
+    )
+
+    def lane_loss(params, toks, train: bool):
+        """Whole-sequence next-token CE for one worker's (B, T) batch."""
+        logits = model.apply({"params": params}, toks[:, :-1], train=train)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+        nll = -jnp.take_along_axis(logp, toks[:, 1:, None], axis=-1)[..., 0]
+        return jnp.mean(nll)
+
+    if cfg.approach == "cyclic":
+        code = cyclic_mod.build_cyclic_code(n, cfg.worker_fail)
+        rand_factor = jnp.asarray(drng.random_projection_factors(cfg.seed, dim))
+    else:
+        code = None
+        rand_factor = None
+
+    def step_body(state: TrainState, tokens, adv_mask):
+        def lane(toks):
+            loss, g = jax.value_and_grad(lane_loss)(state.params, toks, True)
+            return _flatten_tree(g), loss
+
+        grads, losses = jax.vmap(lane)(tokens)  # (n, d), (n,)
+        grads = jax.lax.with_sharding_constraint(grads, shard_w)
+        agg = aggregate_flat_grads(grads, adv_mask, cfg, code, rand_factor)
+        new_params, new_opt = apply_flat_update(state, agg, opt, unravel)
+        new_params = _constrain_params(new_params, mesh)
+        new_state = TrainState(new_params, new_opt, None, state.step + 1)
+        return new_state, {"loss": jnp.mean(losses)}
+
+    def eval_body(params, tokens):
+        return jnp.mean(jax.vmap(lambda t: lane_loss(params, t, False))(tokens))
+
+    with mesh:
+        train_step = jax.jit(step_body, donate_argnums=(0,))
+        eval_step = jax.jit(eval_body)
+
+    return TPTrainSetup(
+        model=model, state=state, train_step=train_step, eval_step=eval_step,
+        code=code, unravel=unravel, dim=dim,
+    )
+
+
+def train_tp(cfg: TrainConfig, mesh, steps: Optional[int] = None,
+             quiet: bool = False):
+    """TP training loop on the synthetic token stream (same stream as the SP
+    loop, sp_step.synthetic_text). Returns (state, last metrics)."""
+    from draco_tpu.parallel.sp_step import synthetic_text
+
+    setup = build_tp_train_setup(cfg, mesh)
+    state = setup.state
+    total = steps or cfg.max_steps
+    adv = drng.adversary_schedule(cfg.seed, total + 1, cfg.num_workers,
+                                  cfg.worker_fail)
+    metrics = {}
+    for step in range(1, total + 1):
+        toks = jnp.asarray(
+            synthetic_text(cfg.seed, step, cfg.num_workers, cfg.batch_size,
+                           cfg.seq_len, cfg.vocab)
+        )
+        state, metrics = setup.train_step(state, toks, jnp.asarray(adv[step]))
+        if not quiet and step % cfg.log_every == 0:
+            print(f"tp step {step}: loss {float(metrics['loss']):.4f}", flush=True)
+    return state, metrics
