@@ -89,6 +89,10 @@ class TrainConfig:
     # tp mesh-axis size for the GSPMD tensor-parallel path (parallel/
     # tp_step.py); composes with the coded worker axis on a (w, tp) mesh
     tensor_shards: int = 1
+    # Switch-MoE: experts per block (0 = dense MLP) and the ep mesh-axis
+    # size sharding the expert stacks (parallel/ep_step.py, models/moe.py)
+    moe_experts: int = 0
+    expert_shards: int = 1
     seq_len: int = 256  # tokens per sequence (global, pre-sharding)
     vocab: int = 256
     model_dim: int = 128
@@ -248,11 +252,41 @@ class TrainConfig:
                 )
             if self.sp_attn not in ("ring", "a2a"):
                 raise ValueError(f"sp_attn must be ring|a2a, got {self.sp_attn}")
-            if self.tensor_shards > 1:
-                if self.seq_shards > 1:
+            if (
+                sum(int(x > 1) for x in
+                    (self.tensor_shards, self.seq_shards, self.expert_shards))
+                > 1
+            ):
+                raise ValueError(
+                    "tensor_shards / seq_shards / expert_shards are separate "
+                    "paths (tp_step / sp_step / ep_step); combining model-"
+                    "parallel axes is not implemented"
+                )
+            if self.expert_shards > 1:
+                if self.moe_experts <= 0:
+                    raise ValueError("expert_shards > 1 needs moe_experts > 0")
+                if self.moe_experts % self.expert_shards:
                     raise ValueError(
-                        "tensor_shards and seq_shards are separate paths "
-                        "(tp_step vs sp_step); combine is not implemented"
+                        f"expert_shards={self.expert_shards} must divide "
+                        f"moe_experts {self.moe_experts}"
+                    )
+            if self.moe_experts < 0:
+                raise ValueError("moe_experts must be >= 0")
+            if self.moe_experts > 0 and self.seq_shards > 1:
+                # MoeMlp computes capacity and arrival-order drops from its
+                # LOCAL token count; under sp sharding that breaks the
+                # documented sp layout-invariance (global routing is not
+                # implemented)
+                raise ValueError(
+                    "moe_experts > 0 with seq_shards > 1 is not implemented: "
+                    "per-shard MoE routing/capacity would break sp "
+                    "layout-invariance"
+                )
+            if self.tensor_shards > 1:
+                if self.moe_experts > 0:
+                    raise ValueError(
+                        "tensor_shards with moe_experts is not implemented "
+                        "(the tp partition rules cover the dense MLP only)"
                     )
                 if (
                     self.model_dim % self.tensor_shards
@@ -278,4 +312,8 @@ class TrainConfig:
             raise ValueError("seq_shards > 1 requires network=TransformerLM")
         elif self.tensor_shards > 1:
             raise ValueError("tensor_shards > 1 requires network=TransformerLM")
+        elif self.expert_shards > 1 or self.moe_experts > 0:
+            raise ValueError(
+                "moe_experts / expert_shards require network=TransformerLM"
+            )
         return self

@@ -1,0 +1,80 @@
+"""Switch-style mixture-of-experts MLP — the expert-parallel workload.
+
+Top-1 token routing with a fixed per-expert capacity (Switch Transformer
+semantics): tokens pick their argmax expert, overflow beyond
+``capacity_factor · N/E`` tokens per expert is dropped (the token passes
+through the residual stream unchanged — standard Switch behaviour), and
+dispatch/combine are dense one-hot einsums so the whole layer is one
+fixed-shape jittable program (no data-dependent shapes; the TPU requirement
+that shaped this framework's decode path too, SURVEY.md §7.1-3).
+
+Expert weights are stacked on a leading E axis, which is what the
+expert-parallel path shards over mesh axis ``ep``
+(draco_tpu/parallel/ep_step.py): the per-expert FFN einsum is batched over
+E, so GSPMD turns the E-sharding into an all-to-all-free local compute with
+dispatch/combine resharding at the boundaries.
+
+No reference counterpart (CNN-only zoo); part of the TPU build's scale-out
+surface beyond parity.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class MoeMlp(nn.Module):
+    dim: int
+    experts: int
+    mlp_ratio: int = 4
+    capacity_factor: float = 1.25
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        """x: (B, T, D) -> (B, T, D). Dropped (over-capacity) tokens return 0
+        here and survive via the caller's residual connection."""
+        b, t, d = x.shape
+        e = self.experts
+        hidden = self.mlp_ratio * d
+        n_tok = b * t
+        cap = max(int(self.capacity_factor * n_tok / e), 1)
+        xf = x.reshape(n_tok, d)
+
+        # router in f32 (softmax numerics); top-1 with index-order tie-break
+        logits = nn.Dense(e, use_bias=False, name="router",
+                          dtype=jnp.float32)(xf.astype(jnp.float32))
+        probs = jax.nn.softmax(logits, axis=-1)  # (N, E)
+        eidx = jnp.argmax(probs, axis=-1)  # (N,)
+        gate = jnp.take_along_axis(probs, eidx[:, None], axis=-1)[:, 0]  # (N,)
+
+        onehot = jax.nn.one_hot(eidx, e, dtype=jnp.float32)  # (N, E)
+        # arrival-order position of each token within its expert's buffer
+        pos = jnp.cumsum(onehot, axis=0) - 1.0  # (N, E)
+        keep = (pos < cap) * onehot  # (N, E), 1 where routed AND in capacity
+        # (N, E, C) one-hot dispatch/combine tensor
+        dispatch = keep[:, :, None] * jax.nn.one_hot(
+            pos.astype(jnp.int32), cap, dtype=jnp.float32
+        )
+
+        w1 = self.param(
+            "w1", nn.initializers.lecun_normal(batch_axis=(0,)), (e, d, hidden)
+        )
+        b1 = self.param("b1", nn.initializers.zeros, (e, 1, hidden))
+        w2 = self.param(
+            "w2", nn.initializers.lecun_normal(batch_axis=(0,)), (e, hidden, d)
+        )
+        b2 = self.param("b2", nn.initializers.zeros, (e, 1, d))
+
+        cd = self.dtype
+        xe = jnp.einsum("nd,nec->ecd", xf.astype(jnp.float32), dispatch)
+        h = jnp.einsum("ecd,edh->ech", xe.astype(cd), w1.astype(cd)) + b1.astype(cd)
+        h = nn.gelu(h)
+        ye = jnp.einsum("ech,ehd->ecd", h, w2.astype(cd)) + b2.astype(cd)
+        yf = jnp.einsum("ecd,nec->nd", ye.astype(jnp.float32), dispatch)
+        yf = yf * gate[:, None]  # straight-through top-1 gate (router trains)
+        return yf.reshape(b, t, d).astype(x.dtype)

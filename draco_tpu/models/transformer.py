@@ -40,6 +40,7 @@ class Block(nn.Module):
     heads: int
     mlp_ratio: int = 4
     attn_fn: Optional[AttnFn] = None
+    experts: int = 0  # >0 replaces the dense MLP with a Switch MoE (moe.py)
     dtype: Any = jnp.float32  # MXU compute dtype; params stay float32
 
     @nn.compact
@@ -63,9 +64,15 @@ class Block(nn.Module):
         o = attn(q, k, v).reshape(b, t, self.dim)
         x = x + nn.Dense(self.dim, use_bias=False, name="proj", dtype=self.dtype)(o)
         h = nn.LayerNorm(use_bias=False, dtype=self.dtype)(x)
-        h = nn.Dense(self.mlp_ratio * self.dim, name="mlp_in", dtype=self.dtype)(h)
-        h = nn.gelu(h)
-        x = x + nn.Dense(self.dim, name="mlp_out", dtype=self.dtype)(h)
+        if self.experts > 0:
+            from draco_tpu.models.moe import MoeMlp
+
+            x = x + MoeMlp(self.dim, self.experts, self.mlp_ratio,
+                           dtype=self.dtype, name="moe")(h)
+        else:
+            h = nn.Dense(self.mlp_ratio * self.dim, name="mlp_in", dtype=self.dtype)(h)
+            h = nn.gelu(h)
+            x = x + nn.Dense(self.dim, name="mlp_out", dtype=self.dtype)(h)
         return x
 
 
@@ -81,6 +88,7 @@ class TransformerLM(nn.Module):
     heads: int = 4
     layers: int = 2
     attn_fn: Optional[AttnFn] = None
+    experts: int = 0  # >0: every block's MLP becomes a Switch MoE
     dtype: Any = jnp.float32
 
     @nn.compact
@@ -90,7 +98,8 @@ class TransformerLM(nn.Module):
         positions = pos_offset + jnp.arange(tokens.shape[1])
         for i in range(self.layers):
             x = Block(self.dim, self.heads, attn_fn=self.attn_fn,
-                      dtype=self.dtype, name=f"block{i}")(x, positions, train)
+                      experts=self.experts, dtype=self.dtype,
+                      name=f"block{i}")(x, positions, train)
         x = nn.LayerNorm(use_bias=False, name="final_ln")(x)
         # logits in float32 (loss numerics)
         return emb.attend(x.astype(jnp.float32))

@@ -18,22 +18,36 @@ reference lacks first-class in the TPU build:
     written the GSPMD way (parameter sharding annotations, one plain jit,
     XLA inserts the collectives) as the counterpart to the SP path's
     explicit shard_map style (tp_step.py).
+  * ``ep`` — expert parallelism for the Switch-MoE TransformerLM on
+    ``(w, ep)`` meshes: expert weight stacks shard their leading E axis,
+    router and shared weights stay replicated (ep_step.py, models/moe.py).
 """
 
 from draco_tpu.parallel.a2a_attention import a2a_attention
-from draco_tpu.parallel.mesh import SEQ_AXIS, TP_AXIS, make_mesh_2d, make_mesh_wtp
+from draco_tpu.parallel.ep_step import build_ep_train_setup
+from draco_tpu.parallel.mesh import (
+    EP_AXIS,
+    SEQ_AXIS,
+    TP_AXIS,
+    make_mesh_2d,
+    make_mesh_wep,
+    make_mesh_wtp,
+)
 from draco_tpu.parallel.ring_attention import dense_attention, ring_attention
 from draco_tpu.parallel.sp_step import build_sp_train_setup
 from draco_tpu.parallel.tp_step import build_tp_train_setup
 
 __all__ = [
+    "EP_AXIS",
     "SEQ_AXIS",
     "TP_AXIS",
     "make_mesh_2d",
+    "make_mesh_wep",
     "make_mesh_wtp",
     "a2a_attention",
     "ring_attention",
     "dense_attention",
     "build_sp_train_setup",
     "build_tp_train_setup",
+    "build_ep_train_setup",
 ]

@@ -1,0 +1,56 @@
+"""Coded data parallelism × expert parallelism: the (w, ep) GSPMD step.
+
+Expert parallelism for the Switch-MoE TransformerLM
+(draco_tpu/models/moe.py), same GSPMD idiom as the tensor-parallel path
+(tp_step.py): expert weight stacks carry ``NamedSharding`` annotations over
+mesh axis ``ep`` on their leading E axis, the step is one plain jit, and
+XLA's partitioner localises each expert's FFN to its shard with
+dispatch/combine resharding at the einsum boundaries. Router and all
+non-expert parameters stay replicated.
+
+Draco composition is identical to the tp path: per-worker flat gradients
+over ``w``, then the shared coding/robust-aggregation tail
+(parallel/common.py).
+
+No reference counterpart (CNN-only zoo, single-axis DP).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from jax.sharding import PartitionSpec as P
+
+from draco_tpu.config import TrainConfig
+from draco_tpu.parallel.mesh import EP_AXIS
+from draco_tpu.parallel.tp_step import (
+    TPTrainSetup,
+    _build_gspmd_train_setup,
+    run_token_loop,
+)
+
+EXPERT_PARAMS = ("w1", "w2", "b1", "b2")
+
+
+def ep_partition_spec(path) -> P:
+    """Expert weight stacks shard their leading E axis over ``ep``; the
+    router and every non-MoE parameter stay replicated."""
+    names = [getattr(k, "key", str(k)) for k in path]
+    if len(names) >= 2 and names[-2] == "moe" and names[-1] in EXPERT_PARAMS:
+        return P(EP_AXIS)
+    return P()
+
+
+def build_ep_train_setup(cfg: TrainConfig, mesh) -> TPTrainSetup:
+    """mesh must have axes (w, ep) — see make_mesh_wep."""
+    return _build_gspmd_train_setup(
+        cfg, mesh, mp_axis=EP_AXIS, mp_size=max(cfg.expert_shards, 1),
+        partition_fn=ep_partition_spec, experts=cfg.moe_experts,
+    )
+
+
+def train_ep(cfg: TrainConfig, mesh, steps: Optional[int] = None,
+             quiet: bool = False):
+    """EP training loop; returns (state, last metrics)."""
+    return run_token_loop(build_ep_train_setup(cfg, mesh), cfg, steps, quiet,
+                          tag="ep")

@@ -12,6 +12,7 @@ from draco_tpu.runtime import WORKER_AXIS
 
 SEQ_AXIS = "sp"
 TP_AXIS = "tp"
+EP_AXIS = "ep"
 
 
 def make_mesh_2d(
@@ -35,23 +36,35 @@ def make_mesh_2d(
     return Mesh(grid, (WORKER_AXIS, SEQ_AXIS))
 
 
+def _make_mesh_w2(axis2: str, num_workers: int, shards: int,
+                  devices: Optional[Sequence[jax.Device]]) -> Mesh:
+    """(num_workers, shards) mesh with axes (w, axis2); the model-parallel
+    axis is innermost, riding the fastest ICI links (its collectives fire
+    several times per step; the worker-axis gather once)."""
+    devices = list(devices if devices is not None else jax.devices())
+    need = num_workers * shards
+    if len(devices) < need:
+        raise ValueError(
+            f"(w={num_workers}, {axis2}={shards}) mesh needs {need} devices, "
+            f"have {len(devices)}"
+        )
+    grid = np.asarray(devices[:need]).reshape(num_workers, shards)
+    return Mesh(grid, (WORKER_AXIS, axis2))
+
+
 def make_mesh_wtp(
     num_workers: int,
     tensor_shards: int,
     devices: Optional[Sequence[jax.Device]] = None,
 ) -> Mesh:
-    """Mesh of shape (num_workers, tensor_shards) with axes (w, tp).
+    """Mesh of shape (num_workers, tensor_shards) with axes (w, tp)."""
+    return _make_mesh_w2(TP_AXIS, num_workers, tensor_shards, devices)
 
-    Tensor-parallel all-reduces fire at every row-parallel layer boundary
-    (several per step), the worker-axis gather once per step — so ``tp``
-    is innermost, riding the fastest ICI links.
-    """
-    devices = list(devices if devices is not None else jax.devices())
-    need = num_workers * tensor_shards
-    if len(devices) < need:
-        raise ValueError(
-            f"make_mesh_wtp({num_workers}, {tensor_shards}) needs {need} "
-            f"devices, have {len(devices)}"
-        )
-    grid = np.asarray(devices[:need]).reshape(num_workers, tensor_shards)
-    return Mesh(grid, (WORKER_AXIS, TP_AXIS))
+
+def make_mesh_wep(
+    num_workers: int,
+    expert_shards: int,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Mesh of shape (num_workers, expert_shards) with axes (w, ep)."""
+    return _make_mesh_w2(EP_AXIS, num_workers, expert_shards, devices)

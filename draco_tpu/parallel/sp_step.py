@@ -74,12 +74,14 @@ def build_sp_train_setup(cfg: TrainConfig, mesh) -> SPTrainSetup:
     cdtype = jnp.dtype(cfg.compute_dtype)
     model = TransformerLM(
         vocab=cfg.vocab, dim=cfg.model_dim, heads=cfg.model_heads,
-        layers=cfg.model_layers, attn_fn=attn, dtype=cdtype,
+        layers=cfg.model_layers, attn_fn=attn, experts=cfg.moe_experts,
+        dtype=cdtype,
     )
     # init single-shard (dense attention) — parameter shapes are identical
     init_model = TransformerLM(
         vocab=cfg.vocab, dim=cfg.model_dim, heads=cfg.model_heads,
-        layers=cfg.model_layers, attn_fn=None, dtype=cdtype,
+        layers=cfg.model_layers, attn_fn=None, experts=cfg.moe_experts,
+        dtype=cdtype,
     )
     root = jax.random.key(cfg.seed)
     init_toks = jnp.zeros((1, min(cfg.seq_len, 8)), jnp.int32)

@@ -70,21 +70,21 @@ def param_partition_spec(path) -> P:
     return P()
 
 
-def shard_params(params, mesh):
-    """Annotate a parameter pytree with its (w-replicated, tp-sharded)
+def shard_params(params, mesh, partition_fn=param_partition_spec):
+    """Annotate a parameter pytree with its (w-replicated, mp-sharded)
     placement."""
     return jax.tree_util.tree_map_with_path(
         lambda path, x: jax.device_put(
-            x, NamedSharding(mesh, param_partition_spec(path))
+            x, NamedSharding(mesh, partition_fn(path))
         ),
         params,
     )
 
 
-def _constrain_params(params, mesh):
+def _constrain_params(params, mesh, partition_fn):
     return jax.tree_util.tree_map_with_path(
         lambda path, x: jax.lax.with_sharding_constraint(
-            x, NamedSharding(mesh, param_partition_spec(path))
+            x, NamedSharding(mesh, partition_fn(path))
         ),
         params,
     )
@@ -92,23 +92,37 @@ def _constrain_params(params, mesh):
 
 def build_tp_train_setup(cfg: TrainConfig, mesh) -> TPTrainSetup:
     """mesh must have axes (w, tp) — see make_mesh_wtp."""
+    # experts honoured even at tensor_shards=1 (validate() forbids MoE with
+    # tensor_shards>1; at 1 shard the tp rules just replicate expert params)
+    return _build_gspmd_train_setup(
+        cfg, mesh, mp_axis=TP_AXIS, mp_size=max(cfg.tensor_shards, 1),
+        partition_fn=param_partition_spec, experts=cfg.moe_experts,
+    )
+
+
+def _build_gspmd_train_setup(cfg: TrainConfig, mesh, *, mp_axis: str,
+                             mp_size: int, partition_fn, experts: int) -> TPTrainSetup:
+    """Shared GSPMD builder for the sharding-annotation model-parallel paths
+    (tensor parallelism here; expert parallelism in ep_step.py). The paths
+    differ only in the mesh axis, the parameter partition rules, and the
+    model's expert count."""
     cfg.validate()
     if cfg.approach not in ("baseline", "cyclic"):
-        raise ValueError(f"TP path supports baseline|cyclic, got {cfg.approach}")
+        raise ValueError(f"MP path supports baseline|cyclic, got {cfg.approach}")
     n = cfg.num_workers
     assert mesh.shape[WORKER_AXIS] == n, (mesh.shape, n)
     # the mesh defines the actual shard count — it must be the one the
     # config's divisibility checks validated, or GSPMD silently pads
-    if mesh.shape[TP_AXIS] != max(cfg.tensor_shards, 1):
+    if mesh.shape[mp_axis] != mp_size:
         raise ValueError(
-            f"mesh tp axis is {mesh.shape[TP_AXIS]} but cfg.tensor_shards="
-            f"{cfg.tensor_shards}"
+            f"mesh {mp_axis} axis is {mesh.shape[mp_axis]} but the config "
+            f"requests {mp_size} shards"
         )
 
     cdtype = jnp.dtype(cfg.compute_dtype)
     model = TransformerLM(
         vocab=cfg.vocab, dim=cfg.model_dim, heads=cfg.model_heads,
-        layers=cfg.model_layers, attn_fn=None, dtype=cdtype,
+        layers=cfg.model_layers, attn_fn=None, experts=experts, dtype=cdtype,
     )
     root = jax.random.key(cfg.seed)
     init_toks = jnp.zeros((1, min(cfg.seq_len, 8)), jnp.int32)
@@ -119,7 +133,7 @@ def build_tp_train_setup(cfg: TrainConfig, mesh) -> TPTrainSetup:
 
     repl = NamedSharding(mesh, P())
     shard_w = NamedSharding(mesh, P(WORKER_AXIS))
-    params = shard_params(params, mesh)
+    params = shard_params(params, mesh, partition_fn)
     state = TrainState(
         params=params,
         # opt.init is zeros_like on the sharded params, so the slots inherit
@@ -152,7 +166,7 @@ def build_tp_train_setup(cfg: TrainConfig, mesh) -> TPTrainSetup:
         grads = jax.lax.with_sharding_constraint(grads, shard_w)
         agg = aggregate_flat_grads(grads, adv_mask, cfg, code, rand_factor)
         new_params, new_opt = apply_flat_update(state, agg, opt, unravel)
-        new_params = _constrain_params(new_params, mesh)
+        new_params = _constrain_params(new_params, mesh, partition_fn)
         new_state = TrainState(new_params, new_opt, None, state.step + 1)
         return new_state, {"loss": jnp.mean(losses)}
 
@@ -169,13 +183,13 @@ def build_tp_train_setup(cfg: TrainConfig, mesh) -> TPTrainSetup:
     )
 
 
-def train_tp(cfg: TrainConfig, mesh, steps: Optional[int] = None,
-             quiet: bool = False):
-    """TP training loop on the synthetic token stream (same stream as the SP
-    loop, sp_step.synthetic_text). Returns (state, last metrics)."""
+def run_token_loop(setup: TPTrainSetup, cfg: TrainConfig,
+                   steps: Optional[int] = None, quiet: bool = False,
+                   tag: str = "mp"):
+    """Training loop on the synthetic token stream (sp_step.synthetic_text)
+    for any GSPMD setup. Returns (state, last metrics)."""
     from draco_tpu.parallel.sp_step import synthetic_text
 
-    setup = build_tp_train_setup(cfg, mesh)
     state = setup.state
     total = steps or cfg.max_steps
     adv = drng.adversary_schedule(cfg.seed, total + 1, cfg.num_workers,
@@ -188,5 +202,13 @@ def train_tp(cfg: TrainConfig, mesh, steps: Optional[int] = None,
         )
         state, metrics = setup.train_step(state, toks, jnp.asarray(adv[step]))
         if not quiet and step % cfg.log_every == 0:
-            print(f"tp step {step}: loss {float(metrics['loss']):.4f}", flush=True)
+            print(f"{tag} step {step}: loss {float(metrics['loss']):.4f}",
+                  flush=True)
     return state, metrics
+
+
+def train_tp(cfg: TrainConfig, mesh, steps: Optional[int] = None,
+             quiet: bool = False):
+    """TP training loop; returns (state, last metrics)."""
+    return run_token_loop(build_tp_train_setup(cfg, mesh), cfg, steps, quiet,
+                          tag="tp")
