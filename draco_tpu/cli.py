@@ -72,6 +72,20 @@ def add_fit_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     # counterpart — the reference zoo is CNN-only, SURVEY.md §5.7)
     p.add_argument("--seq-shards", type=int, default=1,
                    help="sp mesh-axis size for network=TransformerLM")
+    p.add_argument("--sp-attn", type=str, default="ring",
+                   choices=["ring", "a2a"],
+                   help="sequence-parallel attention: ring (ppermute K/V "
+                        "blocks) or a2a (Ulysses head-scatter all_to_all)")
+    p.add_argument("--tensor-shards", type=int, default=1,
+                   help="tp mesh-axis size (Megatron GSPMD path, tp_step.py)")
+    p.add_argument("--moe-experts", type=int, default=0,
+                   help="Switch-MoE experts per block (0 = dense MLP)")
+    p.add_argument("--expert-shards", type=int, default=1,
+                   help="ep mesh-axis size sharding the expert stacks")
+    p.add_argument("--pipeline-shards", type=int, default=1,
+                   help="pp mesh-axis size (GPipe schedule, pp_step.py)")
+    p.add_argument("--pp-microbatches", type=int, default=0,
+                   help="microbatches per pipeline step (0 = pipeline-shards)")
     p.add_argument("--seq-len", type=int, default=256)
     p.add_argument("--vocab", type=int, default=256)
     p.add_argument("--model-dim", type=int, default=128)
@@ -138,6 +152,12 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         seed=args.seed,
         log_every=args.log_every,
         seq_shards=args.seq_shards,
+        sp_attn=args.sp_attn,
+        tensor_shards=args.tensor_shards,
+        moe_experts=args.moe_experts,
+        expert_shards=args.expert_shards,
+        pipeline_shards=args.pipeline_shards,
+        pp_microbatches=args.pp_microbatches,
         seq_len=args.seq_len,
         vocab=args.vocab,
         model_dim=args.model_dim,
@@ -171,12 +191,36 @@ def main(argv=None):
     else:
         cfg = config_from_args(args)
     if cfg.network == "TransformerLM":
-        # long-context path: 2-D (w × sp) mesh, ring attention, coded DP on w
-        from draco_tpu.parallel import make_mesh_2d
-        from draco_tpu.parallel.sp_step import train_sp
+        # model-parallel paths compose with coded DP on 2-D (w × axis)
+        # meshes; config.validate() guarantees at most one axis is active
+        if cfg.tensor_shards > 1:
+            from draco_tpu.parallel import make_mesh_wtp
+            from draco_tpu.parallel.tp_step import train_tp
 
-        mesh = make_mesh_2d(cfg.num_workers, cfg.seq_shards)
-        _, last = train_sp(cfg, mesh)
+            _, last = train_tp(cfg, make_mesh_wtp(cfg.num_workers,
+                                                  cfg.tensor_shards))
+        elif cfg.expert_shards > 1:
+            from draco_tpu.parallel import make_mesh_wep
+            from draco_tpu.parallel.ep_step import train_ep
+
+            _, last = train_ep(cfg, make_mesh_wep(cfg.num_workers,
+                                                  cfg.expert_shards))
+        elif cfg.pipeline_shards > 1 or cfg.pp_microbatches > 0:
+            # pp_microbatches alone still selects the pipeline path: the
+            # GPipe schedule runs at S=1 with M microbatches (validated
+            # above), rather than silently dropping the flag
+            from draco_tpu.parallel import make_mesh_wpp
+            from draco_tpu.parallel.pp_step import train_pp
+
+            _, last = train_pp(cfg, make_mesh_wpp(cfg.num_workers,
+                                                  cfg.pipeline_shards))
+        else:
+            # long-context default: (w × sp) mesh, ring/a2a attention
+            from draco_tpu.parallel import make_mesh_2d
+            from draco_tpu.parallel.sp_step import train_sp
+
+            _, last = train_sp(cfg, make_mesh_2d(cfg.num_workers,
+                                                 cfg.seq_shards))
         return last
     trainer = Trainer(cfg)
     last = trainer.run(profile_dir=args.profile_dir or None)

@@ -93,6 +93,13 @@ class TrainConfig:
     # size sharding the expert stacks (parallel/ep_step.py, models/moe.py)
     moe_experts: int = 0
     expert_shards: int = 1
+    # pp mesh-axis size for the GPipe-style pipeline path (parallel/
+    # pp_step.py): transformer blocks split into pipeline_shards stages,
+    # microbatches flow stage-to-stage over ppermute hops
+    pipeline_shards: int = 1
+    # microbatches per step for the pipeline schedule (0 = pipeline_shards);
+    # more microbatches shrink the bubble: S-1 of M+S-1 ticks are idle
+    pp_microbatches: int = 0
     seq_len: int = 256  # tokens per sequence (global, pre-sharding)
     vocab: int = 256
     model_dim: int = 128
@@ -254,13 +261,15 @@ class TrainConfig:
                 raise ValueError(f"sp_attn must be ring|a2a, got {self.sp_attn}")
             if (
                 sum(int(x > 1) for x in
-                    (self.tensor_shards, self.seq_shards, self.expert_shards))
+                    (self.tensor_shards, self.seq_shards, self.expert_shards,
+                     self.pipeline_shards))
                 > 1
             ):
                 raise ValueError(
-                    "tensor_shards / seq_shards / expert_shards are separate "
-                    "paths (tp_step / sp_step / ep_step); combining model-"
-                    "parallel axes is not implemented"
+                    "tensor_shards / seq_shards / expert_shards / "
+                    "pipeline_shards are separate paths (tp_step / sp_step / "
+                    "ep_step / pp_step); combining model-parallel axes is "
+                    "not implemented"
                 )
             if self.expert_shards > 1:
                 if self.moe_experts <= 0:
@@ -306,6 +315,28 @@ class TrainConfig:
                     f"sp_attn=a2a needs model_heads % seq_shards == 0 "
                     f"({self.model_heads} % {self.seq_shards})"
                 )
+            if self.pp_microbatches < 0 or self.pipeline_shards < 1:
+                raise ValueError(
+                    "pipeline_shards must be >= 1 and pp_microbatches >= 0"
+                )
+            if self.pipeline_shards > 1 or self.pp_microbatches > 0:
+                if self.moe_experts > 0 and self.pipeline_shards > 1:
+                    raise ValueError(
+                        "pipeline_shards with moe_experts is not implemented "
+                        "(the pipeline's scanned block stack covers the dense "
+                        "MLP only)"
+                    )
+                if self.model_layers % max(self.pipeline_shards, 1):
+                    raise ValueError(
+                        f"pipeline_shards={self.pipeline_shards} must divide "
+                        f"model_layers {self.model_layers}"
+                    )
+                mb = self.pp_microbatches or self.pipeline_shards
+                if self.batch_size % mb:
+                    raise ValueError(
+                        f"pipeline microbatch count {mb} must divide "
+                        f"batch_size {self.batch_size}"
+                    )
             if self.seq_len < 2 or self.vocab < 2:
                 raise ValueError("TransformerLM needs seq_len >= 2 and vocab >= 2")
         elif self.seq_shards > 1:
@@ -316,4 +347,6 @@ class TrainConfig:
             raise ValueError(
                 "moe_experts / expert_shards require network=TransformerLM"
             )
+        elif self.pipeline_shards > 1:
+            raise ValueError("pipeline_shards > 1 requires network=TransformerLM")
         return self

@@ -21,28 +21,38 @@ reference lacks first-class in the TPU build:
   * ``ep`` — expert parallelism for the Switch-MoE TransformerLM on
     ``(w, ep)`` meshes: expert weight stacks shard their leading E axis,
     router and shared weights stay replicated (ep_step.py, models/moe.py).
+  * ``pp`` — GPipe-style pipeline parallelism on ``(w, pp)`` meshes: the
+    block stack splits into pp stages, microbatch activations flow
+    stage-to-stage over ``ppermute`` inside a ``lax.scan`` schedule, and
+    ``jax.grad`` transposes the loop into the backward pipeline
+    (pp_step.py).
 """
 
 from draco_tpu.parallel.a2a_attention import a2a_attention
 from draco_tpu.parallel.ep_step import build_ep_train_setup
 from draco_tpu.parallel.mesh import (
     EP_AXIS,
+    PP_AXIS,
     SEQ_AXIS,
     TP_AXIS,
     make_mesh_2d,
     make_mesh_wep,
+    make_mesh_wpp,
     make_mesh_wtp,
 )
+from draco_tpu.parallel.pp_step import build_pp_train_setup
 from draco_tpu.parallel.ring_attention import dense_attention, ring_attention
 from draco_tpu.parallel.sp_step import build_sp_train_setup
 from draco_tpu.parallel.tp_step import build_tp_train_setup
 
 __all__ = [
     "EP_AXIS",
+    "PP_AXIS",
     "SEQ_AXIS",
     "TP_AXIS",
     "make_mesh_2d",
     "make_mesh_wep",
+    "make_mesh_wpp",
     "make_mesh_wtp",
     "a2a_attention",
     "ring_attention",
@@ -50,4 +60,5 @@ __all__ = [
     "build_sp_train_setup",
     "build_tp_train_setup",
     "build_ep_train_setup",
+    "build_pp_train_setup",
 ]

@@ -13,6 +13,7 @@ from draco_tpu.runtime import WORKER_AXIS
 SEQ_AXIS = "sp"
 TP_AXIS = "tp"
 EP_AXIS = "ep"
+PP_AXIS = "pp"
 
 
 def make_mesh_2d(
@@ -68,3 +69,12 @@ def make_mesh_wep(
 ) -> Mesh:
     """Mesh of shape (num_workers, expert_shards) with axes (w, ep)."""
     return _make_mesh_w2(EP_AXIS, num_workers, expert_shards, devices)
+
+
+def make_mesh_wpp(
+    num_workers: int,
+    pipeline_shards: int,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Mesh of shape (num_workers, pipeline_shards) with axes (w, pp)."""
+    return _make_mesh_w2(PP_AXIS, num_workers, pipeline_shards, devices)

@@ -1,0 +1,306 @@
+"""Coded data parallelism × pipeline parallelism: the (w, pp) GPipe step.
+
+Pipeline parallelism the TPU-native way: the TransformerLM's blocks are a
+``nn.scan`` stack whose stacked parameters shard their leading layer axis
+over mesh axis ``pp`` (each device holds ``layers / pp`` consecutive
+blocks = one stage), and the classic GPipe schedule is an explicit
+``lax.scan`` over ``M + S - 1`` ticks inside ``shard_map``: each tick a
+stage runs its blocks on the activation in flight and hands the result to
+its successor with ONE ``ppermute`` hop.  Backward needs no hand-written
+schedule — the pipeline loop is traced, ``ppermute`` is linear, and
+``jax.grad`` transposes the whole thing into the reverse-flowing backward
+pipeline automatically (cotangents ride the same ring, reversed).
+
+Composition with Draco (SURVEY.md §2.3): parameters are broadcast along a
+leading worker axis sharded over ``w`` (free: each worker column just uses
+its replica), so ``jax.grad`` yields *per-worker* gradients laid out
+(n, ...) over ``w`` with stage slices over ``pp``; flattening to the (n, d)
+gradient matrix re-lays them over ``w`` (XLA inserts the pp-gather) and the
+coding / robust-aggregation machinery is unchanged, exactly as in the tp
+path.
+
+No reference counterpart: the reference's *Split* models stream per-layer
+gradients over MPI but every worker holds the full model
+(/root/reference/src/model_ops/resnet_split.py:210-234 — grad streaming,
+not pipeline stages; SURVEY.md §2.3 "Pipeline parallelism: absent"). This
+axis is part of the TPU build's scale-out surface: models deeper than one
+chip's HBM span the ``pp`` axis.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from draco_tpu import optim, rng as drng
+from draco_tpu.coding import cyclic as cyclic_mod
+from draco_tpu.config import TrainConfig
+from draco_tpu.models.transformer import Block
+from draco_tpu.parallel.common import aggregate_flat_grads, apply_flat_update
+from draco_tpu.parallel.mesh import PP_AXIS
+from draco_tpu.parallel.tp_step import _constrain_params, shard_params
+from draco_tpu.runtime import WORKER_AXIS
+from draco_tpu.training.step import TrainState, _make_unravel
+
+
+class _PipeBlock(nn.Module):
+    """scan cell: one transformer block, (carry, broadcast args) contract."""
+
+    dim: int
+    heads: int
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x, positions):
+        x = Block(self.dim, self.heads, dtype=self.dtype, name="b")(
+            x, positions, True
+        )
+        return x, None
+
+
+class StageBlocks(nn.Module):
+    """``layers`` transformer blocks as one scanned stack.
+
+    Parameters carry a leading ``layers`` axis, so a contiguous slice of the
+    full stack IS a pipeline stage's parameter tree: the same module class
+    applies the full model (layers=L) and a stage (layers=L/S) alike.
+    """
+
+    dim: int
+    heads: int
+    layers: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, positions):
+        scan = nn.scan(
+            _PipeBlock,
+            variable_axes={"params": 0},
+            split_rngs={"params": True},
+            length=self.layers,
+            in_axes=nn.broadcast,
+        )
+        x, _ = scan(self.dim, self.heads, self.dtype, name="loop")(x, positions)
+        return x
+
+
+class PPTrainSetup(NamedTuple):
+    state: TrainState
+    train_step: any  # (state, tokens (n,B,T), adv_mask (n,)) -> (state, metrics)
+    eval_step: any  # (params, tokens) -> mean loss
+    per_worker_loss: any  # (params, tokens (n,B,T)) -> (n,) losses
+    per_worker_grads: any  # (params, tokens) -> ((n, d) flat grads, (n,) losses)
+    code: Optional[cyclic_mod.CyclicCode]
+    unravel: any
+    dim: int
+
+
+def _flatten_rows(tree) -> jnp.ndarray:
+    """(n, ...)-leaved tree -> (n, d), same leaf order as _make_unravel."""
+    leaves = jax.tree.leaves(tree)
+    n = leaves[0].shape[0]
+    return jnp.concatenate([jnp.reshape(x, (n, -1)) for x in leaves], axis=1)
+
+
+def build_pp_train_setup(cfg: TrainConfig, mesh) -> PPTrainSetup:
+    """mesh must have axes (w, pp) — see make_mesh_wpp."""
+    cfg.validate()
+    if cfg.approach not in ("baseline", "cyclic"):
+        raise ValueError(f"PP path supports baseline|cyclic, got {cfg.approach}")
+    n = cfg.num_workers
+    S = mesh.shape[PP_AXIS]
+    assert mesh.shape[WORKER_AXIS] == n, (mesh.shape, n)
+    L = cfg.model_layers
+    if L % S:
+        raise ValueError(f"model_layers {L} not divisible by pp={S}")
+    l_loc = L // S
+    M = cfg.pp_microbatches or S
+    if cfg.batch_size % M:
+        raise ValueError(f"microbatches {M} must divide batch_size {cfg.batch_size}")
+    mb = cfg.batch_size // M
+    t_in = cfg.seq_len - 1  # next-token objective: inputs are tokens[:-1]
+
+    cdtype = jnp.dtype(cfg.compute_dtype)
+    embed = nn.Embed(cfg.vocab, cfg.model_dim, name="embed")
+    blocks_full = StageBlocks(cfg.model_dim, cfg.model_heads, layers=L, dtype=cdtype)
+    blocks_stage = StageBlocks(cfg.model_dim, cfg.model_heads, layers=l_loc,
+                               dtype=cdtype)
+    final_ln = nn.LayerNorm(use_bias=False, name="final_ln")
+
+    root = jax.random.key(cfg.seed)
+    k_emb, k_blk, k_ln = jax.random.split(root, 3)
+    init_toks = jnp.zeros((1, min(t_in, 8)), jnp.int32)
+    init_x = jnp.zeros((1, min(t_in, 8), cfg.model_dim), cdtype)
+    init_pos = jnp.arange(init_x.shape[1])
+    params = {
+        "embed": embed.init(k_emb, init_toks)["params"],
+        "blocks": blocks_full.init(k_blk, init_x, init_pos)["params"],
+        "final_ln": final_ln.init(k_ln, init_x.astype(jnp.float32))["params"],
+    }
+
+    opt = optim.build_optimizer(cfg.optimizer, cfg.lr, cfg.momentum)
+    unravel, dim, _ = _make_unravel(params)
+
+    # parameter residence between steps: stage stacks shard their leading
+    # layer axis over pp, everything else replicated
+    def _leaf_spec(path):
+        names = [getattr(k, "key", str(k)) for k in path]
+        if names and names[0] == "blocks":
+            return P(PP_AXIS)
+        return P()
+
+    def _leaf_spec_n(path):
+        """Same, with the per-worker broadcast axis leading."""
+        return P(WORKER_AXIS, *_leaf_spec(path))
+
+    params = shard_params(params, mesh, _leaf_spec)
+    state = TrainState(
+        params=params,
+        opt_state=shard_params(opt.init(params), mesh, _leaf_spec),
+        batch_stats=None,
+        step=jax.device_put(jnp.asarray(1, jnp.int32),
+                            NamedSharding(mesh, P())),
+    )
+
+    params_n_specs = jax.tree_util.tree_map_with_path(
+        lambda path, _: _leaf_spec_n(path), params
+    )
+
+    def device_loss(params_n_local, tokens_local):
+        """One device = one (worker, stage) cell of the mesh.
+
+        params_n_local: this worker's replica, this stage's block slice —
+        leaves (1, [l_loc,] ...).  tokens_local: (1, B, T).  Returns this
+        worker's mean next-token CE, replicated over pp, shape (1,).
+        """
+        p = jax.tree.map(lambda x: x[0], params_n_local)
+        toks = tokens_local[0]
+        inp, tgt = toks[:, :-1], toks[:, 1:]
+        my = lax.axis_index(PP_AXIS)
+        positions = jnp.arange(t_in)
+
+        # stage 0's injections: embedded microbatches, padded with S-1
+        # bubble ticks (every stage computes the embedding locally — it is
+        # one gather; only stage 0's enters the pipeline, so only stage 0
+        # contributes its cotangent)
+        x = embed.apply({"params": p["embed"]}, inp).astype(cdtype)
+        x_mb = x.reshape(M, mb, t_in, cfg.model_dim)
+        feed = jnp.concatenate(
+            [x_mb, jnp.zeros((S - 1, mb, t_in, cfg.model_dim), cdtype)], axis=0
+        ) if S > 1 else x_mb
+
+        def stage(xin):
+            return blocks_stage.apply({"params": p["blocks"]}, xin, positions)
+
+        if S == 1:
+            outs = jax.vmap(stage)(x_mb)
+        else:
+            def tick(carry, t):
+                cur, outs = carry
+                xin = lax.dynamic_index_in_dim(feed, t, 0, keepdims=False)
+                xin = jnp.where(my == 0, xin, cur)
+                out = stage(xin)
+                # hand to the successor stage; stage 0 receives nothing
+                # (ppermute leaves unaddressed receivers zero)
+                nxt = lax.ppermute(
+                    out, PP_AXIS, [(i, i + 1) for i in range(S - 1)]
+                )
+                idx = t - (S - 1)
+                upd = lax.dynamic_update_index_in_dim(
+                    outs, out, jnp.clip(idx, 0, M - 1), 0
+                )
+                outs = jnp.where(idx >= 0, upd, outs)
+                return (nxt, outs), None
+
+            outs0 = jnp.zeros((M, mb, t_in, cfg.model_dim), cdtype)
+            (_, outs), _ = lax.scan(
+                tick, (jnp.zeros((mb, t_in, cfg.model_dim), cdtype), outs0),
+                jnp.arange(M + S - 1),
+            )
+
+        # head on the last stage (all stages run it SPMD-uniformly; the
+        # where selects, and non-last contributions are exact zeros)
+        h = final_ln.apply({"params": p["final_ln"]},
+                           outs.astype(jnp.float32))
+        logits = embed.apply({"params": p["embed"]}, h, method="attend")
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+        tgt_mb = tgt.reshape(M, mb, t_in)
+        nll = -jnp.take_along_axis(logp, tgt_mb[..., None], axis=-1)[..., 0]
+        loss = jnp.where(my == S - 1, jnp.mean(nll), 0.0)
+        loss = lax.psum(loss, PP_AXIS)
+        return loss[None]
+
+    losses_fn = shard_map(
+        device_loss,
+        mesh=mesh,
+        in_specs=(params_n_specs, P(WORKER_AXIS, None, None)),
+        out_specs=P(WORKER_AXIS),
+        check_vma=False,
+    )
+
+    def _broadcast_n(params):
+        bcast = jax.tree.map(
+            lambda x: jnp.broadcast_to(x[None], (n,) + x.shape), params
+        )
+        return _constrain_params(bcast, mesh, _leaf_spec_n)
+
+    def per_worker_loss(params, tokens):
+        return losses_fn(_broadcast_n(params), tokens)
+
+    def per_worker_grads(params, tokens):
+        def total(params_n):
+            losses = losses_fn(params_n, tokens)
+            return jnp.sum(losses), losses
+
+        grads_n, losses = jax.grad(total, has_aux=True)(_broadcast_n(params))
+        flat = _flatten_rows(grads_n)
+        return lax.with_sharding_constraint(
+            flat, NamedSharding(mesh, P(WORKER_AXIS))
+        ), losses
+
+    if cfg.approach == "cyclic":
+        code = cyclic_mod.build_cyclic_code(n, cfg.worker_fail)
+        rand_factor = jnp.asarray(drng.random_projection_factors(cfg.seed, dim))
+    else:
+        code = None
+        rand_factor = None
+
+    def step_body(state: TrainState, tokens, adv_mask):
+        grads, losses = per_worker_grads(state.params, tokens)
+        agg = aggregate_flat_grads(grads, adv_mask, cfg, code, rand_factor)
+        new_params, new_opt = apply_flat_update(state, agg, opt, unravel)
+        new_state = TrainState(
+            _constrain_params(new_params, mesh, _leaf_spec), new_opt, None,
+            state.step + 1,
+        )
+        return new_state, {"loss": jnp.mean(losses)}
+
+    def eval_body(params, tokens):
+        return jnp.mean(per_worker_loss(params, tokens))
+
+    with mesh:
+        train_step = jax.jit(step_body, donate_argnums=(0,))
+        eval_step = jax.jit(eval_body)
+        loss_jit = jax.jit(per_worker_loss)
+        grads_jit = jax.jit(per_worker_grads)
+
+    return PPTrainSetup(
+        state=state, train_step=train_step, eval_step=eval_step,
+        per_worker_loss=loss_jit, per_worker_grads=grads_jit,
+        code=code, unravel=unravel, dim=dim,
+    )
+
+
+def train_pp(cfg: TrainConfig, mesh, steps: Optional[int] = None,
+             quiet: bool = False):
+    """PP training loop; returns (state, last metrics)."""
+    from draco_tpu.parallel.tp_step import run_token_loop
+
+    setup = build_pp_train_setup(cfg, mesh)
+    return run_token_loop(setup, cfg, steps, quiet, tag="pp")
