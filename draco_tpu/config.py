@@ -259,10 +259,13 @@ class TrainConfig:
                 )
             if self.sp_attn not in ("ring", "a2a"):
                 raise ValueError(f"sp_attn must be ring|a2a, got {self.sp_attn}")
+            # pp_microbatches alone activates the pipeline path (cli.py),
+            # so it counts as the pp axis being in use
+            pp_active = self.pipeline_shards > 1 or self.pp_microbatches > 0
             if (
                 sum(int(x > 1) for x in
-                    (self.tensor_shards, self.seq_shards, self.expert_shards,
-                     self.pipeline_shards))
+                    (self.tensor_shards, self.seq_shards, self.expert_shards))
+                + int(pp_active)
                 > 1
             ):
                 raise ValueError(
@@ -319,11 +322,11 @@ class TrainConfig:
                 raise ValueError(
                     "pipeline_shards must be >= 1 and pp_microbatches >= 0"
                 )
-            if self.pipeline_shards > 1 or self.pp_microbatches > 0:
-                if self.moe_experts > 0 and self.pipeline_shards > 1:
+            if pp_active:
+                if self.moe_experts > 0:
                     raise ValueError(
-                        "pipeline_shards with moe_experts is not implemented "
-                        "(the pipeline's scanned block stack covers the dense "
+                        "the pipeline path with moe_experts is not implemented "
+                        "(pp_step's scanned block stack covers the dense "
                         "MLP only)"
                     )
                 if self.model_layers % max(self.pipeline_shards, 1):
