@@ -141,7 +141,7 @@ def run(cfg_kwargs, ds, mesh, steps, warmup=1, reps=2, want_flops=False):
     from draco_tpu.config import TrainConfig
     from draco_tpu.runtime import WORKER_AXIS, put_global
     from draco_tpu.training.trainer import Trainer
-    from draco_tpu.utils.timing import fetch_scalar, measure_rtt
+    from draco_tpu.utils.timing import time_scanned_steps
 
     cfg = TrainConfig(**cfg_kwargs)
     tr = Trainer(cfg, mesh=mesh, dataset=ds, quiet=True)
@@ -195,16 +195,9 @@ def run(cfg_kwargs, ds, mesh, steps, warmup=1, reps=2, want_flops=False):
     # flops), so the loop's flops figure already IS the per-step figure.
     flops = _compiled_flops(compiled) if want_flops else None
 
-    rtt = measure_rtt()
-    st = state
-    for _ in range(max(warmup, 1)):  # un-timed settle scans (incl. compile)
-        st, losses = compiled(st, xs, ys, ms)
-    fetch_scalar(losses)
-    t0 = time.perf_counter()
-    for _ in range(max(reps, 1)):
-        st, losses = compiled(st, xs, ys, ms)
-    fetch_scalar(losses)
-    dt = max(time.perf_counter() - t0 - rtt, 0.0) / (max(reps, 1) * steps)
+    dt, losses = time_scanned_steps(
+        compiled, state, (xs, ys, ms), steps=steps, warmup=warmup, reps=reps
+    )
     loss = float(np.asarray(jax.device_get(losses))[-1])
     tr.close()
     return dt, loss, flops

@@ -110,8 +110,15 @@ def _build_gspmd_train_setup(cfg: TrainConfig, mesh, *, mp_axis: str,
     if cfg.approach not in ("baseline", "cyclic"):
         raise ValueError(f"MP path supports baseline|cyclic, got {cfg.approach}")
     n = cfg.num_workers
-    assert mesh.shape[WORKER_AXIS] == n, (mesh.shape, n)
-    # the mesh defines the actual shard count — it must be the one the
+    # logical workers fold onto the available w-axis devices in equal blocks
+    # (same discipline as runtime.make_mesh for the CNN path) — a single
+    # chip can still run the n-lane coded step, vmapped
+    if n % mesh.shape[WORKER_AXIS]:
+        raise ValueError(
+            f"num_workers {n} must be a multiple of the mesh's w axis "
+            f"({mesh.shape[WORKER_AXIS]})"
+        )
+    # the mesh defines the actual mp shard count — it must be the one the
     # config's divisibility checks validated, or GSPMD silently pads
     if mesh.shape[mp_axis] != mp_size:
         raise ValueError(

@@ -94,6 +94,28 @@ def timeit_chained(step, carry, consts=(), reps: int = 20,
     return max(total, 0.0) / reps
 
 
+def time_scanned_steps(compiled_loop, init_state, operands, *, steps: int,
+                       warmup: int = 1, reps: int = 2):
+    """Per-step seconds of a compiled ``lax.scan``-of-train-steps loop under
+    the fetch-sync protocol (items 1-4 above), plus the final per-step loss
+    array. ``compiled_loop(state, *operands) -> (state, losses)`` must fold
+    ``steps`` steps into one device program; warmup executions settle
+    compile/donation, timed reps chain through the state. Shared by bench.py
+    and tools/tpu_lm_perf.py so the protocol cannot drift between them."""
+    rtt = measure_rtt()
+    st = init_state
+    losses = None
+    for _ in range(max(warmup, 1)):
+        st, losses = compiled_loop(st, *operands)
+    fetch_scalar(losses)
+    t0 = time.perf_counter()
+    for _ in range(max(reps, 1)):
+        st, losses = compiled_loop(st, *operands)
+    fetch_scalar(losses)
+    dt = max(time.perf_counter() - t0 - rtt, 0.0) / (max(reps, 1) * steps)
+    return dt, losses
+
+
 def timeit_device(fn, *args, reps: int = 30, rtt: float | None = None) -> float:
     """Average seconds per ``fn(*args)`` call with execution-barrier sync.
 

@@ -1,0 +1,45 @@
+"""The driver's entry points must keep working: entry() compiles single-chip;
+dryrun_multichip(N) jits the full coded training step plus every 2-D
+(w × sp/tp/pp/ep) composition over an N-device mesh and executes one step.
+
+Run in a subprocess because dryrun_multichip pins the device count / platform
+at backend init, which must not leak into this process (conftest pins 8)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+
+@pytest.mark.parametrize("n", [16])
+def test_dryrun_multichip_subprocess(n):
+    """VERDICT round-1 item 9: exercise the sharding envelope at n beyond the
+    reference's 8-worker cluster (w=8 rows make every 2-D composition run
+    approach=cyclic with a live adversary; the 1-D path runs s=3)."""
+    env = dict(os.environ)
+    # the conftest pins an 8-device mesh via XLA_FLAGS (and a shell may pin
+    # JAX_PLATFORMS); dryrun_multichip must choose both itself
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, "-c",
+         f"import __graft_entry__ as g; g.dryrun_multichip({n})"],
+        capture_output=True, text=True, timeout=560, env=env, cwd=repo,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    out = r.stdout
+    assert f"dryrun_multichip({n}): approach=cyclic ok" in out
+    for axis in ("sp", "tp", "pp", "ep"):
+        assert f"× {axis}=2) approach=cyclic" in out, (axis, out)
+
+
+def test_entry_compiles():
+    """entry() must lower and compile standalone (single chip / CPU)."""
+    import jax
+
+    import __graft_entry__ as g
+
+    fn, args = g.entry()
+    jax.jit(fn).lower(*args).compile()

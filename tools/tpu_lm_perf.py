@@ -1,0 +1,186 @@
+#!/usr/bin/env python
+"""Transformer-scale perf evidence for the coded-DP step (single chip).
+
+The CNN headline (tools/tpu_perf.py) is HBM-bound at 32×32 — MFU 11.5%
+says nothing about the framework on MXU-shaped work. This tool measures the
+TransformerLM coded step at a size where the matmuls dominate, in bfloat16,
+and shows how the paper's decode-vs-geomedian gap (reference README.md:2,
+baseline_master.py:271-276) grows with gradient dimension d: Weiszfeld is
+80 full passes over the (n, d) stack per step, the cyclic decode a handful.
+
+Variants (all n logical coded workers vmapped on the available devices via
+the GSPMD LM path, parallel/tp_step.py):
+  * cyclic s=1, shared-redundancy encode (the LM paths' native encode)
+  * geometric median (80 Weiszfeld iterations)
+  * krum
+  * plain mean, no attack (lower bound)
+
+Timing: utils/timing.py protocol — steps folded into ONE jitted lax.scan
+over pre-staged token batches, device→host fetch sync, minus RTT. FLOPs
+from XLA cost analysis of the compiled scan (counts the body once). Run
+with the host otherwise idle (PERF.md §4).
+
+Usage: python tools/tpu_lm_perf.py [--cpu-mesh N for smoke]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run_lm(cfg, mesh, steps, warmup=1, reps=2):
+    """(ms/step, flops/step, last loss) of the jitted LM train step scan."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import bench
+    from draco_tpu import rng as drng
+    from draco_tpu.parallel.sp_step import synthetic_text
+    from draco_tpu.parallel.tp_step import build_tp_train_setup
+    from draco_tpu.utils.timing import time_scanned_steps
+
+    setup = build_tp_train_setup(cfg, mesh)
+    adv = drng.adversary_schedule(cfg.seed, steps + 1, cfg.num_workers,
+                                  cfg.worker_fail)
+    xs = jnp.asarray(np.stack([
+        synthetic_text(cfg.seed, s, cfg.num_workers, cfg.batch_size,
+                       cfg.seq_len, cfg.vocab)
+        for s in range(1, steps + 1)
+    ]))
+    ms = jnp.asarray(np.stack([np.asarray(adv[s]) for s in range(1, steps + 1)]))
+
+    def loop(state, xs, ms):
+        def body(st, batch):
+            toks, mask = batch
+            st, metrics = setup.train_step(st, toks, mask)
+            return st, metrics["loss"]
+        return jax.lax.scan(body, state, (xs, ms))
+
+    with mesh:
+        compiled = jax.jit(loop).lower(setup.state, xs, ms).compile()
+    flops = bench._compiled_flops(compiled)
+
+    if jax.devices()[0].platform == "cpu":
+        # local CPU: block_until_ready is a real barrier; smoke only
+        st, losses = compiled(setup.state, xs, ms)
+        jax.block_until_ready(losses)
+        t0 = time.perf_counter()
+        st, losses = compiled(st, xs, ms)
+        jax.block_until_ready(losses)
+        dt = (time.perf_counter() - t0) / steps
+        return dt * 1e3, flops, float(np.asarray(losses)[-1])
+
+    dt, losses = time_scanned_steps(
+        compiled, setup.state, (xs, ms), steps=steps, warmup=warmup, reps=reps
+    )
+    return dt * 1e3, flops, float(np.asarray(jax.device_get(losses))[-1])
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", type=str, default="baselines_out/tpu_lm_perf.json")
+    ap.add_argument("--steps", type=int, default=4)
+    ap.add_argument("--reps", type=int, default=2)
+    ap.add_argument("--num-workers", type=int, default=8)
+    ap.add_argument("--batch-size", type=int, default=2)
+    ap.add_argument("--seq-len", type=int, default=512)
+    ap.add_argument("--model-dim", type=int, default=768)
+    ap.add_argument("--model-heads", type=int, default=12)
+    ap.add_argument("--model-layers", type=int, default=8)
+    ap.add_argument("--vocab", type=int, default=8192)
+    ap.add_argument("--cpu-mesh", type=int, default=0)
+    args = ap.parse_args(argv)
+
+    if args.cpu_mesh:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={args.cpu_mesh}"
+        ).strip()
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    import bench
+    from draco_tpu.config import TrainConfig
+    from draco_tpu.runtime import WORKER_AXIS, make_mesh
+    from draco_tpu.parallel.mesh import TP_AXIS
+
+    # make_mesh owns the logical-workers→devices fold (and warns loudly when
+    # devices idle); add a trivial tp=1 axis so the GSPMD LM builder applies
+    fold = make_mesh(args.num_workers).devices.ravel()
+    mesh = Mesh(np.asarray(fold).reshape(len(fold), 1), (WORKER_AXIS, TP_AXIS))
+    dev = jax.devices()[0]
+    n_dev = len(fold)
+
+    common = dict(
+        network="TransformerLM", dataset="synthetic-text",
+        batch_size=args.batch_size, lr=0.01, momentum=0.9,
+        num_workers=args.num_workers, worker_fail=1, err_mode="rev_grad",
+        seq_len=args.seq_len, vocab=args.vocab, model_dim=args.model_dim,
+        model_heads=args.model_heads, model_layers=args.model_layers,
+        compute_dtype="bfloat16", max_steps=args.steps + 1, eval_freq=0,
+        train_dir="", log_every=10**9,
+    )
+    variants = {
+        "lm_cyclic_s1_shared_bf16": dict(common, approach="cyclic"),
+        "lm_geomedian_bf16": dict(common, approach="baseline",
+                                  mode="geometric_median"),
+        "lm_krum_bf16": dict(common, approach="baseline", mode="krum"),
+        "lm_mean_no_attack_bf16": dict(common, approach="baseline",
+                                       mode="normal", worker_fail=0),
+    }
+
+    report = {
+        "platform": dev.platform,
+        "device_kind": getattr(dev, "device_kind", dev.platform),
+        "num_workers": args.num_workers,
+        "devices_used": n_dev,
+        "batch_size_per_worker": args.batch_size,
+        "seq_len": args.seq_len,
+        "model_dim": args.model_dim,
+        "model_layers": args.model_layers,
+        "vocab": args.vocab,
+        "tokens_per_step": args.num_workers * args.batch_size * args.seq_len,
+        "steps_per_scan": args.steps,
+    }
+    peak = bench._peak_flops(report["device_kind"])
+    for name, kw in variants.items():
+        print(f"[tpu_lm_perf] measuring {name} ...", file=sys.stderr, flush=True)
+        t0 = time.time()
+        ms, flops, loss = run_lm(TrainConfig(**kw), mesh, args.steps,
+                                 reps=args.reps)
+        print(f"[tpu_lm_perf] {name}: {ms:.2f} ms/step ({time.time()-t0:.0f}s)",
+              file=sys.stderr, flush=True)
+        report[f"{name}_step_ms"] = round(ms, 3)
+        report[f"{name}_loss"] = round(loss, 4)
+        if flops:
+            report[f"{name}_flops_per_step"] = flops
+            if peak:
+                report[f"{name}_mfu_vs_bf16_peak"] = round(
+                    flops / (ms * 1e-3) / peak, 4
+                )
+    report["lm_cyclic_vs_geomedian_step_speedup"] = round(
+        report["lm_geomedian_bf16_step_ms"]
+        / report["lm_cyclic_s1_shared_bf16_step_ms"], 3
+    )
+
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+    with open(args.out, "w") as fh:
+        json.dump(report, fh, indent=1)
+    print(json.dumps(report))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
