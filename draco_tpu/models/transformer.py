@@ -90,16 +90,25 @@ class TransformerLM(nn.Module):
     attn_fn: Optional[AttnFn] = None
     experts: int = 0  # >0: every block's MLP becomes a Switch MoE
     dtype: Any = jnp.float32
+    # per-block rematerialisation: drop each block's activations and
+    # recompute them in backward (jax.checkpoint) — peak activation memory
+    # becomes one block's instead of `layers` blocks', buying long sequences
+    # / big batches for FLOPs. Collectives inside a block (ring attention's
+    # ppermute hops) replay in the recompute, which is SPMD-safe.
+    remat: bool = False
 
     @nn.compact
     def __call__(self, tokens, pos_offset=0, train: bool = True):
         emb = nn.Embed(self.vocab, self.dim, name="embed")
         x = emb(tokens).astype(self.dtype)
         positions = pos_offset + jnp.arange(tokens.shape[1])
+        # static_argnums counts self as 0 (flax subtracts 1 internally), so
+        # the train flag of __call__(self, x, positions, train) is 3
+        blk_cls = nn.remat(Block, static_argnums=(3,)) if self.remat else Block
         for i in range(self.layers):
-            x = Block(self.dim, self.heads, attn_fn=self.attn_fn,
-                      experts=self.experts, dtype=self.dtype,
-                      name=f"block{i}")(x, positions, train)
+            x = blk_cls(self.dim, self.heads, attn_fn=self.attn_fn,
+                        experts=self.experts, dtype=self.dtype,
+                        name=f"block{i}")(x, positions, train)
         x = nn.LayerNorm(use_bias=False, name="final_ln")(x)
         # logits in float32 (loss numerics)
         return emb.attend(x.astype(jnp.float32))

@@ -55,10 +55,17 @@ class _PipeBlock(nn.Module):
     dim: int
     heads: int
     dtype: Any
+    remat: bool = False
 
     @nn.compact
     def __call__(self, x, positions):
-        x = Block(self.dim, self.heads, dtype=self.dtype, name="b")(
+        # static_argnums counts self as 0, so `train` is 3; CSE prevention
+        # is unnecessary inside nn.scan (flax checkpoint docs) and would
+        # put a barrier in every scanned body
+        blk_cls = Block if not self.remat else nn.remat(
+            Block, static_argnums=(3,), prevent_cse=False
+        )
+        x = blk_cls(self.dim, self.heads, dtype=self.dtype, name="b")(
             x, positions, True
         )
         return x, None
@@ -76,6 +83,7 @@ class StageBlocks(nn.Module):
     heads: int
     layers: int
     dtype: Any = jnp.float32
+    remat: bool = False
 
     @nn.compact
     def __call__(self, x, positions):
@@ -86,7 +94,8 @@ class StageBlocks(nn.Module):
             length=self.layers,
             in_axes=nn.broadcast,
         )
-        x, _ = scan(self.dim, self.heads, self.dtype, name="loop")(x, positions)
+        x, _ = scan(self.dim, self.heads, self.dtype, self.remat,
+                    name="loop")(x, positions)
         return x
 
 
@@ -128,9 +137,10 @@ def build_pp_train_setup(cfg: TrainConfig, mesh) -> PPTrainSetup:
 
     cdtype = jnp.dtype(cfg.compute_dtype)
     embed = nn.Embed(cfg.vocab, cfg.model_dim, name="embed")
-    blocks_full = StageBlocks(cfg.model_dim, cfg.model_heads, layers=L, dtype=cdtype)
+    blocks_full = StageBlocks(cfg.model_dim, cfg.model_heads, layers=L,
+                              dtype=cdtype, remat=cfg.remat)
     blocks_stage = StageBlocks(cfg.model_dim, cfg.model_heads, layers=l_loc,
-                               dtype=cdtype)
+                               dtype=cdtype, remat=cfg.remat)
     final_ln = nn.LayerNorm(use_bias=False, name="final_ln")
 
     root = jax.random.key(cfg.seed)

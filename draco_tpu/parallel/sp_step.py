@@ -75,7 +75,7 @@ def build_sp_train_setup(cfg: TrainConfig, mesh) -> SPTrainSetup:
     model = TransformerLM(
         vocab=cfg.vocab, dim=cfg.model_dim, heads=cfg.model_heads,
         layers=cfg.model_layers, attn_fn=attn, experts=cfg.moe_experts,
-        dtype=cdtype,
+        dtype=cdtype, remat=cfg.remat,
     )
     # init single-shard (dense attention) — parameter shapes are identical
     init_model = TransformerLM(

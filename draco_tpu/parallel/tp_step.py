@@ -130,6 +130,7 @@ def _build_gspmd_train_setup(cfg: TrainConfig, mesh, *, mp_axis: str,
     model = TransformerLM(
         vocab=cfg.vocab, dim=cfg.model_dim, heads=cfg.model_heads,
         layers=cfg.model_layers, attn_fn=None, experts=experts, dtype=cdtype,
+        remat=cfg.remat,
     )
     root = jax.random.key(cfg.seed)
     init_toks = jnp.zeros((1, min(cfg.seq_len, 8)), jnp.int32)

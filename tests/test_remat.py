@@ -1,0 +1,68 @@
+"""remat=True must change memory behavior only: losses and per-worker
+gradients identical to the non-remat step on every LM path (per-block
+jax.checkpoint — models/transformer.py, pp_step's scanned stack). The
+CNN path's remat is covered by tests/test_train_step.py."""
+
+import jax
+import numpy as np
+import pytest
+
+from draco_tpu.config import TrainConfig
+from draco_tpu.parallel import make_mesh_2d, make_mesh_wpp, make_mesh_wtp
+from draco_tpu.parallel.pp_step import build_pp_train_setup
+from draco_tpu.parallel.sp_step import build_sp_train_setup
+from draco_tpu.parallel.tp_step import build_tp_train_setup
+from tests.test_parallel_pp import _cfg, _toks
+
+
+def _lm_cfg(**kw):
+    return _cfg(pipeline_shards=1, pp_microbatches=0, **kw)
+
+
+def test_tp_remat_grads_exact():
+    cfg0 = _lm_cfg(num_workers=4, tensor_shards=2)
+    cfg1 = _lm_cfg(num_workers=4, tensor_shards=2, remat=True)
+    mesh = make_mesh_wtp(4, 2)
+    s0 = build_tp_train_setup(cfg0, mesh)
+    s1 = build_tp_train_setup(cfg1, mesh)
+    toks = _toks(cfg0)
+    adv = np.zeros(4, dtype=bool)
+    st0, m0 = s0.train_step(s0.state, toks, adv)
+    st1, m1 = s1.train_step(s1.state, toks, adv)
+    assert float(m0["loss"]) == pytest.approx(float(m1["loss"]), rel=1e-6)
+    a = np.asarray(jax.device_get(st0.params["embed"]["embedding"]))
+    b = np.asarray(jax.device_get(st1.params["embed"]["embedding"]))
+    np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
+
+
+def test_pp_remat_grads_exact():
+    cfg0 = _cfg(num_workers=2, pipeline_shards=4)
+    cfg1 = _cfg(num_workers=2, pipeline_shards=4, remat=True)
+    mesh = make_mesh_wpp(2, 4)
+    s0 = build_pp_train_setup(cfg0, mesh)
+    s1 = build_pp_train_setup(cfg1, mesh)
+    toks = _toks(cfg0)
+    g0, _ = s0.per_worker_grads(s0.state.params, toks)
+    g1, _ = s1.per_worker_grads(s1.state.params, toks)
+    np.testing.assert_allclose(
+        np.asarray(jax.device_get(g0)), np.asarray(jax.device_get(g1)),
+        rtol=1e-6, atol=1e-7,
+    )
+
+
+def test_sp_remat_ring_attention_exact():
+    """remat recomputes blocks containing ring ppermute hops — the
+    recompute's collectives must replay identically."""
+    cfg0 = _lm_cfg(num_workers=2, seq_shards=4)
+    cfg1 = _lm_cfg(num_workers=2, seq_shards=4, remat=True)
+    mesh = make_mesh_2d(2, 4)
+    s0 = build_sp_train_setup(cfg0, mesh)
+    s1 = build_sp_train_setup(cfg1, mesh)
+    toks = _toks(cfg0)
+    adv = np.zeros(2, dtype=bool)
+    st0, m0 = s0.train_step(s0.state, toks, adv)
+    st1, m1 = s1.train_step(s1.state, toks, adv)
+    assert float(m0["loss"]) == pytest.approx(float(m1["loss"]), rel=1e-6)
+    a = np.asarray(jax.device_get(st0.params["embed"]["embedding"]))
+    b = np.asarray(jax.device_get(st1.params["embed"]["embedding"]))
+    np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)

@@ -95,6 +95,11 @@ def main(argv=None) -> int:
     ap.add_argument("--model-heads", type=int, default=12)
     ap.add_argument("--model-layers", type=int, default=8)
     ap.add_argument("--vocab", type=int, default=8192)
+    ap.add_argument("--remat", action="store_true",
+                    help="per-block rematerialisation — buys bigger "
+                         "batch × seq at ~1/3 extra fwd FLOPs")
+    ap.add_argument("--variants", type=str, default="",
+                    help="comma-separated subset of variants to run")
     ap.add_argument("--cpu-mesh", type=int, default=0)
     args = ap.parse_args(argv)
 
@@ -129,7 +134,8 @@ def main(argv=None) -> int:
         num_workers=args.num_workers, worker_fail=1, err_mode="rev_grad",
         seq_len=args.seq_len, vocab=args.vocab, model_dim=args.model_dim,
         model_heads=args.model_heads, model_layers=args.model_layers,
-        compute_dtype="bfloat16", max_steps=args.steps + 1, eval_freq=0,
+        compute_dtype="bfloat16", remat=args.remat,
+        max_steps=args.steps + 1, eval_freq=0,
         train_dir="", log_every=10**9,
     )
     variants = {
@@ -141,8 +147,15 @@ def main(argv=None) -> int:
                                        mode="normal", worker_fail=0),
     }
 
+    if args.variants:
+        keep = {v.strip() for v in args.variants.split(",")}
+        variants = {k: v for k, v in variants.items() if k in keep}
+        if not variants:
+            raise SystemExit(f"no variants match {sorted(keep)}")
+
     report = {
         "platform": dev.platform,
+        "remat": args.remat,
         "device_kind": getattr(dev, "device_kind", dev.platform),
         "num_workers": args.num_workers,
         "devices_used": n_dev,
@@ -170,10 +183,12 @@ def main(argv=None) -> int:
                 report[f"{name}_mfu_vs_bf16_peak"] = round(
                     flops / (ms * 1e-3) / peak, 4
                 )
-    report["lm_cyclic_vs_geomedian_step_speedup"] = round(
-        report["lm_geomedian_bf16_step_ms"]
-        / report["lm_cyclic_s1_shared_bf16_step_ms"], 3
-    )
+    if ("lm_geomedian_bf16_step_ms" in report
+            and "lm_cyclic_s1_shared_bf16_step_ms" in report):
+        report["lm_cyclic_vs_geomedian_step_speedup"] = round(
+            report["lm_geomedian_bf16_step_ms"]
+            / report["lm_cyclic_s1_shared_bf16_step_ms"], 3
+        )
 
     os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
     with open(args.out, "w") as fh:
