@@ -76,6 +76,11 @@ def add_fit_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                    choices=["ring", "a2a"],
                    help="sequence-parallel attention: ring (ppermute K/V "
                         "blocks) or a2a (Ulysses head-scatter all_to_all)")
+    p.add_argument("--attn-impl", type=str, default="dense",
+                   choices=["dense", "flash"],
+                   help="single-shard attention: dense (T,T) scores or the "
+                        "Pallas blockwise flash kernel (long context on one "
+                        "chip; ops/flash_attention.py)")
     p.add_argument("--tensor-shards", type=int, default=1,
                    help="tp mesh-axis size (Megatron GSPMD path, tp_step.py)")
     p.add_argument("--moe-experts", type=int, default=0,
@@ -153,6 +158,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         log_every=args.log_every,
         seq_shards=args.seq_shards,
         sp_attn=args.sp_attn,
+        attn_impl=args.attn_impl,
         tensor_shards=args.tensor_shards,
         moe_experts=args.moe_experts,
         expert_shards=args.expert_shards,

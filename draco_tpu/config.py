@@ -86,6 +86,11 @@ class TrainConfig:
     # (2 collectives total, needs model_heads % sp == 0, materialises the
     # full (T,T) score block per head group)
     sp_attn: str = "ring"
+    # Single-shard attention implementation (seq_shards == 1): "dense"
+    # materialises (T, T) scores per head; "flash" is the Pallas blockwise
+    # kernel (ops/flash_attention.py) — O(T·Dh) memory, for long sequences
+    # on one chip. Off-TPU it falls back to dense automatically.
+    attn_impl: str = "dense"
     # tp mesh-axis size for the GSPMD tensor-parallel path (parallel/
     # tp_step.py); composes with the coded worker axis on a (w, tp) mesh
     tensor_shards: int = 1
@@ -259,6 +264,24 @@ class TrainConfig:
                 )
             if self.sp_attn not in ("ring", "a2a"):
                 raise ValueError(f"sp_attn must be ring|a2a, got {self.sp_attn}")
+            if self.attn_impl not in ("dense", "flash"):
+                raise ValueError(
+                    f"attn_impl must be dense|flash, got {self.attn_impl}"
+                )
+            if self.attn_impl == "flash" and self.seq_shards > 1:
+                raise ValueError(
+                    "attn_impl=flash applies to single-shard attention; "
+                    "sequence-parallel runs choose sp_attn (ring|a2a) instead"
+                )
+            if self.attn_impl == "flash" and (
+                self.tensor_shards > 1 or self.expert_shards > 1
+                or self.moe_experts > 0
+            ):
+                raise ValueError(
+                    "attn_impl=flash runs on the shard_map paths (sp/pp): "
+                    "the GSPMD paths (tensor_shards/expert_shards/moe) "
+                    "cannot partition an opaque Pallas call over the mesh"
+                )
             # pp_microbatches alone activates the pipeline path (cli.py),
             # so it counts as the pp axis being in use
             pp_active = self.pipeline_shards > 1 or self.pp_microbatches > 0

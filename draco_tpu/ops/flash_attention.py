@@ -1,0 +1,331 @@
+"""Blockwise causal flash attention as a Pallas TPU kernel.
+
+The LM paths' single-shard attention (parallel/ring_attention.dense_attention)
+materialises the full (T, T) score matrix per head — O(T²) HBM traffic and
+memory that caps sequence length on one chip. This kernel streams K/V blocks
+through VMEM with the online-softmax accumulators (the same m/l/o algebra the
+ring uses *across chips*, here applied *within* a chip's sequence), so peak
+memory is O(T·Dh + block²) and the (T, T) matrix never exists.
+
+Forward saves only the per-row log-sum-exp; backward recomputes the
+probability blocks in two passes (dq sweeping K blocks, dk/dv sweeping Q
+blocks) — the standard flash-attention custom VJP, each pass again never
+materialising (T, T).
+
+Block-causal skipping: grid steps with j > i (keys entirely in the future)
+compute nothing (`pl.when`), so causal attention does ~half the block work.
+
+No reference counterpart (the reference is CNN-only, SURVEY.md §5.7); this
+is a hot-op kernel of the TPU build's long-context axis, complementing ring
+attention (which shards T across chips; this kernel serves each shard or the
+single-chip case). Dispatch mirrors ops/coded.py: Pallas on TPU backends,
+dense jnp fallback elsewhere; interpret mode in CI.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from draco_tpu.ops.coded import use_pallas
+
+NEG_INF = -1e30
+_LANE = 128
+
+
+def _ceil_to(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(scale, nk, bq, bk, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                acc_ref, m_ref, l_ref):
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    # a (i, j) block pair holds >= 1 causal (q_pos >= k_pos) entry iff the
+    # block's earliest key is no later than its latest query — comparing raw
+    # block indices (j <= i) is only correct when bq == bk
+    @pl.when(j * bk <= i * bq + bq - 1)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # (bq, bk)
+        q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        k_pos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_prev = m_ref[...]  # (bq, 1)
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_cur)
+        corr = jnp.exp(m_prev - m_cur)  # (bq, 1)
+        l_ref[...] = l_ref[...] * corr + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * corr + jax.lax.dot(
+            p, v, preferred_element_type=jnp.float32
+        )
+        m_ref[...] = m_cur
+
+    @pl.when(j == nk - 1)
+    def _flush():
+        l = jnp.maximum(l_ref[...], 1e-30)
+        o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
+        lse_ref[0] = (m_ref[...] + jnp.log(l))[:, 0]
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("scale", "bq", "bk", "interpret"))
+def _flash_fwd(q, k, v, scale, bq, bk, interpret):
+    """q, k, v: (G, T, Dh_padded) f32 (G = B·H folded). ``scale`` comes from
+    the TRUE head dim (the lane padding must not change the softmax
+    temperature). Returns (o, lse)."""
+    g, t, dh = q.shape
+    nq, nk = t // bq, t // bk
+    grid = (g, nq, nk)
+    kern = functools.partial(_fwd_kernel, scale, nk, bq, bk)
+    return pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, dh), lambda g, i, j: (g, i, 0)),
+            pl.BlockSpec((1, bk, dh), lambda g, i, j: (g, j, 0)),
+            pl.BlockSpec((1, bk, dh), lambda g, i, j: (g, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, dh), lambda g, i, j: (g, i, 0)),
+            pl.BlockSpec((1, bq), lambda g, i, j: (g, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((g, t, dh), q.dtype),
+            jax.ShapeDtypeStruct((g, t), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, dh), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+def _p_block(q_ref, k_ref, lse_ref, scale, i, j):
+    """Recompute the masked probability block P = exp(S - lse)."""
+    q = q_ref[0].astype(jnp.float32)
+    k = k_ref[0].astype(jnp.float32)
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale
+    bq, bk = s.shape
+    q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    k_pos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    return jnp.exp(s - lse_ref[0][:, None])
+
+
+def _dq_kernel(scale, nk, bq, bk, q_ref, k_ref, v_ref, do_ref, lse_ref,
+               dcap_ref, dq_ref, dq_acc):
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    @pl.when(j * bk <= i * bq + bq - 1)
+    def _compute():
+        p = _p_block(q_ref, k_ref, lse_ref, scale, i, j)  # (bq, bk)
+        do = do_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # (bq, bk)
+        ds = p * (dp - dcap_ref[0][:, None])
+        dq_acc[...] += jax.lax.dot(
+            ds, k_ref[0].astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        ) * scale
+
+    @pl.when(j == nk - 1)
+    def _flush():
+        dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(scale, nq, bq, bk, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                dcap_ref, dk_ref, dv_ref, dk_acc, dv_acc):
+    j = pl.program_id(1)
+    i = pl.program_id(2)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    @pl.when(i * bq + bq - 1 >= j * bk)
+    def _compute():
+        p = _p_block(q_ref, k_ref, lse_ref, scale, i, j)  # (bq, bk)
+        do = do_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        dv_acc[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )  # pᵀ · do -> (bk, dh)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - dcap_ref[0][:, None])
+        dk_acc[...] += jax.lax.dot_general(
+            ds, q_ref[0].astype(jnp.float32),
+            (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+        ) * scale  # dsᵀ · q -> (bk, dh)
+
+    @pl.when(i == nq - 1)
+    def _flush():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("scale", "bq", "bk", "interpret"))
+def _flash_bwd(q, k, v, o, lse, do, scale, bq, bk, interpret):
+    g, t, dh = q.shape
+    nq, nk = t // bq, t // bk
+    dcap = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale, nk, bq, bk),
+        grid=(g, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, dh), lambda g, i, j: (g, i, 0)),
+            pl.BlockSpec((1, bk, dh), lambda g, i, j: (g, j, 0)),
+            pl.BlockSpec((1, bk, dh), lambda g, i, j: (g, j, 0)),
+            pl.BlockSpec((1, bq, dh), lambda g, i, j: (g, i, 0)),
+            pl.BlockSpec((1, bq), lambda g, i, j: (g, i)),
+            pl.BlockSpec((1, bq), lambda g, i, j: (g, i)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, dh), lambda g, i, j: (g, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((g, t, dh), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, dh), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(q, k, v, do, lse, dcap)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale, nq, bq, bk),
+        grid=(g, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, bq, dh), lambda g, j, i: (g, i, 0)),
+            pl.BlockSpec((1, bk, dh), lambda g, j, i: (g, j, 0)),
+            pl.BlockSpec((1, bk, dh), lambda g, j, i: (g, j, 0)),
+            pl.BlockSpec((1, bq, dh), lambda g, j, i: (g, i, 0)),
+            pl.BlockSpec((1, bq), lambda g, j, i: (g, i)),
+            pl.BlockSpec((1, bq), lambda g, j, i: (g, i)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, dh), lambda g, j, i: (g, j, 0)),
+            pl.BlockSpec((1, bk, dh), lambda g, j, i: (g, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((g, t, dh), k.dtype),
+            jax.ShapeDtypeStruct((g, t, dh), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, dh), jnp.float32),
+            pltpu.VMEM((bk, dh), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(q, k, v, do, lse, dcap)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# custom-vjp core on (G, T, Dh)
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_core(q, k, v, scale, bq, bk, interpret):
+    o, _ = _flash_fwd(q, k, v, scale, bq, bk, interpret)
+    return o
+
+
+def _flash_core_fwd(q, k, v, scale, bq, bk, interpret):
+    o, lse = _flash_fwd(q, k, v, scale, bq, bk, interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_core_bwd(scale, bq, bk, interpret, res, do):
+    q, k, v, o, lse = res
+    return _flash_bwd(q, k, v, o, lse, do, scale, bq, bk, interpret)
+
+
+_flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
+
+
+# ---------------------------------------------------------------------------
+# public entry — AttnFn contract of models/transformer.Block
+# ---------------------------------------------------------------------------
+
+def flash_attention(q, k, v, *, block_q: int = 128, block_k: int = 128,
+                    force=None, interpret: bool = False):
+    """Causal self-attention. q, k, v: (B, T, H, Dh) — the Block contract
+    (attention math upstream is f32; the kernel accumulates f32 regardless).
+
+    The causal mask is offset-invariant for self-attention (q and k share
+    positions), so no offset argument is needed. Falls back to the dense
+    streaming-softmax path off-TPU, when T doesn't tile, or when T is too
+    small to block.
+    """
+    from draco_tpu.parallel.ring_attention import dense_attention
+
+    b, t, h, dh = q.shape
+    bq = min(block_q, t)
+    bk = min(block_k, t)
+    use = force if force is not None else (use_pallas() or interpret)
+    # t % 8: blocks must honour the 8-sublane f32 tile even when T itself
+    # becomes the (single) block
+    if not use or t % 8 or t % bq or t % bk or dh > _LANE:
+        return dense_attention(q, k, v, causal=True)
+
+    dh_p = _ceil_to(dh, _LANE)
+
+    def fold(x):
+        x = jnp.moveaxis(x, 2, 1).reshape(b * h, t, dh)  # (B,T,H,D)->(BH,T,D)
+        if dh_p != dh:
+            x = jnp.pad(x, ((0, 0), (0, 0), (0, dh_p - dh)))
+        return x
+
+    o = _flash_core(fold(q), fold(k), fold(v), 1.0 / (dh ** 0.5),
+                    bq, bk, interpret)
+    o = o[..., :dh].reshape(b, h, t, dh)
+    return jnp.moveaxis(o, 1, 2)  # (B, T, H, Dh)
+
+
+def attn_impl_fn(cfg):
+    """cfg.attn_impl -> AttnFn for the single-shard LM paths (None = Block's
+    dense default). One dispatch point shared by sp_step / pp_step."""
+    return flash_attention if cfg.attn_impl == "flash" else None

@@ -56,6 +56,7 @@ class _PipeBlock(nn.Module):
     heads: int
     dtype: Any
     remat: bool = False
+    attn_fn: Any = None
 
     @nn.compact
     def __call__(self, x, positions):
@@ -65,9 +66,8 @@ class _PipeBlock(nn.Module):
         blk_cls = Block if not self.remat else nn.remat(
             Block, static_argnums=(3,), prevent_cse=False
         )
-        x = blk_cls(self.dim, self.heads, dtype=self.dtype, name="b")(
-            x, positions, True
-        )
+        x = blk_cls(self.dim, self.heads, attn_fn=self.attn_fn,
+                    dtype=self.dtype, name="b")(x, positions, True)
         return x, None
 
 
@@ -84,6 +84,7 @@ class StageBlocks(nn.Module):
     layers: int
     dtype: Any = jnp.float32
     remat: bool = False
+    attn_fn: Any = None
 
     @nn.compact
     def __call__(self, x, positions):
@@ -95,7 +96,7 @@ class StageBlocks(nn.Module):
             in_axes=nn.broadcast,
         )
         x, _ = scan(self.dim, self.heads, self.dtype, self.remat,
-                    name="loop")(x, positions)
+                    self.attn_fn, name="loop")(x, positions)
         return x
 
 
@@ -136,11 +137,14 @@ def build_pp_train_setup(cfg: TrainConfig, mesh) -> PPTrainSetup:
     t_in = cfg.seq_len - 1  # next-token objective: inputs are tokens[:-1]
 
     cdtype = jnp.dtype(cfg.compute_dtype)
+    from draco_tpu.ops.flash_attention import attn_impl_fn
+
+    attn_fn = attn_impl_fn(cfg)
     embed = nn.Embed(cfg.vocab, cfg.model_dim, name="embed")
     blocks_full = StageBlocks(cfg.model_dim, cfg.model_heads, layers=L,
-                              dtype=cdtype, remat=cfg.remat)
+                              dtype=cdtype, remat=cfg.remat, attn_fn=attn_fn)
     blocks_stage = StageBlocks(cfg.model_dim, cfg.model_heads, layers=l_loc,
-                               dtype=cdtype, remat=cfg.remat)
+                               dtype=cdtype, remat=cfg.remat, attn_fn=attn_fn)
     final_ln = nn.LayerNorm(use_bias=False, name="final_ln")
 
     root = jax.random.key(cfg.seed)

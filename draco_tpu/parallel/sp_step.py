@@ -69,8 +69,18 @@ def build_sp_train_setup(cfg: TrainConfig, mesh) -> SPTrainSetup:
         raise ValueError(f"seq_len {cfg.seq_len} not divisible by sp={sp}")
     t_local = cfg.seq_len // sp
 
-    attn_impl = ring_attention if cfg.sp_attn == "ring" else a2a_attention
-    attn = functools.partial(attn_impl, axis_name=SEQ_AXIS if sp > 1 else None)
+    from draco_tpu.ops.flash_attention import attn_impl_fn
+
+    flash = attn_impl_fn(cfg) if sp == 1 else None
+    if flash is not None:
+        # single-shard long-context path: the Pallas blockwise kernel
+        # (per-device inside shard_map — no GSPMD partitioning involved)
+        attn = flash
+    else:
+        attn_impl = ring_attention if cfg.sp_attn == "ring" else a2a_attention
+        attn = functools.partial(
+            attn_impl, axis_name=SEQ_AXIS if sp > 1 else None
+        )
     cdtype = jnp.dtype(cfg.compute_dtype)
     model = TransformerLM(
         vocab=cfg.vocab, dim=cfg.model_dim, heads=cfg.model_heads,
@@ -192,43 +202,9 @@ def build_sp_train_setup(cfg: TrainConfig, mesh) -> SPTrainSetup:
 
 def train_sp(cfg: TrainConfig, mesh, steps: Optional[int] = None, quiet: bool = False):
     """SP training loop on the synthetic text stream; returns the final state
-    and last-step metrics. Same operational contract as the CNN Trainer:
-    step-indexed Orbax checkpoints + held-out eval every ``eval_freq`` steps
-    into ``train_dir`` (reference: baseline_master.py:142-144), resume via
-    ``checkpoint_step``."""
-    from draco_tpu.utils import checkpoint as ckpt_mod
-    from draco_tpu.utils.metrics import MetricWriter
+    and last-step metrics. Checkpoint/eval/resume semantics live in the
+    shared token loop (tp_step.run_token_loop)."""
+    from draco_tpu.parallel.tp_step import run_token_loop
 
-    setup = build_sp_train_setup(cfg, mesh)
-    state = setup.state
-    start = 1
-    if cfg.checkpoint_step > 0:
-        state = ckpt_mod.load(cfg.train_dir, cfg.checkpoint_step,
-                              jax.tree.map(lambda x: x, state))
-        start = cfg.checkpoint_step + 1
-    total = steps or cfg.max_steps
-    adv = drng.adversary_schedule(
-        cfg.seed, start + total + 1, cfg.num_workers, cfg.worker_fail
-    )
-    writer = MetricWriter(cfg.train_dir or None, quiet=quiet)
-    eval_toks = None
-    if cfg.eval_freq and cfg.train_dir:
-        # held-out stream: step 0 is never trained on
-        eval_toks = jnp.asarray(
-            synthetic_text(cfg.seed + 1, 0, cfg.num_workers, cfg.batch_size,
-                           cfg.seq_len, cfg.vocab)
-        )
-    metrics = {}
-    for step in range(start, start + total):
-        toks = jnp.asarray(
-            synthetic_text(cfg.seed, step, cfg.num_workers, cfg.batch_size,
-                           cfg.seq_len, cfg.vocab)
-        )
-        state, metrics = setup.train_step(state, toks, jnp.asarray(adv[step]))
-        if not quiet and step % cfg.log_every == 0:
-            print(f"sp step {step}: loss {float(metrics['loss']):.4f}", flush=True)
-        if cfg.eval_freq and cfg.train_dir and step % cfg.eval_freq == 0:
-            eval_loss = float(setup.eval_step(state.params, eval_toks))
-            writer.write({"step": step, "split": "eval", "loss": eval_loss})
-            ckpt_mod.save(cfg.train_dir, step, state)
-    return state, metrics
+    return run_token_loop(build_sp_train_setup(cfg, mesh), cfg, steps, quiet,
+                          tag="sp")

@@ -191,19 +191,37 @@ def _build_gspmd_train_setup(cfg: TrainConfig, mesh, *, mp_axis: str,
     )
 
 
-def run_token_loop(setup: TPTrainSetup, cfg: TrainConfig,
-                   steps: Optional[int] = None, quiet: bool = False,
-                   tag: str = "mp"):
+def run_token_loop(setup, cfg: TrainConfig, steps: Optional[int] = None,
+                   quiet: bool = False, tag: str = "mp"):
     """Training loop on the synthetic token stream (sp_step.synthetic_text)
-    for any GSPMD setup. Returns (state, last metrics)."""
+    for any LM setup (sp / tp / ep / pp — anything exposing .state,
+    .train_step, .eval_step). Same operational contract as the CNN Trainer:
+    step-indexed Orbax checkpoints + held-out eval every ``eval_freq`` steps
+    into ``train_dir`` (reference: baseline_master.py:142-144), resume via
+    ``checkpoint_step``. Returns (state, last metrics)."""
     from draco_tpu.parallel.sp_step import synthetic_text
+    from draco_tpu.utils import checkpoint as ckpt_mod
+    from draco_tpu.utils.metrics import MetricWriter
 
     state = setup.state
+    start = 1
+    if cfg.checkpoint_step > 0:
+        state = ckpt_mod.load(cfg.train_dir, cfg.checkpoint_step,
+                              jax.tree.map(lambda x: x, state))
+        start = cfg.checkpoint_step + 1
     total = steps or cfg.max_steps
-    adv = drng.adversary_schedule(cfg.seed, total + 1, cfg.num_workers,
-                                  cfg.worker_fail)
+    adv = drng.adversary_schedule(cfg.seed, start + total + 1,
+                                  cfg.num_workers, cfg.worker_fail)
+    writer = MetricWriter(cfg.train_dir or None, quiet=quiet)
+    eval_toks = None
+    if cfg.eval_freq and cfg.train_dir:
+        # held-out stream: step 0 is never trained on
+        eval_toks = jnp.asarray(
+            synthetic_text(cfg.seed + 1, 0, cfg.num_workers, cfg.batch_size,
+                           cfg.seq_len, cfg.vocab)
+        )
     metrics = {}
-    for step in range(1, total + 1):
+    for step in range(start, start + total):
         toks = jnp.asarray(
             synthetic_text(cfg.seed, step, cfg.num_workers, cfg.batch_size,
                            cfg.seq_len, cfg.vocab)
@@ -212,6 +230,11 @@ def run_token_loop(setup: TPTrainSetup, cfg: TrainConfig,
         if not quiet and step % cfg.log_every == 0:
             print(f"{tag} step {step}: loss {float(metrics['loss']):.4f}",
                   flush=True)
+        if cfg.eval_freq and cfg.train_dir and step % cfg.eval_freq == 0:
+            eval_loss = float(setup.eval_step(state.params, eval_toks))
+            writer.write({"step": step, "split": "eval", "loss": eval_loss})
+            ckpt_mod.save(cfg.train_dir, step, state,
+                          compress=cfg.compress_ckpt)
     return state, metrics
 
 

@@ -128,6 +128,23 @@ def test_pp_composes_with_robust_aggregation():
     np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
 
 
+def test_pp_checkpoint_resume_exact(tmp_path):
+    """The shared token loop checkpoints pp's stage-sharded state; resuming
+    from step 2 reproduces the uninterrupted 4-step run exactly (same
+    deterministic token stream and adversary schedule)."""
+    kw = dict(num_workers=2, pipeline_shards=2, model_layers=2, max_steps=4,
+              eval_freq=2, train_dir=str(tmp_path) + "/")
+    full, _ = train_pp(_cfg(**kw), make_mesh_wpp(2, 2), quiet=True)
+    resumed, _ = train_pp(
+        _cfg(**dict(kw, checkpoint_step=2, max_steps=2)), make_mesh_wpp(2, 2),
+        quiet=True,
+    )
+    a = np.asarray(jax.device_get(full.params["embed"]["embedding"]))
+    b = np.asarray(jax.device_get(resumed.params["embed"]["embedding"]))
+    np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
+    assert int(full.step) == int(resumed.step) == 5
+
+
 def test_pp_config_validation():
     with pytest.raises(ValueError, match="must divide model_layers"):
         _cfg(model_layers=3).validate()
