@@ -306,9 +306,10 @@ def flash_attention(q, k, v, *, block_q: int = 128, block_k: int = 128,
     bq = min(block_q, t)
     bk = min(block_k, t)
     use = force if force is not None else (use_pallas() or interpret)
-    # t % 8: blocks must honour the 8-sublane f32 tile even when T itself
-    # becomes the (single) block
-    if not use or t % 8 or t % bq or t % bk or dh > _LANE:
+    # blocks (including T itself when it becomes the single block) must
+    # honour the 8-sublane f32 tile
+    if (not use or t % 8 or bq % 8 or bk % 8 or t % bq or t % bk
+            or dh > _LANE):
         return dense_attention(q, k, v, causal=True)
 
     dh_p = _ceil_to(dh, _LANE)

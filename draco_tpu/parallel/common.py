@@ -16,23 +16,34 @@ from draco_tpu import aggregation, attacks
 from draco_tpu.coding import cyclic as cyclic_mod
 
 
-def aggregate_flat_grads(grads: jnp.ndarray, adv_mask, cfg, code, rand_factor):
+def aggregate_flat_grads(grads: jnp.ndarray, adv_mask, cfg, code, rand_factor,
+                         present=None):
     """(n, d) per-worker flat gradients → one aggregated (d,) gradient.
 
     cyclic: shared-redundancy encode, adversarial injection on the encoded
     rows, exact decode. Otherwise: injection on the raw rows, then the
     configured robust aggregation (mean / geo-median / krum).
+
+    ``present`` ((n,) bool, optional): straggler rows marked False never
+    arrive — cyclic decodes around them as erasures (known-missing, one
+    redundancy unit each), the robust rules aggregate over present rows
+    only. Same semantics as the CNN path (training/step.py).
     """
     if cfg.approach == "cyclic":
         enc_re, enc_im = cyclic_mod.encode_shared(code, grads)
         enc_re, enc_im = attacks.inject_cyclic(
             enc_re, enc_im, adv_mask, cfg.err_mode, cfg.adversarial
         )
-        agg, _honest = cyclic_mod.decode(code, enc_re, enc_im, rand_factor)
+        if present is not None:
+            pw = present[:, None].astype(enc_re.dtype)
+            enc_re, enc_im = enc_re * pw, enc_im * pw
+        agg, _honest = cyclic_mod.decode(code, enc_re, enc_im, rand_factor,
+                                         present=present)
         return agg
     grads = attacks.inject_plain(grads, adv_mask, cfg.err_mode, cfg.adversarial)
     return aggregation.aggregate(
-        grads, cfg.mode, s=cfg.worker_fail, geomedian_iters=cfg.geomedian_iters
+        grads, cfg.mode, s=cfg.worker_fail,
+        geomedian_iters=cfg.geomedian_iters, present=present,
     )
 
 

@@ -285,15 +285,23 @@ def build_pp_train_setup(cfg: TrainConfig, mesh) -> PPTrainSetup:
         code = None
         rand_factor = None
 
-    def step_body(state: TrainState, tokens, adv_mask):
+    def step_body(state: TrainState, tokens, adv_mask, present=None):
         grads, losses = per_worker_grads(state.params, tokens)
-        agg = aggregate_flat_grads(grads, adv_mask, cfg, code, rand_factor)
+        agg = aggregate_flat_grads(grads, adv_mask, cfg, code, rand_factor,
+                                   present=present)
         new_params, new_opt = apply_flat_update(state, agg, opt, unravel)
         new_state = TrainState(
             _constrain_params(new_params, mesh, _leaf_spec), new_opt, None,
             state.step + 1,
         )
-        return new_state, {"loss": jnp.mean(losses)}
+        if present is None:
+            loss_metric = jnp.mean(losses)
+        else:
+            # a straggler's loss was never received — mask it like the CNN
+            # path's _metrics (training/step.py)
+            w = present.astype(losses.dtype)
+            loss_metric = jnp.sum(losses * w) / jnp.maximum(jnp.sum(w), 1.0)
+        return new_state, {"loss": loss_metric}
 
     def eval_body(params, tokens):
         return jnp.mean(per_worker_loss(params, tokens))

@@ -171,13 +171,21 @@ def build_sp_train_setup(cfg: TrainConfig, mesh) -> SPTrainSetup:
         code = None
         rand_factor = None
 
-    def step_body(state: TrainState, tokens, adv_mask):
+    def step_body(state: TrainState, tokens, adv_mask, present=None):
         grads, losses = grads_fn(state.params, tokens)
         grads = lax.with_sharding_constraint(grads, shard_w)
-        agg = aggregate_flat_grads(grads, adv_mask, cfg, code, rand_factor)
+        agg = aggregate_flat_grads(grads, adv_mask, cfg, code, rand_factor,
+                                   present=present)
         new_params, new_opt = apply_flat_update(state, agg, opt, unravel)
         new_state = TrainState(new_params, new_opt, None, state.step + 1)
-        return new_state, {"loss": jnp.mean(losses)}
+        if present is None:
+            loss_metric = jnp.mean(losses)
+        else:
+            # a straggler's loss was never received — mask it like the CNN
+            # path's _metrics (training/step.py)
+            w = present.astype(losses.dtype)
+            loss_metric = jnp.sum(losses * w) / jnp.maximum(jnp.sum(w), 1.0)
+        return new_state, {"loss": loss_metric}
 
     loss_fn = shard_map(
         device_loss,

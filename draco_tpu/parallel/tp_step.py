@@ -165,18 +165,26 @@ def _build_gspmd_train_setup(cfg: TrainConfig, mesh, *, mp_axis: str,
         code = None
         rand_factor = None
 
-    def step_body(state: TrainState, tokens, adv_mask):
+    def step_body(state: TrainState, tokens, adv_mask, present=None):
         def lane(toks):
             loss, g = jax.value_and_grad(lane_loss)(state.params, toks, True)
             return _flatten_tree(g), loss
 
         grads, losses = jax.vmap(lane)(tokens)  # (n, d), (n,)
         grads = jax.lax.with_sharding_constraint(grads, shard_w)
-        agg = aggregate_flat_grads(grads, adv_mask, cfg, code, rand_factor)
+        agg = aggregate_flat_grads(grads, adv_mask, cfg, code, rand_factor,
+                                   present=present)
         new_params, new_opt = apply_flat_update(state, agg, opt, unravel)
         new_params = _constrain_params(new_params, mesh, partition_fn)
         new_state = TrainState(new_params, new_opt, None, state.step + 1)
-        return new_state, {"loss": jnp.mean(losses)}
+        if present is None:
+            loss_metric = jnp.mean(losses)
+        else:
+            # a straggler's loss was never received — mask it like the CNN
+            # path's _metrics (training/step.py)
+            w = present.astype(losses.dtype)
+            loss_metric = jnp.sum(losses * w) / jnp.maximum(jnp.sum(w), 1.0)
+        return new_state, {"loss": loss_metric}
 
     def eval_body(params, tokens):
         return jnp.mean(jax.vmap(lambda t: lane_loss(params, t, False))(tokens))
@@ -210,8 +218,16 @@ def run_token_loop(setup, cfg: TrainConfig, steps: Optional[int] = None,
                               jax.tree.map(lambda x: x, state))
         start = cfg.checkpoint_step + 1
     total = steps or cfg.max_steps
+    # live adversaries may be fewer than the code parameter s when decode
+    # budget is reserved for stragglers (config.adversary_count)
     adv = drng.adversary_schedule(cfg.seed, start + total + 1,
-                                  cfg.num_workers, cfg.worker_fail)
+                                  cfg.num_workers, cfg.num_adversaries)
+    straggle = (
+        drng.straggler_schedule(cfg.seed, start + total + 1, cfg.num_workers,
+                                cfg.straggle_count)
+        if cfg.straggle_mode == "drop" and cfg.straggle_count > 0
+        else None
+    )
     writer = MetricWriter(cfg.train_dir or None, quiet=quiet)
     eval_toks = None
     if cfg.eval_freq and cfg.train_dir:
@@ -226,7 +242,14 @@ def run_token_loop(setup, cfg: TrainConfig, steps: Optional[int] = None,
             synthetic_text(cfg.seed, step, cfg.num_workers, cfg.batch_size,
                            cfg.seq_len, cfg.vocab)
         )
-        state, metrics = setup.train_step(state, toks, jnp.asarray(adv[step]))
+        if straggle is None:
+            state, metrics = setup.train_step(state, toks,
+                                              jnp.asarray(adv[step]))
+        else:
+            state, metrics = setup.train_step(
+                state, toks, jnp.asarray(adv[step]),
+                jnp.asarray(~straggle[step]),
+            )
         if not quiet and step % cfg.log_every == 0:
             print(f"{tag} step {step}: loss {float(metrics['loss']):.4f}",
                   flush=True)

@@ -206,6 +206,45 @@ def test_timing_protocol_helpers():
     assert 0.0 <= dt < 5.0
 
 
+def test_tpu_attn_check_tool(tmp_path):
+    """tools/tpu_attn_check.py smoke: interpret-mode parity row on CPU."""
+    import json
+
+    from tools import tpu_attn_check
+
+    out = tmp_path / "attn.json"
+    rc = tpu_attn_check.main([
+        "--out", str(out), "--cpu-interpret", "--seq-lens", "128",
+        "--batch", "1", "--heads", "2", "--reps", "2",
+    ])
+    rep = json.loads(out.read_text())
+    assert rc == 0
+    row = rep["rows"][0]
+    assert row["fwd_max_abs_err"] < 1e-4 and row["grad_max_abs_err"] < 1e-3
+
+
+def test_tpu_lm_perf_tool(tmp_path):
+    """tools/tpu_lm_perf.py smoke on the CPU mesh: all four variants emit
+    per-step timings and the cyclic-vs-geomedian ratio."""
+    import json
+
+    from tools import tpu_lm_perf
+
+    out = tmp_path / "lm.json"
+    rc = tpu_lm_perf.main([
+        "--out", str(out), "--cpu-mesh", "4", "--num-workers", "8",
+        "--model-dim", "32", "--model-heads", "2", "--model-layers", "1",
+        "--vocab", "32", "--seq-len", "16", "--batch-size", "2",
+        "--steps", "2", "--reps", "1",
+    ])
+    rep = json.loads(out.read_text())
+    assert rc == 0
+    for v in ("lm_cyclic_s1_shared_bf16", "lm_geomedian_bf16",
+              "lm_krum_bf16", "lm_mean_no_attack_bf16"):
+        assert rep[f"{v}_step_ms"] > 0
+    assert rep["lm_cyclic_vs_geomedian_step_speedup"] > 0
+
+
 def test_time_to_acc_tool(tmp_path):
     """tools/time_to_acc.py converges on the synthetic set and records a
     monotone wall-clock curve (stand-in for the reference's evaluator

@@ -1,7 +1,7 @@
-"""remat=True must change memory behavior only: losses and per-worker
-gradients identical to the non-remat step on every LM path (per-block
-jax.checkpoint — models/transformer.py, pp_step's scanned stack). The
-CNN path's remat is covered by tests/test_train_step.py."""
+"""Cross-cutting LM-path behaviors: rematerialisation (must change memory
+only — losses and per-worker gradients identical on every path) and
+straggler erasures (the CNN path's semantics, now shared through
+parallel/common.aggregate_flat_grads)."""
 
 import jax
 import numpy as np
@@ -48,6 +48,42 @@ def test_pp_remat_grads_exact():
         np.asarray(jax.device_get(g0)), np.asarray(jax.device_get(g1)),
         rtol=1e-6, atol=1e-7,
     )
+
+
+def test_lm_straggler_erasure_decode_exact():
+    """LM paths now share the CNN path's straggler semantics: cyclic decode
+    around <= 2s erasures reconstructs the exact clean update (the dropped
+    rows' batch gradients are algebraically recovered from the code)."""
+    from draco_tpu.parallel.sp_step import synthetic_text
+
+    cfg = _lm_cfg(num_workers=8, approach="cyclic", worker_fail=1,
+                  adversary_count=0)
+    mesh = make_mesh_wtp(8, 1)
+    setup = build_tp_train_setup(cfg, mesh)
+    toks = __import__("jax").numpy.asarray(
+        synthetic_text(cfg.seed, 1, 8, cfg.batch_size, cfg.seq_len, cfg.vocab)
+    )
+    adv = np.zeros(8, dtype=bool)
+    present = np.ones(8, dtype=bool)
+    present[[2, 5]] = False  # 2 erasures <= 2s
+    st_clean, _ = setup.train_step(setup.state, toks, adv)
+    setup2 = build_tp_train_setup(cfg, mesh)
+    st_drop, _ = setup2.train_step(setup2.state, toks, adv, present)
+    a = np.asarray(jax.device_get(st_clean.params["embed"]["embedding"]))
+    b = np.asarray(jax.device_get(st_drop.params["embed"]["embedding"]))
+    np.testing.assert_allclose(a, b, rtol=5e-4, atol=1e-5)
+
+
+def test_lm_straggler_loop_runs():
+    """run_token_loop threads the straggler schedule through any LM path
+    (here pp) with masked robust aggregation."""
+    from draco_tpu.parallel.pp_step import train_pp
+
+    cfg = _cfg(num_workers=4, pipeline_shards=2, model_layers=2,
+               mode="geometric_median", worker_fail=1,
+               straggle_mode="drop", straggle_count=1, max_steps=3)
+    state, metrics = train_pp(cfg, make_mesh_wpp(4, 2), steps=3, quiet=True)
+    assert np.isfinite(float(metrics["loss"]))
 
 
 def test_sp_remat_ring_attention_exact():

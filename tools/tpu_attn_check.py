@@ -44,33 +44,15 @@ def check_one(t, b, h, dh, reps, interpret=False):
 
     rec = {"seq_len": t, "batch": b, "heads": h, "head_dim": dh}
 
-    # ---- parity (fwd + grads) --------------------------------------------
-    o_f = jax.jit(flash)(q, k, v)
-    o_d = jax.jit(dense)(q, k, v)
-    rec["fwd_max_abs_err"] = float(jnp.max(jnp.abs(o_f - o_d)))
-
     def loss(attn):
         return lambda q, k, v: jnp.sum(jnp.sin(attn(q, k, v)))
 
-    g_f = jax.jit(jax.grad(loss(flash), argnums=(0, 1, 2)))(q, k, v)
-    g_d = jax.jit(jax.grad(loss(dense), argnums=(0, 1, 2)))(q, k, v)
-    rec["grad_max_abs_err"] = float(
-        max(jnp.max(jnp.abs(a - b)) for a, b in zip(g_f, g_d))
-    )
-
-    # ---- timing: fwd ------------------------------------------------------
     def fwd_step(attn):
         def step(qc, k, v):
             o = attn(qc, k, v)
             return qc + 1e-30 * jnp.sum(o * o, axis=None, keepdims=False)
         return step
 
-    rec["flash_fwd_ms"] = round(
-        timeit_chained(fwd_step(flash), q, (k, v), reps=reps) * 1e3, 3)
-    rec["dense_fwd_ms"] = round(
-        timeit_chained(fwd_step(dense), q, (k, v), reps=reps) * 1e3, 3)
-
-    # ---- timing: fwd + bwd ------------------------------------------------
     def fb_step(attn):
         g = jax.grad(lambda q, k, v: jnp.sum(jnp.sin(attn(q, k, v))),
                      argnums=0)
@@ -79,13 +61,33 @@ def check_one(t, b, h, dh, reps, interpret=False):
             return qc + 1e-30 * g(qc, k, v) ** 2
         return step
 
+    # flash numbers first — they must survive a dense OOM at long T (the
+    # regime the kernel exists for)
+    o_f = jax.jit(flash)(q, k, v)
+    g_f = jax.jit(jax.grad(loss(flash), argnums=(0, 1, 2)))(q, k, v)
+    rec["flash_fwd_ms"] = round(
+        timeit_chained(fwd_step(flash), q, (k, v), reps=reps) * 1e3, 3)
     rec["flash_fwdbwd_ms"] = round(
         timeit_chained(fb_step(flash), q, (k, v), reps=reps) * 1e3, 3)
-    rec["dense_fwdbwd_ms"] = round(
-        timeit_chained(fb_step(dense), q, (k, v), reps=reps) * 1e3, 3)
-    rec["fwd_speedup"] = round(rec["dense_fwd_ms"] / rec["flash_fwd_ms"], 3)
-    rec["fwdbwd_speedup"] = round(
-        rec["dense_fwdbwd_ms"] / rec["flash_fwdbwd_ms"], 3)
+
+    try:
+        o_d = jax.jit(dense)(q, k, v)
+        rec["fwd_max_abs_err"] = float(jnp.max(jnp.abs(o_f - o_d)))
+        g_d = jax.jit(jax.grad(loss(dense), argnums=(0, 1, 2)))(q, k, v)
+        rec["grad_max_abs_err"] = float(
+            max(jnp.max(jnp.abs(a - b)) for a, b in zip(g_f, g_d))
+        )
+        rec["dense_fwd_ms"] = round(
+            timeit_chained(fwd_step(dense), q, (k, v), reps=reps) * 1e3, 3)
+        rec["dense_fwdbwd_ms"] = round(
+            timeit_chained(fb_step(dense), q, (k, v), reps=reps) * 1e3, 3)
+        rec["fwd_speedup"] = round(
+            rec["dense_fwd_ms"] / rec["flash_fwd_ms"], 3)
+        rec["fwdbwd_speedup"] = round(
+            rec["dense_fwdbwd_ms"] / rec["flash_fwdbwd_ms"], 3)
+    except Exception as e:  # dense OOM: keep the flash row
+        rec["dense"] = "oom"
+        rec["dense_error"] = f"{type(e).__name__}: {e}"[:200]
     return rec
 
 
