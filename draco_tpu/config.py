@@ -268,10 +268,13 @@ class TrainConfig:
                 raise ValueError(
                     f"attn_impl must be dense|flash, got {self.attn_impl}"
                 )
-            if self.attn_impl == "flash" and self.seq_shards > 1:
+            if (self.attn_impl == "flash" and self.seq_shards > 1
+                    and self.sp_attn != "a2a"):
                 raise ValueError(
-                    "attn_impl=flash applies to single-shard attention; "
-                    "sequence-parallel runs choose sp_attn (ring|a2a) instead"
+                    "attn_impl=flash under sequence parallelism requires "
+                    "sp_attn=a2a (the flash kernel runs on each device's "
+                    "full-sequence head group after the scatter); ring "
+                    "attention is already blockwise and takes no inner kernel"
                 )
             if self.attn_impl == "flash" and (
                 self.tensor_shards > 1 or self.expert_shards > 1

@@ -36,6 +36,7 @@ def a2a_attention(
     v,
     axis_name: Optional[str],
     causal: bool = True,
+    inner=None,
 ):
     """Exact attention over sequence shards via head-scatter all_to_all.
 
@@ -43,9 +44,15 @@ def a2a_attention(
     H heads. H must be divisible by the ``axis_name`` mesh-axis size. Must
     be called inside ``shard_map``; with ``axis_name=None`` it degrades to
     single-shard dense attention.
+
+    ``inner``: the full-sequence attention run on each device's head group
+    after the scatter — defaults to dense causal attention; pass the flash
+    kernel (ops/flash_attention.py) to remove the (T, T) score block this
+    strategy otherwise materialises (causal-only contract: (q, k, v) -> o).
     """
     if axis_name is None:
-        return dense_attention(q, k, v, causal=causal)
+        return (inner(q, k, v) if inner is not None
+                else dense_attention(q, k, v, causal=causal))
 
     sp = lax.axis_size(axis_name)
     h = q.shape[2]
@@ -60,10 +67,13 @@ def a2a_attention(
     kh = lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1, tiled=True)
     vh = lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1, tiled=True)
 
-    # full-sequence dense attention on this device's head group. The whole
-    # (T, T) score block materialises per head group — the strategy's known
-    # memory trade; use ring_attention when that block cannot fit.
-    oh = dense_attention(qh, kh, vh, causal=causal)
+    # full-sequence attention on this device's head group. With the dense
+    # default the whole (T, T) score block materialises per head group —
+    # the strategy's known memory trade; the flash inner removes it.
+    if inner is not None:
+        oh = inner(qh, kh, vh)
+    else:
+        oh = dense_attention(qh, kh, vh, causal=causal)
 
     # full sequence, H/sp heads  ->  sequence-sharded, all heads
     return lax.all_to_all(oh, axis_name, split_axis=1, concat_axis=2, tiled=True)

@@ -47,6 +47,15 @@ def aggregate_flat_grads(grads: jnp.ndarray, adv_mask, cfg, code, rand_factor,
     )
 
 
+def masked_loss_metric(losses, present):
+    """Mean loss over received rows only — a straggler's loss was never
+    observed (mirrors the CNN path's _metrics, training/step.py)."""
+    if present is None:
+        return jnp.mean(losses)
+    w = present.astype(losses.dtype)
+    return jnp.sum(losses * w) / jnp.maximum(jnp.sum(w), 1.0)
+
+
 def apply_flat_update(state, agg: jnp.ndarray, opt, unravel):
     """Aggregated flat gradient → (new_params, new_opt_state) via the
     grads-as-argument optimizer convention (reference sgd_modified.py:53)."""

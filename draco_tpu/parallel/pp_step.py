@@ -42,7 +42,11 @@ from draco_tpu import optim, rng as drng
 from draco_tpu.coding import cyclic as cyclic_mod
 from draco_tpu.config import TrainConfig
 from draco_tpu.models.transformer import Block
-from draco_tpu.parallel.common import aggregate_flat_grads, apply_flat_update
+from draco_tpu.parallel.common import (
+    aggregate_flat_grads,
+    apply_flat_update,
+    masked_loss_metric,
+)
 from draco_tpu.parallel.mesh import PP_AXIS
 from draco_tpu.parallel.tp_step import _constrain_params, shard_params
 from draco_tpu.runtime import WORKER_AXIS
@@ -294,14 +298,7 @@ def build_pp_train_setup(cfg: TrainConfig, mesh) -> PPTrainSetup:
             _constrain_params(new_params, mesh, _leaf_spec), new_opt, None,
             state.step + 1,
         )
-        if present is None:
-            loss_metric = jnp.mean(losses)
-        else:
-            # a straggler's loss was never received — mask it like the CNN
-            # path's _metrics (training/step.py)
-            w = present.astype(losses.dtype)
-            loss_metric = jnp.sum(losses * w) / jnp.maximum(jnp.sum(w), 1.0)
-        return new_state, {"loss": loss_metric}
+        return new_state, {"loss": masked_loss_metric(losses, present)}
 
     def eval_body(params, tokens):
         return jnp.mean(per_worker_loss(params, tokens))

@@ -30,7 +30,11 @@ from draco_tpu.coding import cyclic as cyclic_mod
 from draco_tpu.config import TrainConfig
 from draco_tpu.models.transformer import TransformerLM
 from draco_tpu.parallel.a2a_attention import a2a_attention
-from draco_tpu.parallel.common import aggregate_flat_grads, apply_flat_update
+from draco_tpu.parallel.common import (
+    aggregate_flat_grads,
+    apply_flat_update,
+    masked_loss_metric,
+)
 from draco_tpu.parallel.mesh import SEQ_AXIS
 from draco_tpu.parallel.ring_attention import ring_attention
 from draco_tpu.runtime import WORKER_AXIS
@@ -71,11 +75,16 @@ def build_sp_train_setup(cfg: TrainConfig, mesh) -> SPTrainSetup:
 
     from draco_tpu.ops.flash_attention import attn_impl_fn
 
-    flash = attn_impl_fn(cfg) if sp == 1 else None
-    if flash is not None:
+    flash = attn_impl_fn(cfg)
+    if flash is not None and sp == 1:
         # single-shard long-context path: the Pallas blockwise kernel
         # (per-device inside shard_map — no GSPMD partitioning involved)
         attn = flash
+    elif flash is not None:
+        # Ulysses + flash: head-scatter a2a, then the flash kernel on each
+        # device's full-sequence head group (validate() enforces sp_attn=a2a)
+        attn = functools.partial(a2a_attention, axis_name=SEQ_AXIS,
+                                 inner=flash)
     else:
         attn_impl = ring_attention if cfg.sp_attn == "ring" else a2a_attention
         attn = functools.partial(
@@ -178,14 +187,7 @@ def build_sp_train_setup(cfg: TrainConfig, mesh) -> SPTrainSetup:
                                    present=present)
         new_params, new_opt = apply_flat_update(state, agg, opt, unravel)
         new_state = TrainState(new_params, new_opt, None, state.step + 1)
-        if present is None:
-            loss_metric = jnp.mean(losses)
-        else:
-            # a straggler's loss was never received — mask it like the CNN
-            # path's _metrics (training/step.py)
-            w = present.astype(losses.dtype)
-            loss_metric = jnp.sum(losses * w) / jnp.maximum(jnp.sum(w), 1.0)
-        return new_state, {"loss": loss_metric}
+        return new_state, {"loss": masked_loss_metric(losses, present)}
 
     loss_fn = shard_map(
         device_loss,

@@ -33,7 +33,11 @@ from draco_tpu import optim, rng as drng
 from draco_tpu.coding import cyclic as cyclic_mod
 from draco_tpu.config import TrainConfig
 from draco_tpu.models.transformer import TransformerLM
-from draco_tpu.parallel.common import aggregate_flat_grads, apply_flat_update
+from draco_tpu.parallel.common import (
+    aggregate_flat_grads,
+    apply_flat_update,
+    masked_loss_metric,
+)
 from draco_tpu.parallel.mesh import TP_AXIS
 from draco_tpu.runtime import WORKER_AXIS
 from draco_tpu.training.step import TrainState, _flatten_tree, _make_unravel
@@ -177,14 +181,7 @@ def _build_gspmd_train_setup(cfg: TrainConfig, mesh, *, mp_axis: str,
         new_params, new_opt = apply_flat_update(state, agg, opt, unravel)
         new_params = _constrain_params(new_params, mesh, partition_fn)
         new_state = TrainState(new_params, new_opt, None, state.step + 1)
-        if present is None:
-            loss_metric = jnp.mean(losses)
-        else:
-            # a straggler's loss was never received — mask it like the CNN
-            # path's _metrics (training/step.py)
-            w = present.astype(losses.dtype)
-            loss_metric = jnp.sum(losses * w) / jnp.maximum(jnp.sum(w), 1.0)
-        return new_state, {"loss": loss_metric}
+        return new_state, {"loss": masked_loss_metric(losses, present)}
 
     def eval_body(params, tokens):
         return jnp.mean(jax.vmap(lambda t: lane_loss(params, t, False))(tokens))

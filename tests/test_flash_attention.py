@@ -127,6 +127,54 @@ def test_flash_through_model_matches_dense(rng, monkeypatch):
     np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6)
 
 
+def test_a2a_flash_inner_matches_dense(rng, monkeypatch):
+    """Ulysses + flash: sp=4 head-scatter with the interpret-mode kernel as
+    the inner attention reproduces the dense a2a step exactly."""
+    import functools
+
+    from draco_tpu.config import TrainConfig
+    from draco_tpu.parallel import make_mesh_2d
+    from draco_tpu.parallel.sp_step import build_sp_train_setup, synthetic_text
+
+    import draco_tpu.ops.flash_attention as fa
+
+    orig = fa.flash_attention
+    monkeypatch.setattr(
+        fa, "flash_attention",
+        functools.partial(orig, force=True, interpret=True),
+    )
+
+    def cfg(attn):
+        return TrainConfig(
+            network="TransformerLM", dataset="synthetic-text", batch_size=2,
+            num_workers=2, approach="baseline", mode="normal", worker_fail=0,
+            seq_shards=4, sp_attn="a2a", seq_len=256, vocab=32, model_dim=32,
+            model_heads=4, model_layers=1, attn_impl=attn, max_steps=1,
+            eval_freq=0, train_dir="", log_every=1000,
+        )
+
+    mesh = make_mesh_2d(2, 4)
+    toks = jnp.asarray(synthetic_text(428, 1, 2, 2, 256, 32))
+    adv = np.zeros(2, dtype=bool)
+    s_d = build_sp_train_setup(cfg("dense"), mesh)
+    s_f = build_sp_train_setup(cfg("flash"), mesh)
+    st_d, m_d = s_d.train_step(s_d.state, toks, adv)
+    st_f, m_f = s_f.train_step(s_f.state, toks, adv)
+    assert float(m_d["loss"]) == pytest.approx(float(m_f["loss"]), rel=1e-5)
+    a = np.asarray(jax.device_get(st_d.params["embed"]["embedding"]))
+    b = np.asarray(jax.device_get(st_f.params["embed"]["embedding"]))
+    np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6)
+
+
+def test_flash_ring_combination_rejected():
+    from draco_tpu.config import TrainConfig
+
+    with pytest.raises(ValueError, match="sp_attn=a2a"):
+        TrainConfig(network="TransformerLM", seq_shards=2, sp_attn="ring",
+                    attn_impl="flash", model_heads=4, seq_len=16,
+                    batch_size=4).validate()
+
+
 def test_fallback_off_tpu(rng):
     """Without force, non-TPU backends and non-tiling shapes take the dense
     path and still produce correct causal attention."""

@@ -60,7 +60,7 @@ def test_lm_straggler_erasure_decode_exact():
                   adversary_count=0)
     mesh = make_mesh_wtp(8, 1)
     setup = build_tp_train_setup(cfg, mesh)
-    toks = __import__("jax").numpy.asarray(
+    toks = jax.numpy.asarray(
         synthetic_text(cfg.seed, 1, 8, cfg.batch_size, cfg.seq_len, cfg.vocab)
     )
     adv = np.zeros(8, dtype=bool)

@@ -81,13 +81,18 @@ def check_one(t, b, h, dh, reps, interpret=False):
             timeit_chained(fwd_step(dense), q, (k, v), reps=reps) * 1e3, 3)
         rec["dense_fwdbwd_ms"] = round(
             timeit_chained(fb_step(dense), q, (k, v), reps=reps) * 1e3, 3)
-        rec["fwd_speedup"] = round(
-            rec["dense_fwd_ms"] / rec["flash_fwd_ms"], 3)
-        rec["fwdbwd_speedup"] = round(
-            rec["dense_fwdbwd_ms"] / rec["flash_fwdbwd_ms"], 3)
-    except Exception as e:  # dense OOM: keep the flash row
-        rec["dense"] = "oom"
-        rec["dense_error"] = f"{type(e).__name__}: {e}"[:200]
+        if rec["flash_fwd_ms"] > 0:
+            rec["fwd_speedup"] = round(
+                rec["dense_fwd_ms"] / rec["flash_fwd_ms"], 3)
+        if rec["flash_fwdbwd_ms"] > 0:
+            rec["fwdbwd_speedup"] = round(
+                rec["dense_fwdbwd_ms"] / rec["flash_fwdbwd_ms"], 3)
+    except Exception as e:  # keep the flash row either way
+        msg = f"{type(e).__name__}: {e}"
+        is_oom = ("RESOURCE_EXHAUSTED" in msg or "out of memory" in msg.lower()
+                  or "OOM" in msg)
+        rec["dense"] = "oom" if is_oom else "failed"
+        rec["dense_error"] = msg[:200]
     return rec
 
 
