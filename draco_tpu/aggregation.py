@@ -13,6 +13,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from draco_tpu.config import AGG_MODES as MODES  # one source of truth
+
 # Every rule takes an optional ``present`` mask ((n,) bool): False rows never
 # arrived (stragglers — the reference PS would block forever on them,
 # baseline_master.py:112-116) and are excluded from the statistic while
@@ -60,37 +62,154 @@ def krum(grads: jnp.ndarray, s: int,
     n = grads.shape[0]
     if n < s + 3:
         raise ValueError(f"krum requires n >= s+3 (got n={n}, s={s})")
+    return grads[jnp.argmin(_krum_scores(grads, s, present))]
+
+
+def _masked_median(grads: jnp.ndarray, present: jnp.ndarray) -> jnp.ndarray:
+    """Per-coordinate median over present rows only, static shapes under
+    jit: absent rows sort to +inf and the median index is computed from the
+    (traced) present count."""
+    x = jnp.where(present[:, None], grads, jnp.inf)
+    x = jnp.sort(x, axis=0)
+    np_ = jnp.sum(present).astype(jnp.int32)
+    lo = jnp.maximum((np_ - 1) // 2, 0)
+    hi = jnp.maximum(np_ // 2, 0)
+    take = lambda i: jnp.take_along_axis(
+        x, jnp.full((1, grads.shape[1]), i), axis=0
+    )[0]
+    return 0.5 * (take(lo) + take(hi))
+
+
+def coordinate_median(grads: jnp.ndarray,
+                      present: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Coordinate-wise median (Yin et al. 2018) — beyond the reference's
+    aggregator set; tolerates < n/2 Byzantine rows per coordinate. With a
+    present mask the median is taken over present rows only (absent rows
+    carry no information and must not vote)."""
+    if present is not None:
+        return _masked_median(grads, present)
+    return jnp.median(grads, axis=0)
+
+
+def trimmed_mean(grads: jnp.ndarray, s: int,
+                 present: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Coordinate-wise s-trimmed mean (Yin et al. 2018): drop the s largest
+    and s smallest values per coordinate, average the rest. Requires
+    n > 2s. Absent rows are filled with the present-rows *median* — a
+    robust statistic, so a Byzantine present row cannot leak into the fill
+    (a mean fill would be contaminated and carry the attack into the kept
+    middle); the fill copies land inside the kept middle by construction.
+    """
+    n = grads.shape[0]
+    if n <= 2 * s:
+        raise ValueError(f"trimmed_mean requires n > 2s (got n={n}, s={s})")
+    if present is not None:
+        fill = _masked_median(grads, present)
+        grads = jnp.where(present[:, None], grads, fill[None, :])
+    ordered = jnp.sort(grads, axis=0)
+    kept = ordered[s:n - s] if s > 0 else ordered
+    return jnp.mean(kept, axis=0)
+
+
+def multi_krum(grads: jnp.ndarray, s: int, m: Optional[int] = None,
+               present: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Multi-Krum (Blanchard et al.): average the m lowest-Krum-score rows
+    (m = n_present - s - 2 by default) instead of returning a single row —
+    lower variance than Krum at the same tolerance. The kept count is
+    derived from the number of rows that actually arrived: with stragglers,
+    keeping n - s - 2 rows could select every present row and degenerate to
+    a contaminated plain mean.
+    """
+    n = grads.shape[0]
+    if n < s + 3:
+        raise ValueError(f"multi_krum requires n >= s+3 (got n={n}, s={s})")
+    scores = _krum_scores(grads, s, present)
+    # row rank among ascending scores (absent rows score +inf → rank last)
+    rank = jnp.argsort(jnp.argsort(scores))
+    if m is not None:
+        keep = jnp.asarray(m, jnp.int32)
+    elif present is None:
+        keep = jnp.asarray(n - s - 2, jnp.int32)
+    else:
+        keep = jnp.maximum(
+            jnp.sum(present).astype(jnp.int32) - s - 2, 1
+        )
+    w = (rank < keep).astype(grads.dtype)
+    if present is not None:
+        w = w * present.astype(grads.dtype)
+    return (w @ grads) / jnp.maximum(jnp.sum(w), 1.0)
+
+
+def bulyan(grads: jnp.ndarray, s: int,
+           present: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Bulyan (El Mhamdi et al. 2018): Multi-Krum-select θ = n - 2s rows,
+    then a coordinate-wise (θ - 2s)-centered average around the selection's
+    coordinate median. Requires n >= 4s + 3 for the full guarantee; this
+    implementation enforces θ >= 1 and β = max(θ - 2s, 1) kept entries.
+    """
+    n = grads.shape[0]
+    theta = n - 2 * s
+    if theta < 1 or n < s + 3:
+        raise ValueError(f"bulyan requires n > 2s and n >= s+3 (n={n}, s={s})")
+    scores = _krum_scores(grads, s, present)
+    order = jnp.argsort(scores)
+    sel = jnp.zeros((n,), bool).at[order[:theta]].set(True)
+    if present is not None:
+        sel = sel & present
+    fill = mean(grads, present=sel)
+    pool = jnp.where(sel[:, None], grads, fill[None, :])
+    med = jnp.median(pool, axis=0)
+    beta = max(theta - 2 * s, 1)
+    # per coordinate: average the beta selected values closest to the median
+    dist = jnp.where(sel[:, None], jnp.abs(pool - med[None, :]), jnp.inf)
+    idx = jnp.argsort(dist, axis=0)[:beta]  # (beta, d)
+    return jnp.mean(jnp.take_along_axis(pool, idx, axis=0), axis=0)
+
+
+def _krum_scores(grads: jnp.ndarray, s: int,
+                 present: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Krum scores (shared by krum / multi_krum / bulyan); absent rows score
+    +inf and rank last as neighbours."""
+    n = grads.shape[0]
     k = n - s - 2
-    # ||gi-gj||^2 via the Gram identity: one (n,d)@(d,n) MXU matmul instead of
-    # an (n,n,d) broadcast intermediate
+    # ||gi-gj||^2 via the Gram identity: one (n,d)@(d,n) MXU matmul instead
+    # of an (n,n,d) broadcast intermediate
     gram = jnp.matmul(grads, grads.T, precision=jax.lax.Precision.HIGHEST)
     norms = jnp.diag(gram)
     sq = jnp.maximum(norms[:, None] + norms[None, :] - 2.0 * gram, 0.0)
     # penalty for self/absent entries: must outrank every real distance but
     # stay bounded — n of them can land inside one row's k nearest slots
     # (straggle_count > s+1 is valid baseline config) and a finfo.max-scale
-    # constant would overflow the score sum to inf for every row, degenerating
-    # argmin to index 0
+    # constant would overflow the score sum to inf for every row,
+    # degenerating argmin to index 0
     big = 2.0 * jnp.max(sq) + 1.0
     sq = sq + jnp.diag(jnp.full((n,), 1.0, dtype=grads.dtype)) * big
     if present is not None:
-        absent = ~present
-        sq = sq + big * absent[None, :].astype(grads.dtype)
+        sq = sq + big * (~present)[None, :].astype(grads.dtype)
     neighbor_sorted = jnp.sort(sq, axis=1)
     scores = jnp.sum(neighbor_sorted[:, :k], axis=1)
     if present is not None:
         scores = jnp.where(present, scores, jnp.inf)
-    return grads[jnp.argmin(scores)]
+    return scores
 
 
 def aggregate(grads: jnp.ndarray, mode: str, s: int = 0, geomedian_iters: int = 80,
               present: Optional[jnp.ndarray] = None) -> jnp.ndarray:
-    """Dispatch used by the baseline training step (mode parity with
-    baseline_master.py:118-129)."""
+    """Dispatch used by the baseline training step. The first three modes
+    mirror the reference (baseline_master.py:118-129); the rest are
+    beyond-reference robust baselines under the same attack schedules."""
     if mode == "normal":
         return mean(grads, present=present)
     if mode == "geometric_median":
         return geometric_median(grads, iters=geomedian_iters, present=present)
     if mode == "krum":
         return krum(grads, s, present=present)
+    if mode == "coord_median":
+        return coordinate_median(grads, present=present)
+    if mode == "trimmed_mean":
+        return trimmed_mean(grads, s, present=present)
+    if mode == "multi_krum":
+        return multi_krum(grads, s, present=present)
+    if mode == "bulyan":
+        return bulyan(grads, s, present=present)
     raise ValueError(f"unknown aggregation mode: {mode}")

@@ -13,7 +13,7 @@ from __future__ import annotations
 
 import argparse
 
-from draco_tpu.config import SEED, TrainConfig
+from draco_tpu.config import AGG_MODES, SEED, TrainConfig
 
 
 def add_fit_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
@@ -31,8 +31,10 @@ def add_fit_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     p.add_argument("--approach", type=str, default="baseline",
                    choices=["baseline", "maj_vote", "cyclic"])
     p.add_argument("--mode", type=str, default="normal",
-                   choices=["normal", "geometric_median", "krum"],
-                   help="aggregation for --approach baseline")
+                   choices=list(AGG_MODES),
+                   help="aggregation for --approach baseline (first three "
+                        "mirror the reference; the rest are beyond-reference "
+                        "robust baselines)")
     p.add_argument("--num-workers", type=int, default=8,
                    help="logical workers n (the reference's mpirun -n minus the PS)")
     p.add_argument("--group-size", type=int, default=3,

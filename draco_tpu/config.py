@@ -16,6 +16,13 @@ from typing import Optional
 # adversary schedule / group seeds / shuffles from this, so all agree.
 SEED = 428
 
+# Aggregation modes for approach=baseline. First three mirror the reference
+# (baseline_master.py:118-129); the rest are beyond-reference robust
+# baselines (aggregation.py). Lives here (jax-free) so the CLI's --mode
+# choices and validate() share one source of truth.
+AGG_MODES = ("normal", "geometric_median", "krum", "coord_median",
+             "trimmed_mean", "multi_krum", "bulyan")
+
 
 @dataclasses.dataclass
 class TrainConfig:
@@ -40,8 +47,10 @@ class TrainConfig:
     #   maj_vote : repetition code, groups of size `group_size`, majority vote
     #   cyclic   : cyclic (DFT) code, tolerates s Byzantine workers
     approach: str = "baseline"
-    # Aggregation mode for approach=baseline
-    # (reference: baseline_master.py:118-129): normal | geometric_median | krum
+    # Aggregation mode for approach=baseline. Reference parity
+    # (baseline_master.py:118-129): normal | geometric_median | krum.
+    # Beyond-reference robust baselines under the same attack schedules:
+    # coord_median | trimmed_mean | multi_krum | bulyan (aggregation.py).
     mode: str = "normal"
     group_size: int = 3  # r, repetition redundancy (reference: distributed_nn.py:70)
     worker_fail: int = 0  # s, number of Byzantine workers (distributed_nn.py:68)
@@ -153,12 +162,19 @@ class TrainConfig:
     def validate(self) -> "TrainConfig":
         if self.approach not in ("baseline", "maj_vote", "cyclic"):
             raise ValueError(f"unknown approach: {self.approach}")
-        if self.approach == "baseline" and self.mode not in (
-            "normal", "geometric_median", "krum"
-        ):
-            raise ValueError(f"baseline supports mode normal|geometric_median|krum, got: {self.mode}")
-        if self.mode == "krum" and self.num_workers < self.worker_fail + 3:
-            raise ValueError("krum requires num_workers >= worker_fail + 3")
+        if self.approach == "baseline" and self.mode not in AGG_MODES:
+            raise ValueError(
+                f"baseline supports mode in {'|'.join(AGG_MODES)}, "
+                f"got: {self.mode}"
+            )
+        if (self.mode in ("krum", "multi_krum", "bulyan")
+                and self.num_workers < self.worker_fail + 3):
+            raise ValueError(f"{self.mode} requires num_workers >= worker_fail + 3")
+        if (self.mode in ("trimmed_mean", "bulyan")
+                and self.num_workers <= 2 * self.worker_fail):
+            raise ValueError(
+                f"{self.mode} requires num_workers > 2 * worker_fail"
+            )
         if self.err_mode not in ("rev_grad", "constant", "random"):
             raise ValueError(f"unknown err_mode: {self.err_mode}")
         if self.approach == "maj_vote":
@@ -235,10 +251,11 @@ class TrainConfig:
             if self.approach == "baseline":
                 if e >= n:
                     raise ValueError("straggle_count must leave at least one worker")
-                if self.mode == "krum" and n - e < s + 3:
+                if (self.mode in ("krum", "multi_krum", "bulyan")
+                        and n - e < s + 3):
                     raise ValueError(
-                        f"krum needs num_workers - straggle_count >= worker_fail + 3 "
-                        f"({n} - {e} < {s} + 3)"
+                        f"{self.mode} needs num_workers - straggle_count >= "
+                        f"worker_fail + 3 ({n} - {e} < {s} + 3)"
                     )
         if self.network == "TransformerLM":
             if self.approach == "maj_vote":

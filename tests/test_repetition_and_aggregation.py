@@ -88,11 +88,114 @@ class TestAggregators:
 
     def test_aggregate_dispatch(self, rng):
         g = jnp.asarray(rng.randn(8, 5).astype(np.float32))
-        for mode in ("normal", "geometric_median", "krum"):
+        for mode in aggregation.MODES:
             out = aggregation.aggregate(g, mode, s=1)
             assert out.shape == (5,)
         with pytest.raises(ValueError):
             aggregation.aggregate(g, "bogus")
+
+    def test_coordinate_median_oracle(self, rng):
+        g = rng.randn(9, 17).astype(np.float32)
+        out = np.asarray(aggregation.coordinate_median(jnp.asarray(g)))
+        np.testing.assert_allclose(out, np.median(g, axis=0), rtol=1e-6)
+
+    def test_coordinate_median_present_stays_in_range(self, rng):
+        g = rng.randn(9, 8).astype(np.float32)
+        present = np.ones(9, bool)
+        present[[1, 6]] = False
+        g[[1, 6]] = 1e6  # absent rows hold garbage
+        out = np.asarray(aggregation.coordinate_median(
+            jnp.asarray(g), present=jnp.asarray(present)))
+        kept = g[present]
+        assert (out >= kept.min(axis=0) - 1e-6).all()
+        assert (out <= kept.max(axis=0) + 1e-6).all()
+
+    def test_trimmed_mean_oracle(self, rng):
+        n, s = 9, 2
+        g = rng.randn(n, 13).astype(np.float32)
+        out = np.asarray(aggregation.trimmed_mean(jnp.asarray(g), s))
+        want = np.sort(g, axis=0)[s:n - s].mean(axis=0)
+        np.testing.assert_allclose(out, want, rtol=1e-6)
+        with pytest.raises(ValueError):
+            aggregation.trimmed_mean(jnp.asarray(g), 5)
+
+    def test_trimmed_mean_kills_outliers(self, rng):
+        base = rng.randn(12).astype(np.float32)
+        g = base[None, :] + 0.01 * rng.randn(9, 12).astype(np.float32)
+        g[[0, 5]] = -100.0 * g[[0, 5]]
+        out = np.asarray(aggregation.trimmed_mean(jnp.asarray(g), 2))
+        assert np.linalg.norm(out - base) < 1.0
+
+    def test_multi_krum_averages_honest_selection(self, rng):
+        n, s = 10, 2
+        base = rng.randn(16).astype(np.float32)
+        g = base[None, :] + 0.01 * rng.randn(n, 16).astype(np.float32)
+        g[[2, 7]] = -100.0 * g[[2, 7]]
+        out = np.asarray(aggregation.multi_krum(jnp.asarray(g), s))
+        assert np.linalg.norm(out - base) < 1.0
+        # m honest rows averaged: closer to base than single-row krum noise
+        one = np.asarray(aggregation.krum(jnp.asarray(g), s))
+        honest = np.delete(g, [2, 7], axis=0)
+        assert np.linalg.norm(out - honest.mean(axis=0)) \
+            <= np.linalg.norm(one - honest.mean(axis=0)) + 1e-5
+
+    def test_bulyan_discards_adversaries(self, rng):
+        n, s = 11, 2  # n >= 4s+3
+        base = rng.randn(16).astype(np.float32)
+        g = base[None, :] + 0.01 * rng.randn(n, 16).astype(np.float32)
+        g[[1, 8]] = -100.0 * g[[1, 8]]
+        out = np.asarray(aggregation.bulyan(jnp.asarray(g), s))
+        assert np.linalg.norm(out - base) < 1.0
+
+    def test_multi_krum_present_still_excludes_adversary(self, rng):
+        """Regression: with stragglers the kept count derives from the
+        present count — n - s - 2 could select every present row and
+        degenerate to a contaminated mean."""
+        n, s = 10, 1
+        base = rng.randn(16).astype(np.float32)
+        g = base[None, :] + 0.01 * rng.randn(n, 16).astype(np.float32)
+        g[4] = 1e4  # one Byzantine present row
+        present = np.ones(n, bool)
+        present[[0, 1, 2]] = False  # 3 stragglers: 7 present >= s+3
+        out = np.asarray(aggregation.multi_krum(
+            jnp.asarray(g), s, present=jnp.asarray(present)))
+        assert np.linalg.norm(out - base) < 1.0
+
+    def test_trimmed_mean_joint_straggler_adversary(self, rng):
+        """Regression: absent rows are median-filled, so a Byzantine present
+        row cannot leak into the fill and ride inside the kept middle."""
+        n, s = 9, 2
+        base = rng.randn(16).astype(np.float32)
+        g = base[None, :] + 0.01 * rng.randn(n, 16).astype(np.float32)
+        g[[0, 5]] = -1e6  # Byzantine, count == s
+        present = np.ones(n, bool)
+        present[[1, 6]] = False  # absent rows hold garbage
+        g[[1, 6]] = 777.0
+        out = np.asarray(aggregation.trimmed_mean(
+            jnp.asarray(g), s, present=jnp.asarray(present)))
+        assert np.linalg.norm(out - base) < 1.0
+
+    def test_coordinate_median_present_oracle(self, rng):
+        g = rng.randn(9, 11).astype(np.float32)
+        present = np.ones(9, bool)
+        present[[2, 5, 8]] = False
+        g[[2, 5, 8]] = 1e6
+        out = np.asarray(aggregation.coordinate_median(
+            jnp.asarray(g), present=jnp.asarray(present)))
+        np.testing.assert_allclose(out, np.median(g[present], axis=0),
+                                   rtol=1e-6)
+
+    def test_bulyan_present_mask(self, rng):
+        n, s = 11, 2
+        base = rng.randn(16).astype(np.float32)
+        g = base[None, :] + 0.01 * rng.randn(n, 16).astype(np.float32)
+        g[3] = -100.0 * g[3]
+        present = np.ones(n, bool)
+        present[9] = False
+        g[9] = 1e6
+        out = np.asarray(aggregation.bulyan(
+            jnp.asarray(g), s, present=jnp.asarray(present)))
+        assert np.linalg.norm(out - base) < 1.0
 
 
 class TestAttacks:
