@@ -142,28 +142,35 @@ def multi_krum(grads: jnp.ndarray, s: int, m: Optional[int] = None,
 
 def bulyan(grads: jnp.ndarray, s: int,
            present: Optional[jnp.ndarray] = None) -> jnp.ndarray:
-    """Bulyan (El Mhamdi et al. 2018): Multi-Krum-select θ = n - 2s rows,
-    then a coordinate-wise (θ - 2s)-centered average around the selection's
-    coordinate median. Requires n >= 4s + 3 for the full guarantee; this
-    implementation enforces θ >= 1 and β = max(θ - 2s, 1) kept entries.
+    """Bulyan (El Mhamdi et al. 2018): Multi-Krum-select θ rows, then a
+    coordinate-wise β-centered average around the selection's coordinate
+    median. Requires n >= 4s + 3 for the full guarantee; θ and β derive
+    from the rows that actually *arrived* (θ = n_present - 2s) — deriving
+    them from the static n would, under stragglers, select every present
+    row and skip the Krum filtering entirely (the same degeneration
+    multi_krum guards against). All selections are rank masks, so shapes
+    stay static under jit with a traced present count.
     """
     n = grads.shape[0]
-    theta = n - 2 * s
-    if theta < 1 or n < s + 3:
+    if n <= 2 * s or n < s + 3:
         raise ValueError(f"bulyan requires n > 2s and n >= s+3 (n={n}, s={s})")
     scores = _krum_scores(grads, s, present)
-    order = jnp.argsort(scores)
-    sel = jnp.zeros((n,), bool).at[order[:theta]].set(True)
-    if present is not None:
-        sel = sel & present
-    fill = mean(grads, present=sel)
-    pool = jnp.where(sel[:, None], grads, fill[None, :])
-    med = jnp.median(pool, axis=0)
-    beta = max(theta - 2 * s, 1)
-    # per coordinate: average the beta selected values closest to the median
-    dist = jnp.where(sel[:, None], jnp.abs(pool - med[None, :]), jnp.inf)
-    idx = jnp.argsort(dist, axis=0)[:beta]  # (beta, d)
-    return jnp.mean(jnp.take_along_axis(pool, idx, axis=0), axis=0)
+    rank = jnp.argsort(jnp.argsort(scores))
+    if present is None:
+        n_p = jnp.asarray(n, jnp.int32)
+        pmask = jnp.ones((n,), bool)
+    else:
+        n_p = jnp.sum(present).astype(jnp.int32)
+        pmask = present
+    theta = jnp.maximum(n_p - 2 * s, 1)
+    sel = (rank < theta) & pmask
+    med = _masked_median(grads, sel)
+    # per coordinate: average the β selected values closest to the median
+    beta = jnp.maximum(theta - 2 * s, 1)
+    dist = jnp.where(sel[:, None], jnp.abs(grads - med[None, :]), jnp.inf)
+    cranks = jnp.argsort(jnp.argsort(dist, axis=0), axis=0)
+    w = ((cranks < beta) & sel[:, None]).astype(grads.dtype)
+    return jnp.sum(grads * w, axis=0) / jnp.maximum(jnp.sum(w, axis=0), 1.0)
 
 
 def _krum_scores(grads: jnp.ndarray, s: int,

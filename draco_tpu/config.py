@@ -257,6 +257,16 @@ class TrainConfig:
                         f"{self.mode} needs num_workers - straggle_count >= "
                         f"worker_fail + 3 ({n} - {e} < {s} + 3)"
                     )
+                if (self.mode in ("coord_median", "trimmed_mean", "bulyan")
+                        and n - e <= 2 * s):
+                    # the median-based rules need an honest majority among
+                    # the rows that actually arrive: with p <= 2s present
+                    # rows, s Byzantine rows control the per-coordinate
+                    # median (and hence the trim fill) outright
+                    raise ValueError(
+                        f"{self.mode} needs num_workers - straggle_count > "
+                        f"2 * worker_fail ({n} - {e} <= {2 * s})"
+                    )
         if self.network == "TransformerLM":
             if self.approach == "maj_vote":
                 raise ValueError(

@@ -17,7 +17,7 @@ from draco_tpu.coding import cyclic as cyclic_mod
 
 
 def aggregate_flat_grads(grads: jnp.ndarray, adv_mask, cfg, code, rand_factor,
-                         present=None):
+                         present=None, leaf_offsets=None):
     """(n, d) per-worker flat gradients → one aggregated (d,) gradient.
 
     cyclic: shared-redundancy encode, adversarial injection on the encoded
@@ -28,6 +28,11 @@ def aggregate_flat_grads(grads: jnp.ndarray, adv_mask, cfg, code, rand_factor,
     arrive — cyclic decodes around them as erasures (known-missing, one
     redundancy unit each), the robust rules aggregate over present rows
     only. Same semantics as the CNN path (training/step.py).
+
+    ``leaf_offsets``: static per-tensor segment boundaries from
+    _make_unravel — required when ``cfg.decode_granularity == "layer"`` so
+    the cyclic decode runs one locator per parameter tensor like the
+    reference (cyclic_master.py:125-129), matching the CNN path.
     """
     if cfg.approach == "cyclic":
         enc_re, enc_im = cyclic_mod.encode_shared(code, grads)
@@ -37,8 +42,19 @@ def aggregate_flat_grads(grads: jnp.ndarray, adv_mask, cfg, code, rand_factor,
         if present is not None:
             pw = present[:, None].astype(enc_re.dtype)
             enc_re, enc_im = enc_re * pw, enc_im * pw
-        agg, _honest = cyclic_mod.decode(code, enc_re, enc_im, rand_factor,
-                                         present=present)
+        if cfg.decode_granularity == "layer":
+            if leaf_offsets is None:
+                raise ValueError(
+                    "decode_granularity='layer' needs leaf_offsets from "
+                    "_make_unravel"
+                )
+            agg, _honest = cyclic_mod.decode_layers(
+                code, enc_re, enc_im, rand_factor, leaf_offsets,
+                present=present,
+            )
+        else:
+            agg, _honest = cyclic_mod.decode(code, enc_re, enc_im,
+                                             rand_factor, present=present)
         return agg
     grads = attacks.inject_plain(grads, adv_mask, cfg.err_mode, cfg.adversarial)
     return aggregation.aggregate(

@@ -163,7 +163,7 @@ def build_pp_train_setup(cfg: TrainConfig, mesh) -> PPTrainSetup:
     }
 
     opt = optim.build_optimizer(cfg.optimizer, cfg.lr, cfg.momentum)
-    unravel, dim, _ = _make_unravel(params)
+    unravel, dim, leaf_offsets = _make_unravel(params)
 
     # parameter residence between steps: stage stacks shard their leading
     # layer axis over pp, everything else replicated
@@ -292,7 +292,8 @@ def build_pp_train_setup(cfg: TrainConfig, mesh) -> PPTrainSetup:
     def step_body(state: TrainState, tokens, adv_mask, present=None):
         grads, losses = per_worker_grads(state.params, tokens)
         agg = aggregate_flat_grads(grads, adv_mask, cfg, code, rand_factor,
-                                   present=present)
+                                   present=present,
+                                   leaf_offsets=leaf_offsets)
         new_params, new_opt = apply_flat_update(state, agg, opt, unravel)
         new_state = TrainState(
             _constrain_params(new_params, mesh, _leaf_spec), new_opt, None,

@@ -107,7 +107,7 @@ def build_sp_train_setup(cfg: TrainConfig, mesh) -> SPTrainSetup:
     params = init_model.init({"params": root}, init_toks, train=True)["params"]
 
     opt = optim.build_optimizer(cfg.optimizer, cfg.lr, cfg.momentum)
-    unravel, dim, _ = _make_unravel(params)
+    unravel, dim, leaf_offsets = _make_unravel(params)
 
     repl = NamedSharding(mesh, P())
     shard_w = NamedSharding(mesh, P(WORKER_AXIS))
@@ -184,7 +184,8 @@ def build_sp_train_setup(cfg: TrainConfig, mesh) -> SPTrainSetup:
         grads, losses = grads_fn(state.params, tokens)
         grads = lax.with_sharding_constraint(grads, shard_w)
         agg = aggregate_flat_grads(grads, adv_mask, cfg, code, rand_factor,
-                                   present=present)
+                                   present=present,
+                                   leaf_offsets=leaf_offsets)
         new_params, new_opt = apply_flat_update(state, agg, opt, unravel)
         new_state = TrainState(new_params, new_opt, None, state.step + 1)
         return new_state, {"loss": masked_loss_metric(losses, present)}

@@ -141,7 +141,7 @@ def _build_gspmd_train_setup(cfg: TrainConfig, mesh, *, mp_axis: str,
     params = model.init({"params": root}, init_toks, train=True)["params"]
 
     opt = optim.build_optimizer(cfg.optimizer, cfg.lr, cfg.momentum)
-    unravel, dim, _ = _make_unravel(params)
+    unravel, dim, leaf_offsets = _make_unravel(params)
 
     repl = NamedSharding(mesh, P())
     shard_w = NamedSharding(mesh, P(WORKER_AXIS))
@@ -177,7 +177,8 @@ def _build_gspmd_train_setup(cfg: TrainConfig, mesh, *, mp_axis: str,
         grads, losses = jax.vmap(lane)(tokens)  # (n, d), (n,)
         grads = jax.lax.with_sharding_constraint(grads, shard_w)
         agg = aggregate_flat_grads(grads, adv_mask, cfg, code, rand_factor,
-                                   present=present)
+                                   present=present,
+                                   leaf_offsets=leaf_offsets)
         new_params, new_opt = apply_flat_update(state, agg, opt, unravel)
         new_params = _constrain_params(new_params, mesh, partition_fn)
         new_state = TrainState(new_params, new_opt, None, state.step + 1)

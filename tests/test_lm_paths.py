@@ -74,6 +74,30 @@ def test_lm_straggler_erasure_decode_exact():
     np.testing.assert_allclose(a, b, rtol=5e-4, atol=1e-5)
 
 
+def test_lm_layer_decode_matches_global():
+    """decode_granularity=layer (one locator per parameter tensor, the
+    reference's shape — cyclic_master.py:125-129) agrees with the global
+    decode when corruption is per-worker, on the LM path too."""
+    from draco_tpu.parallel.sp_step import synthetic_text
+
+    jnp = jax.numpy
+    outs = {}
+    for gran in ("global", "layer"):
+        cfg = _lm_cfg(num_workers=8, approach="cyclic", worker_fail=1,
+                      decode_granularity=gran)
+        setup = build_tp_train_setup(cfg, make_mesh_wtp(8, 1))
+        toks = jnp.asarray(synthetic_text(cfg.seed, 1, 8, cfg.batch_size,
+                                          cfg.seq_len, cfg.vocab))
+        adv = np.zeros(8, dtype=bool)
+        adv[3] = True
+        st, m = setup.train_step(setup.state, toks, adv)
+        outs[gran] = np.asarray(
+            jax.device_get(st.params["embed"]["embedding"]))
+        assert np.isfinite(float(m["loss"]))
+    np.testing.assert_allclose(outs["global"], outs["layer"],
+                               rtol=5e-4, atol=1e-5)
+
+
 def test_lm_straggler_loop_runs():
     """run_token_loop threads the straggler schedule through any LM path
     (here pp) with masked robust aggregation."""

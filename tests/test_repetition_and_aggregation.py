@@ -197,6 +197,29 @@ class TestAggregators:
             jnp.asarray(g), s, present=jnp.asarray(present)))
         assert np.linalg.norm(out - base) < 1.0
 
+    def test_bulyan_many_stragglers_still_filters(self, rng):
+        """Regression: θ/β derive from the present count — with 4 of 11 rows
+        absent, the Krum stage must still exclude the Byzantine present row
+        rather than degenerate to a plain present-mean."""
+        n, s = 11, 1
+        base = rng.randn(16).astype(np.float32)
+        g = base[None, :] + 0.01 * rng.randn(n, 16).astype(np.float32)
+        g[4] = 1e4  # Byzantine present row
+        present = np.ones(n, bool)
+        present[[0, 1, 2, 3]] = False
+        g[[0, 1, 2, 3]] = 555.0
+        out = np.asarray(aggregation.bulyan(
+            jnp.asarray(g), s, present=jnp.asarray(present)))
+        assert np.linalg.norm(out - base) < 1.0
+
+    def test_median_rules_reject_over_straggled_config(self):
+        from draco_tpu.config import TrainConfig
+
+        with pytest.raises(ValueError, match="> 2 \\* worker_fail"):
+            TrainConfig(approach="baseline", mode="trimmed_mean",
+                        num_workers=9, worker_fail=2, straggle_mode="drop",
+                        straggle_count=6).validate()
+
 
 class TestAttacks:
     def test_plain_modes(self, rng):

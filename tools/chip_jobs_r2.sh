@@ -1,0 +1,36 @@
+#!/bin/bash
+# One-shot round-2 chip job chain: wait for the tunnel TPU to come back,
+# then run the two pending hardware benchmarks sequentially (one client at
+# a time per the tunnel discipline). Safe to re-run; artifacts land in
+# baselines_out/.
+set -eu
+cd "$(dirname "$0")/.."
+
+for attempt in $(seq 1 40); do
+  if python - <<'EOF'
+import sys, jax
+try:
+    d = jax.devices()
+    sys.exit(0 if d and d[0].platform != "cpu" else 3)
+except Exception:
+    sys.exit(3)
+EOF
+  then
+    echo "[chip_jobs] TPU up (attempt $attempt)"
+    break
+  fi
+  echo "[chip_jobs] attempt $attempt: TPU still down"
+  if [ "$attempt" = 40 ]; then
+    echo "[chip_jobs] giving up"
+    exit 3
+  fi
+  sleep 180
+done
+
+echo "[chip_jobs] running tpu_attn_check (flash vs dense, T=1024..4096)"
+python tools/tpu_attn_check.py --out baselines_out/tpu_attn.json
+echo "[chip_jobs] running tpu_lm_perf long-context remat variant"
+python tools/tpu_lm_perf.py --remat --batch-size 8 --seq-len 1024 --steps 3 \
+  --variants lm_cyclic_s1_shared_bf16,lm_mean_no_attack_bf16 \
+  --out baselines_out/tpu_lm_perf_long.json
+echo "[chip_jobs] done"
