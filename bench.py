@@ -76,33 +76,49 @@ def _probe_ok(timeout: float = 300.0) -> bool:
 def _try_backend(retries: int, wait: float):
     """Initialize the accelerator backend, retrying a wedged tunnel lease.
 
-    Returns (devices, None) or (None, last_error_string). A failed in-process
-    init is sticky — xla_bridge caches the surviving CPU backend and never
-    re-probes the accelerator plugin — so jax.devices() returning only CPU
-    counts as failure, retries probe in subprocesses, and on recovery the
-    script re-execs itself for a fresh init (guarded by DRACO_BENCH_REEXEC
-    so a flapping backend can't loop forever).
+    Returns (devices, None) or (None, last_error_string). Availability is
+    established in *bounded subprocesses first* (_probe_ok): an in-process
+    ``jax.devices()`` against a wedged tunnel blocks inside the plugin's own
+    retry loop for ~25 minutes per attempt (measured 2026-07-30), which
+    would eat the driver's whole window; a probe subprocess is killed after
+    its timeout instead, and only after a probe succeeds does this process
+    initialize its own backend (a failed in-process init is sticky —
+    xla_bridge caches the surviving backend set).
     """
     import os
 
     import jax
 
-    last = ""
+    probed = False
+    for attempt in range(max(retries, 1)):
+        if _probe_ok():
+            probed = True
+            break
+        if attempt < retries - 1:
+            time.sleep(wait)
+    if not probed:
+        return None, (
+            f"accelerator probe failed/timed out {max(retries, 1)} times "
+            f"({wait:.0f}s apart)"
+        )
     try:
         devs = jax.devices()
         if devs and devs[0].platform != "cpu":
             return devs, None
         last = f"only cpu devices visible: {devs}"
-    except RuntimeError as e:  # backend init failure (UNAVAILABLE etc.)
+    except RuntimeError as e:  # backend flapped between probe and init
         last = f"{type(e).__name__}: {e}"
-    if os.environ.get("DRACO_BENCH_REEXEC"):
-        return None, last
-    for _ in range(max(retries - 1, 0)):
-        time.sleep(wait)
-        if _probe_ok():
-            os.environ["DRACO_BENCH_REEXEC"] = "1"
-            sys.stdout.flush()
-            os.execv(sys.executable, [sys.executable] + sys.argv)
+    # a failed in-process init is sticky (xla_bridge caches the surviving
+    # backend set and never re-probes the plugin), so if a fresh probe says
+    # the chip is back, re-exec once for a clean init — guarded by an env
+    # var so a flapping backend can't loop forever
+    if not os.environ.get("DRACO_BENCH_REEXEC"):
+        for _ in range(max(retries - 1, 0)):
+            time.sleep(wait)
+            if _probe_ok():
+                os.environ["DRACO_BENCH_REEXEC"] = "1"
+                sys.stdout.flush()
+                os.execv(sys.executable, [sys.executable] + sys.argv)
     return None, last
 
 

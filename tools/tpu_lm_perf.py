@@ -48,7 +48,7 @@ def run_lm(cfg, mesh, steps, warmup=1, reps=2):
 
     setup = build_tp_train_setup(cfg, mesh)
     adv = drng.adversary_schedule(cfg.seed, steps + 1, cfg.num_workers,
-                                  cfg.worker_fail)
+                                  cfg.num_adversaries)
     xs = jnp.asarray(np.stack([
         synthetic_text(cfg.seed, s, cfg.num_workers, cfg.batch_size,
                        cfg.seq_len, cfg.vocab)
