@@ -55,9 +55,11 @@ def _peak_flops(device_kind: str):
     return None
 
 
-def _probe_ok(timeout: float = 300.0) -> bool:
+def _probe_ok(timeout: float = 300.0):
     """Probe accelerator availability in a clean subprocess (which exits and
-    releases the one-client tunnel lease)."""
+    releases the one-client tunnel lease). Returns (ok, detail) — detail is
+    the probe's stderr tail so the actual backend error (UNAVAILABLE vs
+    auth vs DNS) survives into the structured failure record."""
     import subprocess
 
     code = (
@@ -67,10 +69,14 @@ def _probe_ok(timeout: float = 300.0) -> bool:
     )
     try:
         r = subprocess.run([sys.executable, "-c", code], timeout=timeout,
-                           capture_output=True)
-        return r.returncode == 0
-    except Exception:
-        return False
+                           capture_output=True, text=True)
+        if r.returncode == 0:
+            return True, ""
+        return False, (r.stderr or "")[-300:]
+    except subprocess.TimeoutExpired:
+        return False, f"probe subprocess timed out after {timeout:.0f}s"
+    except Exception as e:
+        return False, f"{type(e).__name__}: {e}"[:300]
 
 
 def _try_backend(retries: int, wait: float):
@@ -90,16 +96,17 @@ def _try_backend(retries: int, wait: float):
     import jax
 
     probed = False
+    detail = ""
     for attempt in range(max(retries, 1)):
-        if _probe_ok():
-            probed = True
+        probed, detail = _probe_ok()
+        if probed:
             break
         if attempt < retries - 1:
             time.sleep(wait)
     if not probed:
         return None, (
             f"accelerator probe failed/timed out {max(retries, 1)} times "
-            f"({wait:.0f}s apart)"
+            f"({wait:.0f}s apart); last: {detail}"
         )
     try:
         devs = jax.devices()
@@ -115,7 +122,8 @@ def _try_backend(retries: int, wait: float):
     if not os.environ.get("DRACO_BENCH_REEXEC"):
         for _ in range(max(retries - 1, 0)):
             time.sleep(wait)
-            if _probe_ok():
+            ok, _d = _probe_ok()
+            if ok:
                 os.environ["DRACO_BENCH_REEXEC"] = "1"
                 sys.stdout.flush()
                 os.execv(sys.executable, [sys.executable] + sys.argv)

@@ -22,9 +22,28 @@ def main(argv=None):
 
     maybe_force_cpu_mesh(args)
 
+    cfg = config_from_args(args)
+    if cfg.network == "TransformerLM":
+        # LM single-machine path: the (w=1, sp=1) token loop — same
+        # dispatch the distributed CLI uses, minus the coded axes. The
+        # model-parallel knobs span devices this entry point doesn't have:
+        # reject them loudly rather than silently running unsharded.
+        if (cfg.seq_shards > 1 or cfg.tensor_shards > 1
+                or cfg.expert_shards > 1 or cfg.pipeline_shards > 1
+                or cfg.pp_microbatches > 0):
+            raise SystemExit(
+                "single_machine is the one-device path; use "
+                "python -m draco_tpu.cli for seq/tensor/expert/pipeline "
+                "shards"
+            )
+        from draco_tpu.parallel import make_mesh_2d
+        from draco_tpu.parallel.sp_step import train_sp
+
+        _, last = train_sp(cfg, make_mesh_2d(1, 1))
+        return last
+
     from draco_tpu.training.trainer import Trainer
 
-    cfg = config_from_args(args)
     trainer = Trainer(cfg)
     return trainer.run()
 

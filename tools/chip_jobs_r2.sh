@@ -10,7 +10,7 @@ for attempt in $(seq 1 40); do
   # bounded probe: an unbounded in-process jax.devices() blocks ~25 min
   # inside the plugin's retry loop against a wedged tunnel (PERF.md §4);
   # timeout exit 124 counts as down
-  if timeout 300 python - <<'EOF'
+  if timeout -k 30 300 python - <<'EOF'
 import sys, jax
 try:
     d = jax.devices()
