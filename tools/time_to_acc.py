@@ -32,6 +32,8 @@ def main(argv=None) -> int:
     ap.add_argument("--network", type=str, default="LeNet")
     ap.add_argument("--dataset", type=str, default="synthetic-mnist")
     ap.add_argument("--approach", type=str, default="cyclic")
+    ap.add_argument("--mode", type=str, default="normal",
+                    help="aggregation for --approach baseline")
     ap.add_argument("--worker-fail", type=int, default=1)
     ap.add_argument("--err-mode", type=str, default="rev_grad")
     ap.add_argument("--num-workers", type=int, default=8)
@@ -62,6 +64,7 @@ def main(argv=None) -> int:
 
     cfg = TrainConfig(
         network=args.network, dataset=args.dataset, approach=args.approach,
+        mode=args.mode,
         batch_size=args.batch_size, lr=args.lr, momentum=0.9,
         num_workers=args.num_workers, worker_fail=args.worker_fail,
         err_mode=args.err_mode, max_steps=args.max_steps, eval_freq=0,
@@ -105,7 +108,8 @@ def main(argv=None) -> int:
         "device_kind": getattr(dev, "device_kind", dev.platform),
         "config": {
             "network": args.network, "dataset": ds.name,
-            "approach": args.approach, "worker_fail": args.worker_fail,
+            "approach": args.approach, "mode": args.mode,
+            "worker_fail": args.worker_fail,
             "err_mode": args.err_mode, "num_workers": args.num_workers,
             "batch_size_per_worker": args.batch_size, "lr": args.lr,
         },

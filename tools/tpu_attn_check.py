@@ -93,6 +93,37 @@ def check_one(t, b, h, dh, reps, interpret=False):
                   or "OOM" in msg)
         rec["dense"] = "oom" if is_oom else "failed"
         rec["dense_error"] = msg[:200]
+
+    # optional third column: jax's bundled reference Pallas flash op (same
+    # blockwise algorithm, upstream-tuned) — an external yardstick for the
+    # in-repo kernel. Skipped silently where the bundled op can't run
+    # (non-TPU backends, interpret smoke).
+    if not interpret:
+        try:
+            from jax.experimental.pallas.ops.tpu.flash_attention import (
+                flash_attention as jax_flash,
+            )
+
+            # upstream op wants (B,H,T,D) and defaults sm_scale=1.0 — feed
+            # its native layout (pre-transposed OUTSIDE the timed step, so
+            # the yardstick isn't padded with layout copies) and the same
+            # 1/sqrt(dh) temperature the in-repo kernel applies
+            scale = 1.0 / (dh ** 0.5)
+            qh, kh, vh = (jnp.moveaxis(x, 2, 1) for x in (q, k, v))
+
+            def ref(q, k, v):
+                return jax_flash(q, k, v, causal=True, sm_scale=scale)
+
+            o_r = jnp.moveaxis(jax.jit(ref)(qh, kh, vh), 1, 2)
+            rec["jaxref_fwd_max_abs_err"] = float(jnp.max(jnp.abs(o_f - o_r)))
+            rec["jaxref_fwd_ms"] = round(
+                timeit_chained(fwd_step(ref), qh, (kh, vh), reps=reps) * 1e3,
+                3)
+            rec["jaxref_fwdbwd_ms"] = round(
+                timeit_chained(fb_step(ref), qh, (kh, vh), reps=reps) * 1e3,
+                3)
+        except Exception as e:
+            rec["jaxref_error"] = f"{type(e).__name__}: {e}"[:200]
     return rec
 
 
