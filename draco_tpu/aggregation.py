@@ -95,20 +95,31 @@ def trimmed_mean(grads: jnp.ndarray, s: int,
                  present: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """Coordinate-wise s-trimmed mean (Yin et al. 2018): drop the s largest
     and s smallest values per coordinate, average the rest. Requires
-    n > 2s. Absent rows are filled with the present-rows *median* — a
-    robust statistic, so a Byzantine present row cannot leak into the fill
-    (a mean fill would be contaminated and carry the attack into the kept
-    middle); the fill copies land inside the kept middle by construction.
+    n > 2s. With a present mask the trim runs over present rows ONLY —
+    ranks are taken among present values (absent rows sort past the top and
+    never vote) and the kept middle is ranks [s, n_present - s). Filling
+    absent rows with a statistic and trimming all n would plant e fill
+    copies inside the kept middle and bias the mean toward the fill
+    (advisor r2); the e-shrunken middle keeps the estimator honest instead
+    (guarantee needs n_present > 2s — the config straggler budget).
     """
     n = grads.shape[0]
     if n <= 2 * s:
         raise ValueError(f"trimmed_mean requires n > 2s (got n={n}, s={s})")
-    if present is not None:
-        fill = _masked_median(grads, present)
-        grads = jnp.where(present[:, None], grads, fill[None, :])
-    ordered = jnp.sort(grads, axis=0)
-    kept = ordered[s:n - s] if s > 0 else ordered
-    return jnp.mean(kept, axis=0)
+    if present is None:
+        ordered = jnp.sort(grads, axis=0)
+        kept = ordered[s:n - s] if s > 0 else ordered
+        return jnp.mean(kept, axis=0)
+    x = jnp.where(present[:, None], grads, jnp.inf)
+    ranks = jnp.argsort(jnp.argsort(x, axis=0), axis=0)
+    n_p = jnp.sum(present).astype(jnp.int32)
+    hi = jnp.maximum(n_p - s, s + 1)  # keep >= 1 row even when n_p <= 2s
+    w = (ranks >= s) & (ranks < hi) & present[:, None]
+    # select by where, not by multiply: 0 * inf/NaN = NaN would let a
+    # non-finite excluded row (overflowed or Byzantine) poison the sum
+    kept = jnp.where(w, grads, 0.0)
+    return jnp.sum(kept, axis=0) / jnp.maximum(
+        jnp.sum(w.astype(grads.dtype), axis=0), 1.0)
 
 
 def multi_krum(grads: jnp.ndarray, s: int, m: Optional[int] = None,
@@ -134,10 +145,13 @@ def multi_krum(grads: jnp.ndarray, s: int, m: Optional[int] = None,
         keep = jnp.maximum(
             jnp.sum(present).astype(jnp.int32) - s - 2, 1
         )
-    w = (rank < keep).astype(grads.dtype)
+    w = rank < keep
     if present is not None:
-        w = w * present.astype(grads.dtype)
-    return (w @ grads) / jnp.maximum(jnp.sum(w), 1.0)
+        w = w & present
+    # select by where, not by multiply (0 * inf/NaN = NaN — see trimmed_mean)
+    kept = jnp.where(w[:, None], grads, 0.0)
+    return jnp.sum(kept, axis=0) / jnp.maximum(
+        jnp.sum(w.astype(grads.dtype)), 1.0)
 
 
 def bulyan(grads: jnp.ndarray, s: int,
@@ -154,6 +168,20 @@ def bulyan(grads: jnp.ndarray, s: int,
     n = grads.shape[0]
     if n <= 2 * s or n < s + 3:
         raise ValueError(f"bulyan requires n > 2s and n >= s+3 (n={n}, s={s})")
+    if n < 4 * s + 3:
+        # run anyway (useful as a robust heuristic) but say so: β clamps to
+        # max(θ-2s, 1) and the rule degrades toward per-coordinate
+        # nearest-to-median without the Byzantine guarantee (advisor r2).
+        # Fires at trace time, so it lands once per jitted program, not per
+        # step.
+        import warnings
+
+        warnings.warn(
+            f"bulyan: n={n} < 4s+3={4 * s + 3}; the full Byzantine guarantee "
+            f"does not hold and the rule degrades toward per-coordinate "
+            f"nearest-to-median (beta clamps to 1)",
+            stacklevel=2,
+        )
     scores = _krum_scores(grads, s, present)
     rank = jnp.argsort(jnp.argsort(scores))
     if present is None:
@@ -169,32 +197,42 @@ def bulyan(grads: jnp.ndarray, s: int,
     beta = jnp.maximum(theta - 2 * s, 1)
     dist = jnp.where(sel[:, None], jnp.abs(grads - med[None, :]), jnp.inf)
     cranks = jnp.argsort(jnp.argsort(dist, axis=0), axis=0)
-    w = ((cranks < beta) & sel[:, None]).astype(grads.dtype)
-    return jnp.sum(grads * w, axis=0) / jnp.maximum(jnp.sum(w, axis=0), 1.0)
+    w = (cranks < beta) & sel[:, None]
+    # select by where, not by multiply (0 * inf/NaN = NaN — see trimmed_mean)
+    kept = jnp.where(w, grads, 0.0)
+    return jnp.sum(kept, axis=0) / jnp.maximum(
+        jnp.sum(w.astype(grads.dtype), axis=0), 1.0)
 
 
 def _krum_scores(grads: jnp.ndarray, s: int,
                  present: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """Krum scores (shared by krum / multi_krum / bulyan); absent rows score
-    +inf and rank last as neighbours."""
+    +inf and rank last as neighbours. Rows with non-finite entries (an
+    overflowed/NaN Byzantine gradient) are likewise unselectable and rank
+    last — inf distances would otherwise overflow every score and
+    degenerate argmin to the attacker's row."""
     n = grads.shape[0]
     k = n - s - 2
+    finite = jnp.all(jnp.isfinite(grads), axis=1)
+    g_safe = jnp.where(finite[:, None], grads, 0.0)
     # ||gi-gj||^2 via the Gram identity: one (n,d)@(d,n) MXU matmul instead
     # of an (n,n,d) broadcast intermediate
-    gram = jnp.matmul(grads, grads.T, precision=jax.lax.Precision.HIGHEST)
+    gram = jnp.matmul(g_safe, g_safe.T, precision=jax.lax.Precision.HIGHEST)
     norms = jnp.diag(gram)
     sq = jnp.maximum(norms[:, None] + norms[None, :] - 2.0 * gram, 0.0)
-    # penalty for self/absent entries: must outrank every real distance but
-    # stay bounded — n of them can land inside one row's k nearest slots
-    # (straggle_count > s+1 is valid baseline config) and a finfo.max-scale
-    # constant would overflow the score sum to inf for every row,
-    # degenerating argmin to index 0
+    # penalty for self/absent/non-finite entries: must outrank every real
+    # distance but stay bounded — n of them can land inside one row's k
+    # nearest slots (straggle_count > s+1 is valid baseline config) and a
+    # finfo.max-scale constant would overflow the score sum to inf for
+    # every row, degenerating argmin to index 0
     big = 2.0 * jnp.max(sq) + 1.0
     sq = sq + jnp.diag(jnp.full((n,), 1.0, dtype=grads.dtype)) * big
+    sq = sq + big * (~finite)[None, :].astype(grads.dtype)
     if present is not None:
         sq = sq + big * (~present)[None, :].astype(grads.dtype)
     neighbor_sorted = jnp.sort(sq, axis=1)
     scores = jnp.sum(neighbor_sorted[:, :k], axis=1)
+    scores = jnp.where(finite, scores, jnp.inf)
     if present is not None:
         scores = jnp.where(present, scores, jnp.inf)
     return scores
@@ -205,6 +243,12 @@ def aggregate(grads: jnp.ndarray, mode: str, s: int = 0, geomedian_iters: int = 
     """Dispatch used by the baseline training step. The first three modes
     mirror the reference (baseline_master.py:118-129); the rest are
     beyond-reference robust baselines under the same attack schedules."""
+    if present is not None:
+        # an absent row's values never arrived and must never matter — not
+        # even as 0·x products (x could be NaN/inf from a simulated-straggler
+        # lane that diverged); zero placeholders make every rule's masked
+        # arithmetic finite
+        grads = jnp.where(present[:, None], grads, 0.0)
     if mode == "normal":
         return mean(grads, present=present)
     if mode == "geometric_median":

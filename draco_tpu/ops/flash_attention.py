@@ -25,6 +25,7 @@ dense jnp fallback elsewhere; interpret mode in CI.
 from __future__ import annotations
 
 import functools
+import warnings
 
 import jax
 import jax.numpy as jnp
@@ -35,6 +36,7 @@ from draco_tpu.ops.coded import use_pallas
 
 NEG_INF = -1e30
 _LANE = 128
+_FALLBACK_WARNED = set()  # one warning per distinct non-tiling shape
 
 
 def _ceil_to(x: int, m: int) -> int:
@@ -310,6 +312,27 @@ def flash_attention(q, k, v, *, block_q: int = 128, block_k: int = 128,
     # honour the 8-sublane f32 tile
     if (not use or t % 8 or bq % 8 or bk % 8 or t % bq or t % bk
             or dh > _LANE):
+        tiling_fail = bool(t % 8 or bq % 8 or bk % 8 or t % bq or t % bk
+                           or dh > _LANE)
+        if force and tiling_fail:
+            # a caller that explicitly demanded the O(T·Dh)-memory kernel
+            # must not silently get the O(T²) dense path (advisor r2)
+            raise ValueError(
+                f"flash_attention(force=True): shape does not tile "
+                f"(t={t}, bq={bq}, bk={bk}, dh={dh}; need t%8==0, "
+                f"t%bq==0, t%bk==0, blocks%8==0, dh<={_LANE})"
+            )
+        if use and tiling_fail:
+            key = (t, bq, bk, dh)
+            if key not in _FALLBACK_WARNED:
+                _FALLBACK_WARNED.add(key)
+                warnings.warn(
+                    f"flash_attention: falling back to dense O(T²) attention "
+                    f"for non-tiling shape (t={t}, bq={bq}, bk={bk}, "
+                    f"dh={dh}); pad T to a multiple of the block size to "
+                    f"use the blockwise kernel",
+                    stacklevel=2,
+                )
         return dense_attention(q, k, v, causal=True)
 
     dh_p = _ceil_to(dh, _LANE)

@@ -35,7 +35,15 @@ def aggregate_flat_grads(grads: jnp.ndarray, adv_mask, cfg, code, rand_factor,
     reference (cyclic_master.py:125-129), matching the CNN path.
     """
     if cfg.approach == "cyclic":
-        enc_re, enc_im = cyclic_mod.encode_shared(code, grads)
+        if grads.ndim == 3:
+            # (n, hat_s, d): true per-worker redundant lanes
+            # (cfg.redundancy == "simulate" — the reference's r× compute,
+            # cyclic_worker.py:122-146); each worker encodes its own rows
+            enc_re, enc_im = cyclic_mod.encode(code, grads)
+        else:
+            # (n, d): one-copy batch gradients, rows formed algebraically
+            # (cfg.redundancy == "shared", the TPU-native fast path)
+            enc_re, enc_im = cyclic_mod.encode_shared(code, grads)
         enc_re, enc_im = attacks.inject_cyclic(
             enc_re, enc_im, adv_mask, cfg.err_mode, cfg.adversarial
         )

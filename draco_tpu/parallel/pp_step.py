@@ -129,7 +129,27 @@ def build_pp_train_setup(cfg: TrainConfig, mesh) -> PPTrainSetup:
         raise ValueError(f"PP path supports baseline|cyclic, got {cfg.approach}")
     n = cfg.num_workers
     S = mesh.shape[PP_AXIS]
-    assert mesh.shape[WORKER_AXIS] == n, (mesh.shape, n)
+    # logical workers fold onto the available w-axis devices in equal
+    # lane blocks (same discipline as tp_step / runtime.make_mesh): a
+    # single chip can still run the n-lane coded step, vmapped
+    if n % mesh.shape[WORKER_AXIS]:
+        raise ValueError(
+            f"num_workers {n} must be a multiple of the mesh's w axis "
+            f"({mesh.shape[WORKER_AXIS]})"
+        )
+    if cfg.approach == "cyclic" and cfg.redundancy == "simulate":
+        # sp/tp/ep carry true 2s+1-lane redundant compute; here the r×
+        # regime would multiply the whole pipeline schedule per lane for
+        # no semantic difference (per-batch gradients are deterministic, so
+        # the shared encode is algebraically identical) — say so instead of
+        # silently reinterpreting the config
+        import warnings
+
+        warnings.warn(
+            "pp path: redundancy='simulate' is not implemented; using the "
+            "algebraically-identical 'shared' encode",
+            stacklevel=2,
+        )
     L = cfg.model_layers
     if L % S:
         raise ValueError(f"model_layers {L} not divisible by pp={S}")
@@ -191,14 +211,16 @@ def build_pp_train_setup(cfg: TrainConfig, mesh) -> PPTrainSetup:
     )
 
     def device_loss(params_n_local, tokens_local):
-        """One device = one (worker, stage) cell of the mesh.
+        """One device = one (worker-block, stage) cell of the mesh.
 
-        params_n_local: this worker's replica, this stage's block slice —
-        leaves (1, [l_loc,] ...).  tokens_local: (1, B, T).  Returns this
-        worker's mean next-token CE, replicated over pp, shape (1,).
-        """
-        p = jax.tree.map(lambda x: x[0], params_n_local)
-        toks = tokens_local[0]
+        params_n_local: this device's worker replicas, this stage's block
+        slice — leaves (lanes, [l_loc,] ...) where lanes = num_workers /
+        mesh w-axis (1 on a full mesh). tokens_local: (lanes, B, T).
+        Returns each lane worker's mean next-token CE, replicated over pp,
+        shape (lanes,)."""
+        return jax.vmap(_lane_loss)(params_n_local, tokens_local)
+
+    def _lane_loss(p, toks):
         inp, tgt = toks[:, :-1], toks[:, 1:]
         my = lax.axis_index(PP_AXIS)
         positions = jnp.arange(t_in)
@@ -251,8 +273,7 @@ def build_pp_train_setup(cfg: TrainConfig, mesh) -> PPTrainSetup:
         tgt_mb = tgt.reshape(M, mb, t_in)
         nll = -jnp.take_along_axis(logp, tgt_mb[..., None], axis=-1)[..., 0]
         loss = jnp.where(my == S - 1, jnp.mean(nll), 0.0)
-        loss = lax.psum(loss, PP_AXIS)
-        return loss[None]
+        return lax.psum(loss, PP_AXIS)
 
     losses_fn = shard_map(
         device_loss,

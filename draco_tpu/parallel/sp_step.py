@@ -8,9 +8,12 @@ exact whole per-worker gradients; Draco's coding/aggregation then acts on the
 worker's compute was sharded.
 
 Supported approaches here: ``baseline`` (mean / geo-median / krum) and
-``cyclic`` with shared-redundancy encode. (maj_vote's bitwise-equality vote
-is specified over identical lanes; under SP a group member is a whole mesh
-row, which the batching layer does not replicate — use the CNN path for it.)
+``cyclic`` with either redundancy mode — ``simulate`` (reference-parity
+2s+1-lane redundant compute per worker, cyclic_worker.py:122-146) or
+``shared`` (each batch gradient computed once, rows formed algebraically).
+(maj_vote's bitwise-equality vote is specified over identical lanes; under
+SP a group member is a whole mesh row, which the batching layer does not
+replicate — use the CNN path for it.)
 """
 
 from __future__ import annotations
@@ -68,7 +71,14 @@ def build_sp_train_setup(cfg: TrainConfig, mesh) -> SPTrainSetup:
         raise ValueError(f"SP path supports baseline|cyclic, got {cfg.approach}")
     n = cfg.num_workers
     sp = mesh.shape[SEQ_AXIS]
-    assert mesh.shape[WORKER_AXIS] == n, (mesh.shape, n)
+    # logical workers fold onto the available w-axis devices in equal
+    # lane blocks (same discipline as tp_step / runtime.make_mesh): a
+    # single chip can still run the n-lane coded step, vmapped
+    if n % mesh.shape[WORKER_AXIS]:
+        raise ValueError(
+            f"num_workers {n} must be a multiple of the mesh's w axis "
+            f"({mesh.shape[WORKER_AXIS]})"
+        )
     if cfg.seq_len % sp:
         raise ValueError(f"seq_len {cfg.seq_len} not divisible by sp={sp}")
     t_local = cfg.seq_len // sp
@@ -146,29 +156,57 @@ def build_sp_train_setup(cfg: TrainConfig, mesh) -> SPTrainSetup:
         return jnp.sum(nll * pos_valid[None, :]) / denom
 
     def device_grads(params, tokens):
-        """tokens: (1, B, t_local) — this device's shard of one worker's
-        batch. Returns (flat_grad (1, d), loss (1,)) — the worker's FULL
+        """tokens: (lanes, B, t_local) — this device's shard of its workers'
+        batches (lanes = num_workers / mesh w-axis; 1 on a full mesh).
+        Returns (flat_grads (lanes, d), losses (lanes,)) — each worker's FULL
         gradient, psum-assembled over sp and replicated along it."""
-        toks = tokens[0]
-        loss, g = jax.value_and_grad(
-            lambda p: _shard_objective(p, toks, train=True)
-        )(params)
+        def one_lane(toks):
+            loss, g = jax.value_and_grad(
+                lambda p: _shard_objective(p, toks, train=True)
+            )(params)
+            return _flatten_tree(g), loss
+
+        g, loss = jax.vmap(one_lane)(tokens)
         # exact per-worker grad: cotangents already routed through the ring's
         # transpose; psum folds the shard contributions
         g = lax.psum(g, SEQ_AXIS)
         loss = lax.psum(loss, SEQ_AXIS)
-        return _flatten_tree(g)[None], loss[None]
+        return g, loss
 
     def device_loss(params, tokens):
         """Forward-only held-out loss (no backward, no gradient ICI traffic)."""
-        loss = lax.psum(_shard_objective(params, tokens[0], train=False), SEQ_AXIS)
-        return loss[None]
+        loss = jax.vmap(
+            lambda toks: _shard_objective(params, toks, train=False)
+        )(tokens)
+        return lax.psum(loss, SEQ_AXIS)
 
     grads_fn = shard_map(
         device_grads,
         mesh=mesh,
         in_specs=(P(), P(WORKER_AXIS, None, SEQ_AXIS)),
         out_specs=(P(WORKER_AXIS, None), P(WORKER_AXIS)),
+        check_vma=False,
+    )
+
+    def device_grads_sim(params, tokens):
+        """Reference-parity r× redundant compute under SP: tokens
+        (lanes, hat_s, B, t_local) — each lane worker really evaluates its
+        hat_s = 2s+1 assigned batch rows (cyclic_worker.py:122-146).
+        Returns ((lanes, hat_s, d), (lanes, hat_s))."""
+        def one_row(toks):
+            loss, g = jax.value_and_grad(
+                lambda p: _shard_objective(p, toks, train=True)
+            )(params)
+            return _flatten_tree(g), loss
+
+        g, loss = jax.vmap(jax.vmap(one_row))(tokens)
+        return lax.psum(g, SEQ_AXIS), lax.psum(loss, SEQ_AXIS)
+
+    grads_fn_sim = shard_map(
+        device_grads_sim,
+        mesh=mesh,
+        in_specs=(P(), P(WORKER_AXIS, None, None, SEQ_AXIS)),
+        out_specs=(P(WORKER_AXIS, None, None), P(WORKER_AXIS, None)),
         check_vma=False,
     )
 
@@ -179,10 +217,21 @@ def build_sp_train_setup(cfg: TrainConfig, mesh) -> SPTrainSetup:
     else:
         code = None
         rand_factor = None
+    simulate = cfg.approach == "cyclic" and cfg.redundancy == "simulate"
+    batch_ids = jnp.asarray(code.batch_ids) if simulate else None
+    shard_w3 = NamedSharding(mesh, P(WORKER_AXIS, None, None))
 
     def step_body(state: TrainState, tokens, adv_mask, present=None):
-        grads, losses = grads_fn(state.params, tokens)
-        grads = lax.with_sharding_constraint(grads, shard_w)
+        if simulate:
+            # gather each worker's redundant rows (n, hat_s, B, T); GSPMD
+            # inserts the w-axis collective for the cross-worker rows
+            toks_w = tokens[batch_ids]
+            grads, losses = grads_fn_sim(state.params, toks_w)
+            grads = lax.with_sharding_constraint(grads, shard_w3)
+            losses = jnp.mean(losses, axis=1)
+        else:
+            grads, losses = grads_fn(state.params, tokens)
+            grads = lax.with_sharding_constraint(grads, shard_w)
         agg = aggregate_flat_grads(grads, adv_mask, cfg, code, rand_factor,
                                    present=present,
                                    leaf_offsets=leaf_offsets)

@@ -168,14 +168,28 @@ def _build_gspmd_train_setup(cfg: TrainConfig, mesh, *, mp_axis: str,
     else:
         code = None
         rand_factor = None
+    # reference-parity r× redundant compute: each worker really evaluates
+    # its hat_s = 2s+1 assigned batch rows (cyclic_worker.py:122-146); the
+    # "shared" fast path computes each row once and forms encoded rows
+    # algebraically (identical semantics — per-batch gradients are
+    # deterministic under XLA)
+    simulate = cfg.approach == "cyclic" and cfg.redundancy == "simulate"
+    batch_ids = jnp.asarray(code.batch_ids) if simulate else None
+    shard_w3 = NamedSharding(mesh, P(WORKER_AXIS, None, None))
 
     def step_body(state: TrainState, tokens, adv_mask, present=None):
         def lane(toks):
             loss, g = jax.value_and_grad(lane_loss)(state.params, toks, True)
             return _flatten_tree(g), loss
 
-        grads, losses = jax.vmap(lane)(tokens)  # (n, d), (n,)
-        grads = jax.lax.with_sharding_constraint(grads, shard_w)
+        if simulate:
+            toks_w = tokens[batch_ids]  # (n, hat_s, B, T) redundant rows
+            grads, losses = jax.vmap(jax.vmap(lane))(toks_w)  # (n, hat_s, d)
+            grads = jax.lax.with_sharding_constraint(grads, shard_w3)
+            losses = jnp.mean(losses, axis=1)
+        else:
+            grads, losses = jax.vmap(lane)(tokens)  # (n, d), (n,)
+            grads = jax.lax.with_sharding_constraint(grads, shard_w)
         agg = aggregate_flat_grads(grads, adv_mask, cfg, code, rand_factor,
                                    present=present,
                                    leaf_offsets=leaf_offsets)

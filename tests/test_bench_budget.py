@@ -1,0 +1,111 @@
+"""bench.py failure-discipline tests.
+
+The driver consumes exactly one artifact from this repo — bench.py's JSON
+line — and killed it in both prior rounds (BENCH_r01 rc=1, BENCH_r02 rc=124)
+before any output landed. These tests pin the hardened contract: a structured
+record reaches stdout quickly under every failure mode, enforced by fake-probe
+hooks (DRACO_BENCH_FAKE_PROBE / DRACO_BENCH_FAKE_WEDGE) so no test touches
+the real tunnel.
+
+Reference stake: the north-star per-step wall-clock metric itself
+(BASELINE.json; reference README.md:2).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+
+
+def _run_bench(extra_args, env_overrides, timeout=300.0):
+    env = dict(os.environ)
+    env.update(env_overrides)
+    t0 = time.monotonic()
+    proc = subprocess.run(
+        [sys.executable, BENCH] + extra_args,
+        capture_output=True, text=True, cwd=REPO, timeout=timeout, env=env,
+    )
+    elapsed = time.monotonic() - t0
+    records = []
+    for line in proc.stdout.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            records.append(json.loads(line))  # every emitted line must parse
+    return proc, records, elapsed
+
+
+class TestBenchFailureDiscipline:
+    def test_probe_down_emits_structured_record_fast(self):
+        """Tunnel reports down instantly -> tpu_unavailable record in <60 s."""
+        proc, records, elapsed = _run_bench(
+            ["--no-cpu-fallback"],
+            {"DRACO_BENCH_FAKE_PROBE": "down"},
+        )
+        assert elapsed < 60.0, f"took {elapsed:.0f}s"
+        assert proc.returncode == 0, proc.stderr[-500:]
+        assert records, f"no JSON on stdout: {proc.stdout!r}"
+        rec = records[-1]
+        assert rec["error"] == "tpu_unavailable"
+        assert rec["value"] is None
+        assert rec["unit"] == "ms/step"
+        assert "fake probe" in rec["detail"]
+
+    def test_probe_hang_bounded_by_subprocess_timeout(self):
+        """A wedged probe (child sleeps forever) cannot stall the harness:
+        probe subprocesses are bounded and the record still lands in <60 s."""
+        proc, records, elapsed = _run_bench(
+            ["--no-cpu-fallback", "--budget", "40"],
+            {"DRACO_BENCH_FAKE_PROBE": "hang"},
+        )
+        assert elapsed < 60.0, f"took {elapsed:.0f}s"
+        assert records, f"no JSON on stdout: {proc.stdout!r}"
+        rec = records[-1]
+        assert rec["error"] in ("tpu_unavailable", "bench_budget_exceeded")
+        if rec["error"] == "tpu_unavailable":
+            assert "timed out" in rec["detail"]
+
+    def test_watchdog_fires_when_measurement_wedges(self):
+        """A hang past the probe (stuck compile / wedged backend call) is cut
+        by the watchdog thread at the budget with a bench_budget_exceeded
+        record and a hard exit — never rc 124 with an empty tail."""
+        proc, records, elapsed = _run_bench(
+            ["--cpu-mesh", "8", "--budget", "25"],
+            {"DRACO_BENCH_FAKE_WEDGE": "1"},
+        )
+        assert elapsed < 90.0, f"took {elapsed:.0f}s"
+        assert proc.returncode == 2
+        assert records, f"no JSON on stdout: {proc.stdout!r}"
+        rec = records[-1]
+        assert rec["error"] == "bench_budget_exceeded"
+        assert "cyclic_leg" in rec["detail"]
+
+    @pytest.mark.slow
+    def test_probe_down_cpu_fallback_appends_tiny_record(self):
+        """With fallback enabled, the tpu_unavailable record is printed FIRST
+        (it must survive a later kill), then a clearly-labelled LeNet CPU
+        record is appended; the tail line is the most complete record."""
+        proc, records, elapsed = _run_bench(
+            ["--budget", "240", "--steps", "3"],
+            {"DRACO_BENCH_FAKE_PROBE": "down"},
+        )
+        assert records, f"no JSON on stdout: {proc.stdout!r}"
+        assert records[0]["error"] == "tpu_unavailable"
+        assert records[0]["value"] is None
+        # the budget is generous and the probe fails instantly, so the
+        # fallback must actually have run — an unconditional assertion, or a
+        # broken _cpu_fallback would pass vacuously (code-review r3)
+        tail = records[-1]
+        assert tail["error"] == "tpu_unavailable_cpu_fallback", \
+            f"fallback never ran: {tail} / stderr {proc.stderr[-400:]!r}"
+        assert tail["value"] is not None and tail["value"] > 0
+        # fallback reports under its OWN metric name — a LeNet/CPU number
+        # must never enter the flagship metric's series
+        assert "lenet" in tail["metric"] and "cpu_fallback" in tail["metric"]
+        assert tail["extra"]["network"] == "LeNet"
+        assert tail["extra"]["platform"] == "cpu"

@@ -11,6 +11,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+import draco_tpu.ops.flash_attention as fa
 from draco_tpu.ops.flash_attention import flash_attention
 from draco_tpu.parallel.ring_attention import dense_attention
 
@@ -183,3 +184,25 @@ def test_fallback_off_tpu(rng):
     got = flash_attention(q, k, v)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=1e-6, atol=1e-6)
+
+
+def test_force_true_raises_on_non_tiling_shape(rng):
+    """force=True demands the O(T·Dh) kernel; a shape that cannot tile must
+    raise instead of silently running the dense O(T²) path (advisor r2)."""
+    q, k, v = _qkv(rng, t=100, dh=48)  # t=100 doesn't tile
+    with pytest.raises(ValueError, match="does not tile"):
+        flash_attention(q, k, v, force=True)
+
+
+def test_interpret_fallback_warns_once(rng):
+    """interpret=True wants the kernel; a non-tiling shape falls back to
+    dense with a one-time warning per shape."""
+    import warnings as _w
+
+    q, k, v = _qkv(rng, t=100, dh=48)
+    fa._FALLBACK_WARNED.clear()
+    with pytest.warns(UserWarning, match="falling back to dense"):
+        flash_attention(q, k, v, interpret=True)
+    with _w.catch_warnings():
+        _w.simplefilter("error")  # second call with same shape: silent
+        flash_attention(q, k, v, interpret=True)

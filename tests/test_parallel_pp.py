@@ -154,3 +154,21 @@ def test_pp_config_validation():
         _cfg(tensor_shards=2).validate()
     with pytest.raises(ValueError, match="requires network=TransformerLM"):
         TrainConfig(network="LeNet", pipeline_shards=2).validate()
+
+
+def test_pp_worker_folding_matches_full_mesh():
+    """num_workers=4 folded onto a (w=2 × pp=2) mesh (2 vmapped lanes per
+    device) must reproduce the full (w=4 × pp=2) mesh trajectory — the
+    worker-folding discipline tp_step already has, extended to pp (advisor
+    r2)."""
+    cfg = _cfg(num_workers=4, pipeline_shards=2, model_layers=2, batch_size=8)
+    state_full, m_full = train_pp(cfg, make_mesh_wpp(4, 2), steps=3, quiet=True)
+    state_fold, m_fold = train_pp(cfg, make_mesh_wpp(2, 2), steps=3, quiet=True)
+
+    np.testing.assert_allclose(float(m_fold["loss"]), float(m_full["loss"]),
+                               rtol=1e-4)
+    flat_full = np.concatenate(
+        [np.ravel(x) for x in jax.tree.leaves(state_full.params)])
+    flat_fold = np.concatenate(
+        [np.ravel(x) for x in jax.tree.leaves(state_fold.params)])
+    np.testing.assert_allclose(flat_fold, flat_full, rtol=1e-3, atol=1e-5)

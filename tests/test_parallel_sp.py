@@ -274,3 +274,43 @@ def test_a2a_head_divisibility_validated():
     with pytest.raises(ValueError, match="model_heads"):
         _sp_cfg(sp_attn="a2a", seq_shards=4, model_heads=3,
                 model_dim=36).validate()
+
+
+def test_sp_worker_folding_matches_full_mesh():
+    """num_workers=4 folded onto a (w=2 × sp=2) mesh (2 vmapped lanes per
+    device) must reproduce the full (w=4 × sp=2) mesh trajectory — the
+    worker-folding discipline tp_step already has, extended to sp so a
+    single chip can run the n-lane coded SP step (advisor r2)."""
+    cfg = _sp_cfg(num_workers=4, seq_shards=2)
+    state_full, m_full = train_sp(cfg, make_mesh_2d(4, 2), steps=3, quiet=True)
+    state_fold, m_fold = train_sp(cfg, make_mesh_2d(2, 2), steps=3, quiet=True)
+
+    np.testing.assert_allclose(float(m_fold["loss"]), float(m_full["loss"]),
+                               rtol=1e-4)
+    flat_full = np.concatenate(
+        [np.ravel(x) for x in jax.tree.leaves(state_full.params)])
+    flat_fold = np.concatenate(
+        [np.ravel(x) for x in jax.tree.leaves(state_fold.params)])
+    np.testing.assert_allclose(flat_fold, flat_full, rtol=1e-3, atol=1e-5)
+
+
+def test_sp_cyclic_simulate_matches_shared():
+    """Reference-parity r× redundant compute under sequence parallelism:
+    redundancy='simulate' (each worker evaluates its 2s+1 assigned rows,
+    sequence-sharded) must match the 'shared' fast path trajectory; one
+    live rev_grad adversary is decoded away in both. n=8 workers fold onto
+    the (w=4 × sp=2) mesh."""
+    kw = dict(num_workers=8, seq_shards=2, approach="cyclic", worker_fail=1,
+              err_mode="rev_grad")
+    mesh = make_mesh_2d(4, 2)
+    st_sim, m_sim = train_sp(_sp_cfg(redundancy="simulate", **kw), mesh,
+                             steps=3, quiet=True)
+    st_sh, m_sh = train_sp(_sp_cfg(redundancy="shared", **kw), mesh,
+                           steps=3, quiet=True)
+    np.testing.assert_allclose(float(m_sim["loss"]), float(m_sh["loss"]),
+                               rtol=1e-4)
+    flat_sim = np.concatenate(
+        [np.ravel(x) for x in jax.tree.leaves(st_sim.params)])
+    flat_sh = np.concatenate(
+        [np.ravel(x) for x in jax.tree.leaves(st_sh.params)])
+    np.testing.assert_allclose(flat_sim, flat_sh, rtol=1e-3, atol=1e-5)

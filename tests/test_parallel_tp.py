@@ -96,3 +96,26 @@ def test_tp_validation():
         _tp_cfg(tensor_shards=3).validate()
     with pytest.raises(ValueError, match="separate paths"):
         _tp_cfg(tensor_shards=2, seq_shards=2).validate()
+
+
+def test_tp_cyclic_simulate_matches_shared():
+    """Reference-parity r× redundant compute (redundancy='simulate',
+    cyclic_worker.py:122-146) and the one-copy 'shared' fast path must give
+    the same trajectory — per-batch gradients are deterministic under XLA,
+    so the encoded rows are algebraically identical. n=8 workers fold onto
+    the (w=4 × tp=2) mesh, 2 lanes/device; one live rev_grad adversary is
+    decoded away in both."""
+    kw = dict(num_workers=8, approach="cyclic", worker_fail=1,
+              err_mode="rev_grad")
+    mesh = make_mesh_wtp(4, 2)
+    st_sim, m_sim = train_tp(_tp_cfg(redundancy="simulate", **kw), mesh,
+                             steps=3, quiet=True)
+    st_sh, m_sh = train_tp(_tp_cfg(redundancy="shared", **kw), mesh,
+                           steps=3, quiet=True)
+    np.testing.assert_allclose(float(m_sim["loss"]), float(m_sh["loss"]),
+                               rtol=1e-4)
+    np.testing.assert_allclose(
+        _flat(jax.device_get(st_sim.params)),
+        _flat(jax.device_get(st_sh.params)),
+        rtol=1e-3, atol=1e-5,
+    )

@@ -162,8 +162,8 @@ class TestAggregators:
         assert np.linalg.norm(out - base) < 1.0
 
     def test_trimmed_mean_joint_straggler_adversary(self, rng):
-        """Regression: absent rows are median-filled, so a Byzantine present
-        row cannot leak into the fill and ride inside the kept middle."""
+        """Regression: the trim runs over present rows only, so absent-row
+        garbage never votes and a Byzantine present row is still trimmed."""
         n, s = 9, 2
         base = rng.randn(16).astype(np.float32)
         g = base[None, :] + 0.01 * rng.randn(n, 16).astype(np.float32)
@@ -219,6 +219,73 @@ class TestAggregators:
             TrainConfig(approach="baseline", mode="trimmed_mean",
                         num_workers=9, worker_fail=2, straggle_mode="drop",
                         straggle_count=6).validate()
+
+    def test_trimmed_mean_present_only_oracle(self, rng):
+        """With a present mask the trim is exactly the numpy trimmed mean of
+        the present rows — no fill values enter the kept middle (advisor r2:
+        a median fill lands e copies inside the middle and biases the mean
+        toward the median as straggle_count grows)."""
+        n, s = 9, 2
+        g = rng.randn(n, 13).astype(np.float32)
+        present = np.ones(n, bool)
+        present[[1, 6]] = False
+        g[[1, 6]] = 1e6  # absent-row garbage must not vote
+        out = np.asarray(aggregation.trimmed_mean(
+            jnp.asarray(g), s, present=jnp.asarray(present)))
+        kept = np.sort(g[present], axis=0)[s:present.sum() - s]
+        np.testing.assert_allclose(out, kept.mean(axis=0), rtol=1e-6)
+
+    def test_bulyan_warns_below_guarantee_threshold(self, rng):
+        """n < 4s+3 runs but warns that the Byzantine guarantee is degraded
+        (advisor r2: silent beta clamp)."""
+        g = rng.randn(7, 8).astype(np.float32)
+        with pytest.warns(UserWarning, match="4s\\+3"):
+            aggregation.bulyan(jnp.asarray(g), 2)
+
+    def test_excluded_nonfinite_rows_cannot_poison(self, rng):
+        """A non-finite excluded row (overflowed Byzantine present row, or
+        NaN garbage in an absent row) must not leak into trimmed_mean /
+        bulyan / the aggregate() dispatch via 0·inf = NaN products
+        (code-review r3)."""
+        n, s = 9, 2
+        base = rng.randn(16).astype(np.float32)
+        g0 = base[None, :] + 0.01 * rng.randn(n, 16).astype(np.float32)
+        present = np.ones(n, bool)
+        present[6] = False
+
+        # absent-row NaN garbage: every rule must stay finite (aggregate()
+        # zeroes absent rows before dispatch)
+        g = g0.copy()
+        g[6] = np.nan
+        for mode in ("normal", "geometric_median", "krum", "coord_median",
+                     "trimmed_mean", "multi_krum", "bulyan"):
+            out = np.asarray(aggregation.aggregate(
+                jnp.asarray(g), mode, s=s, present=jnp.asarray(present)))
+            assert np.isfinite(out).all(), f"{mode} poisoned by absent NaN"
+
+        # non-finite Byzantine PRESENT row: the rank/selection rules exclude
+        # it by weight and must not let 0·inf products reintroduce it
+        # (mean is legitimately inf there; Weiszfeld-on-inf matches the
+        # reference's hdmedians behaviour — neither is asserted)
+        g = g0.copy()
+        g[6] = np.nan
+        g[0] = np.inf
+        for mode in ("krum", "coord_median", "trimmed_mean", "multi_krum",
+                     "bulyan"):
+            out = np.asarray(aggregation.aggregate(
+                jnp.asarray(g), mode, s=s, present=jnp.asarray(present)))
+            assert np.isfinite(out).all(), f"{mode} poisoned by present inf"
+        out = np.asarray(aggregation.trimmed_mean(
+            jnp.asarray(g), s, present=jnp.asarray(present)))
+        assert np.linalg.norm(out - base) < 1.0
+
+    def test_bulyan_no_warning_at_full_guarantee(self, rng):
+        import warnings as _w
+
+        g = rng.randn(11, 8).astype(np.float32)
+        with _w.catch_warnings():
+            _w.simplefilter("error")
+            aggregation.bulyan(jnp.asarray(g), 2)
 
 
 class TestAttacks:

@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""Multi-point convergence-under-attack curves for every approach and
+aggregator under one identical schedule (VERDICT r2 item 8).
+
+Runs tools/time_to_acc.py's measurement for each row of the grid — LeNet /
+synthetic-MNIST, n=8 workers, one rev_grad adversary (seeded schedule shared
+across rows), eval every ``--eval-every`` steps from step 1 — and writes one
+JSON with all curves side by side (baselines_out/convergence_grid.json), the
+routine artifact the reference establishes with its convergence oracle
+(src/distributed_evaluator.py:92-110).
+
+Rows: cyclic simulate + shared, maj_vote (r=4 | n=8), the three
+reference-parity baselines (mean / geo-median / krum) and the four
+beyond-reference aggregators (coord_median / trimmed_mean / multi_krum /
+bulyan) — all under attack — plus a clean mean run as the matched-accuracy
+anchor.
+
+Usage: python tools/convergence_grid.py --cpu-mesh 8 [--eval-every 5]
+       [--max-steps 150] [--rows cyclic_sim,geomedian,...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import time_to_acc  # noqa: E402  (sibling tool; shares the measurement loop)
+
+ROWS = {
+    # label -> extra argv for time_to_acc.main
+    "mean_clean": ["--approach", "baseline", "--mode", "normal",
+                   "--worker-fail", "0"],
+    "mean_attacked": ["--approach", "baseline", "--mode", "normal"],
+    "geomedian": ["--approach", "baseline", "--mode", "geometric_median"],
+    "krum": ["--approach", "baseline", "--mode", "krum"],
+    "coord_median": ["--approach", "baseline", "--mode", "coord_median"],
+    "trimmed_mean": ["--approach", "baseline", "--mode", "trimmed_mean"],
+    "multi_krum": ["--approach", "baseline", "--mode", "multi_krum"],
+    "bulyan": ["--approach", "baseline", "--mode", "bulyan"],
+    "maj_vote": ["--approach", "maj_vote", "--group-size", "4"],
+    "cyclic_sim": ["--approach", "cyclic", "--redundancy", "simulate"],
+    "cyclic_shared": ["--approach", "cyclic", "--redundancy", "shared"],
+}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", type=str,
+                    default="baselines_out/convergence_grid.json")
+    ap.add_argument("--network", type=str, default="LeNet")
+    ap.add_argument("--dataset", type=str, default="synthetic-mnist")
+    ap.add_argument("--eval-every", type=int, default=5)
+    ap.add_argument("--max-steps", type=int, default=150)
+    ap.add_argument("--target", type=float, default=0.98)
+    ap.add_argument("--num-workers", type=int, default=8)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--cpu-mesh", type=int, default=0)
+    ap.add_argument("--rows", type=str, default="",
+                    help="comma-separated subset of row labels (default all)")
+    args = ap.parse_args(argv)
+
+    from draco_tpu.cli import maybe_force_cpu_mesh
+
+    maybe_force_cpu_mesh(args)
+
+    labels = [s for s in args.rows.split(",") if s] or list(ROWS)
+    tmp_dir = os.path.join(os.path.dirname(args.out) or ".", "_grid_tmp")
+    os.makedirs(tmp_dir, exist_ok=True)
+
+    grid = {}
+    for label in labels:
+        extra = ROWS[label]
+        tmp = os.path.join(tmp_dir, f"{label}.json")
+        argv_row = [
+            "--out", tmp,
+            "--network", args.network, "--dataset", args.dataset,
+            "--num-workers", str(args.num_workers),
+            "--batch-size", str(args.batch_size),
+            "--eval-every", str(args.eval_every),
+            "--max-steps", str(args.max_steps),
+            "--target", str(args.target),
+        ] + extra
+        print(f"grid: running {label} ...", flush=True)
+        time_to_acc.main(argv_row)
+        with open(tmp) as fh:
+            grid[label] = json.load(fh)
+        r = grid[label]["reached"]
+        pts = len(grid[label]["curve"])
+        print(f"grid: {label}: {pts} curve points, "
+              f"reached={r and (r['step'], r['prec1_test'])}", flush=True)
+
+    report = {
+        "schedule": {
+            "network": args.network, "dataset": args.dataset,
+            "num_workers": args.num_workers,
+            "batch_size_per_worker": args.batch_size,
+            "eval_every": args.eval_every, "max_steps": args.max_steps,
+            "target_prec1": args.target,
+            "attack": "rev_grad, 1 adversary (seeded schedule shared "
+                      "across rows; mean_clean row is the no-attack anchor)",
+        },
+        "rows": grid,
+    }
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+    with open(args.out, "w") as fh:
+        json.dump(report, fh, indent=1)
+    print(json.dumps({k: {"points": len(v["curve"]),
+                          "reached_step": v["reached"] and v["reached"]["step"],
+                          "final_prec1": v["curve"][-1]["prec1_test"]
+                          if v["curve"] else None}
+                      for k, v in grid.items()}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

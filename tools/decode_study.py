@@ -1,0 +1,161 @@
+#!/usr/bin/env python
+"""Decode-granularity and s-scaling study (VERDICT r2 item 7).
+
+Two questions the round-2 evidence left at two data points:
+
+1. How do the isolated encode / decode costs scale with the Byzantine
+   budget s ∈ {1, 2, 3} and the worker count n ∈ {8, 16, 32} at the
+   flagship gradient dimension — against the Weiszfeld geometric-median
+   cost at the same (n, d)? (The "decode stays flat while Weiszfeld
+   scales" claim.)
+2. What does reference-parity per-layer decode granularity
+   (cyclic_master.py:125-129, one locator per parameter tensor) cost vs
+   the global one-locator decode, as a full train step?
+
+Writes after every point; a mid-run tunnel loss keeps completed points.
+
+Usage: python tools/decode_study.py [--out baselines_out/decode_study.json]
+       [--d 11173962] [--cpu-mesh 8 for smoke]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def geomedian_ms(n, d, iters=80, reps=10):
+    """Isolated Weiszfeld cost at (n, d) under the chained-feedback timing
+    protocol (utils/timing.py) — the PS-phase cost cyclic decode replaces."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from draco_tpu import aggregation
+    from draco_tpu.utils.timing import timeit_chained
+
+    r = np.random.RandomState(0)
+    g = jnp.asarray(r.randn(n, d).astype(np.float32))
+
+    def step(gc):
+        med = aggregation.geometric_median(gc, iters=iters)
+        return gc.at[0, 0].add(1e-30 * jnp.sum(med**2))
+
+    return timeit_chained(step, g, reps=reps) * 1e3
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", type=str,
+                    default="baselines_out/decode_study.json")
+    ap.add_argument("--d", type=int, default=0,
+                    help="gradient dimension (0 = flagship ResNet-18 dim)")
+    ap.add_argument("--ns", type=str, default="8,16,32")
+    ap.add_argument("--ss", type=str, default="1,2,3")
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--reps", type=int, default=10)
+    ap.add_argument("--skip-granularity", action="store_true")
+    ap.add_argument("--cpu-mesh", type=int, default=0)
+    args = ap.parse_args(argv)
+
+    from draco_tpu.cli import maybe_force_cpu_mesh
+
+    maybe_force_cpu_mesh(args)
+
+    import jax
+
+    from tpu_perf import phase_times
+
+    dev = jax.devices()[0]
+    d = args.d
+    if not d:
+        # flagship dimension without building the model: ResNet-18/CIFAR-10
+        # param count, pinned by tests (tests/test_models_optim_data.py)
+        d = 11_173_962
+
+    report = {
+        "platform": dev.platform,
+        "device_kind": getattr(dev, "device_kind", dev.platform),
+        "grad_dim": d,
+        "geomedian_iters": 80,
+        "scaling": [],
+        "granularity": {},
+    }
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+
+    def flush():
+        with open(args.out, "w") as fh:
+            json.dump(report, fh, indent=1)
+
+    # ---- s / n scaling of isolated phases vs Weiszfeld --------------------
+    for n in [int(x) for x in args.ns.split(",")]:
+        gm = None
+        for s in [int(x) for x in args.ss.split(",")]:
+            if n <= 4 * s:  # cyclic existence condition
+                report["scaling"].append({"n": n, "s": s,
+                                          "skipped": "needs n > 4s"})
+                flush()
+                continue
+            print(f"[decode_study] n={n} s={s} ...", file=sys.stderr,
+                  flush=True)
+            t0 = time.time()
+            try:
+                enc_ms, dec_ms = phase_times(n, d, s, reps=args.reps)
+                if gm is None:
+                    gm = geomedian_ms(n, d, reps=args.reps)
+            except Exception as e:
+                report["scaling"].append({"n": n, "s": s,
+                                          "error": f"{type(e).__name__}: {e}"[:300]})
+                flush()
+                continue
+            row = {
+                "n": n, "s": s,
+                "encode_ms": round(enc_ms, 3),
+                "decode_ms": round(dec_ms, 3),
+                "geomedian_ms_same_n": round(gm, 3),
+                "decode_vs_geomedian": round(gm / dec_ms, 2),
+                "measure_s": round(time.time() - t0, 1),
+            }
+            report["scaling"].append(row)
+            print(f"[decode_study] n={n} s={s}: enc {row['encode_ms']} ms, "
+                  f"dec {row['decode_ms']} ms, geomed {row['geomedian_ms_same_n']} ms",
+                  file=sys.stderr, flush=True)
+            flush()
+
+    # ---- decode granularity: global vs per-layer, full train step ---------
+    if not args.skip_granularity:
+        import bench
+        from draco_tpu.data.datasets import load_dataset
+        from draco_tpu.runtime import make_mesh
+
+        ds = load_dataset("Cifar10", data_dir="./data")
+        mesh = make_mesh(8)
+        for gran in ("global", "layer"):
+            kw = dict(
+                network="ResNet18", dataset="Cifar10", batch_size=32,
+                lr=0.01, momentum=0.9, num_workers=8, worker_fail=1,
+                err_mode="rev_grad", approach="cyclic",
+                redundancy="simulate", decode_granularity=gran,
+                max_steps=args.steps + 1, eval_freq=0, train_dir="",
+                log_every=10**9,
+            )
+            print(f"[decode_study] granularity={gran} full step ...",
+                  file=sys.stderr, flush=True)
+            try:
+                dt, _loss, _f = bench.run(kw, ds, mesh, args.steps,
+                                          warmup=1, reps=2)
+                report["granularity"][gran] = round(dt * 1e3, 3)
+            except Exception as e:
+                report["granularity"][gran] = f"{type(e).__name__}: {e}"[:300]
+            flush()
+
+    print(json.dumps(report))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

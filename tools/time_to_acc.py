@@ -36,6 +36,11 @@ def main(argv=None) -> int:
                     help="aggregation for --approach baseline")
     ap.add_argument("--worker-fail", type=int, default=1)
     ap.add_argument("--err-mode", type=str, default="rev_grad")
+    ap.add_argument("--redundancy", type=str, default="simulate",
+                    help="cyclic compute regime: simulate (reference-parity "
+                         "2s+1 lanes) | shared (one-copy fast path)")
+    ap.add_argument("--group-size", type=int, default=3,
+                    help="repetition redundancy r for --approach maj_vote")
     ap.add_argument("--num-workers", type=int, default=8)
     ap.add_argument("--batch-size", type=int, default=32)
     ap.add_argument("--lr", type=float, default=0.01)
@@ -45,14 +50,9 @@ def main(argv=None) -> int:
     ap.add_argument("--cpu-mesh", type=int, default=0)
     args = ap.parse_args(argv)
 
-    if args.cpu_mesh:
-        os.environ["XLA_FLAGS"] = (
-            os.environ.get("XLA_FLAGS", "")
-            + f" --xla_force_host_platform_device_count={args.cpu_mesh}"
-        ).strip()
-        import jax
+    from draco_tpu.cli import maybe_force_cpu_mesh
 
-        jax.config.update("jax_platforms", "cpu")
+    maybe_force_cpu_mesh(args)
 
     import jax
 
@@ -64,7 +64,8 @@ def main(argv=None) -> int:
 
     cfg = TrainConfig(
         network=args.network, dataset=args.dataset, approach=args.approach,
-        mode=args.mode,
+        mode=args.mode, redundancy=args.redundancy,
+        group_size=args.group_size,
         batch_size=args.batch_size, lr=args.lr, momentum=0.9,
         num_workers=args.num_workers, worker_fail=args.worker_fail,
         err_mode=args.err_mode, max_steps=args.max_steps, eval_freq=0,
@@ -109,6 +110,7 @@ def main(argv=None) -> int:
         "config": {
             "network": args.network, "dataset": ds.name,
             "approach": args.approach, "mode": args.mode,
+            "redundancy": args.redundancy, "group_size": args.group_size,
             "worker_fail": args.worker_fail,
             "err_mode": args.err_mode, "num_workers": args.num_workers,
             "batch_size_per_worker": args.batch_size, "lr": args.lr,

@@ -83,14 +83,9 @@ def main(argv=None) -> int:
     ap.add_argument("--trace-dir", type=str, default="baselines_out/trace")
     args = ap.parse_args(argv)
 
-    if args.cpu_mesh:
-        os.environ["XLA_FLAGS"] = (
-            os.environ.get("XLA_FLAGS", "")
-            + f" --xla_force_host_platform_device_count={args.cpu_mesh}"
-        ).strip()
-        import jax
+    from draco_tpu.cli import maybe_force_cpu_mesh
 
-        jax.config.update("jax_platforms", "cpu")
+    maybe_force_cpu_mesh(args)
 
     import jax
 

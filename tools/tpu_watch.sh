@@ -1,0 +1,13 @@
+#!/bin/bash
+# Poll the TPU tunnel with bounded probes until it answers; log transitions.
+# Usage: tools/tpu_watch.sh [interval_s] — writes /tmp/tpu_watch.log
+INT=${1:-120}
+while true; do
+  if timeout -k 10 90 python -c "import jax; d=jax.devices(); assert d[0].platform!='cpu'" 2>/dev/null; then
+    echo "$(date +%H:%M:%S) TPU UP" >> /tmp/tpu_watch.log
+    exit 0
+  else
+    echo "$(date +%H:%M:%S) tpu down" >> /tmp/tpu_watch.log
+  fi
+  sleep "$INT"
+done
