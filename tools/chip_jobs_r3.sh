@@ -1,0 +1,43 @@
+#!/bin/bash
+# Round-3 chip job chain: wait for the tunnel TPU, then run every pending
+# hardware study in priority order (one client at a time per the tunnel
+# discipline). Each step is independent — a failure or a mid-chain tunnel
+# loss keeps earlier artifacts. Safe to re-run; artifacts land in
+# baselines_out/.
+#
+# Priority order mirrors VERDICT r2 "Next round: do this":
+#   1. bench.py sanity (the driver-captured headline must land)
+#   2. flash-attention hardware check (item 2 — never Mosaic-compiled)
+#   3. long-context remat LM run (item 2)
+#   4. LM simulate-vs-shared at d~63M (item 6)
+#   5. batch x dtype MFU sweep (item 4)
+#   6. decode s/n scaling + per-layer granularity (item 7)
+#   7. TPU time-to-accuracy: ResNet-18 cyclic vs geo-median, eval every 5
+#      (item 3)
+set -u
+cd "$(dirname "$0")/.."
+
+tools/wait_tpu.sh 60 150 120 || exit 3
+
+run() {
+  echo "[chip_jobs_r3] ===== $* ====="
+  "$@" || echo "[chip_jobs_r3] FAILED (continuing): $*"
+}
+
+run python bench.py --budget 280
+run python tools/tpu_attn_check.py --out baselines_out/tpu_attn.json
+run python tools/tpu_lm_perf.py --remat --batch-size 8 --seq-len 1024 --steps 3 \
+  --variants lm_cyclic_s1_shared_bf16,lm_mean_no_attack_bf16 \
+  --out baselines_out/tpu_lm_perf_long.json
+run python tools/tpu_lm_perf.py --steps 4 \
+  --variants lm_cyclic_s1_shared_bf16,lm_cyclic_s1_simulate_bf16,lm_geomedian_bf16 \
+  --out baselines_out/tpu_lm_perf_simulate.json
+run python tools/tpu_sweep.py --out baselines_out/tpu_sweep.json
+run python tools/decode_study.py --out baselines_out/decode_study.json
+run python tools/time_to_acc.py --network ResNet18 --dataset Cifar10 \
+  --approach cyclic --redundancy simulate --eval-every 5 --max-steps 300 \
+  --target 0.9 --out baselines_out/tpu_tta_resnet_cyclic.json
+run python tools/time_to_acc.py --network ResNet18 --dataset Cifar10 \
+  --approach baseline --mode geometric_median --eval-every 5 --max-steps 300 \
+  --target 0.9 --out baselines_out/tpu_tta_resnet_geomedian.json
+echo "[chip_jobs_r3] done"

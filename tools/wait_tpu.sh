@@ -1,0 +1,30 @@
+#!/bin/bash
+# Shared bounded-probe wait loop for the tunnel TPU (one source of truth
+# for the tunnel discipline: an unbounded in-process jax.devices() blocks
+# ~25 min inside the plugin's retry loop against a wedged lease, PERF.md §4).
+#
+# Usage: tools/wait_tpu.sh [attempts] [sleep_s] [probe_timeout_s]
+# Exits 0 the moment a probe sees a non-cpu device; 3 after `attempts`
+# failures.
+ATTEMPTS=${1:-60}
+SLEEP_S=${2:-150}
+PROBE_S=${3:-120}
+for attempt in $(seq 1 "$ATTEMPTS"); do
+  if timeout -k 30 "$PROBE_S" python - <<'EOF'
+import sys, jax
+try:
+    d = jax.devices()
+    sys.exit(0 if d and d[0].platform != "cpu" else 3)
+except Exception:
+    sys.exit(3)
+EOF
+  then
+    echo "[wait_tpu] TPU up (attempt $attempt)"
+    exit 0
+  fi
+  echo "[wait_tpu] attempt $attempt/$ATTEMPTS: TPU still down"
+  [ "$attempt" = "$ATTEMPTS" ] && break
+  sleep "$SLEEP_S"
+done
+echo "[wait_tpu] giving up"
+exit 3
