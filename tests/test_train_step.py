@@ -182,3 +182,35 @@ class TestEvalAndCheckpoint:
         tr2 = Trainer(cfg2, mesh=mesh, dataset=ds, quiet=True)
         assert tr2._start_step == 31
         assert int(tr2.state.step) == 31
+
+
+def test_elastic_resume_across_topology_and_approach(tmp_path, ds):
+    """Beyond the reference (whose PS blocks forever on a topology change and
+    resumes from a hardcoded path, baseline_master.py:54-57): a checkpoint
+    written by a cyclic n=8 run restores into a geo-median n=6 run.
+    Params/opt state are replicated and topology-independent, so an operator
+    can shrink the fleet or swap the aggregation rule mid-training."""
+    cfg8 = make_cfg(num_workers=8, approach="cyclic", worker_fail=1,
+                    err_mode="rev_grad", batch_size=4, max_steps=6,
+                    eval_freq=3, train_dir=str(tmp_path))
+    tr8 = Trainer(cfg8, mesh=make_mesh(8), dataset=ds, quiet=True)
+    tr8.run()
+    tr8.close()
+    from draco_tpu.utils import checkpoint as ckpt_mod
+
+    assert 6 in ckpt_mod.available_steps(str(tmp_path))
+    saved = np.concatenate(
+        [np.ravel(x) for x in jax.tree.leaves(jax.device_get(tr8.state.params))])
+
+    cfg6 = make_cfg(num_workers=6, approach="baseline",
+                    mode="geometric_median", worker_fail=1,
+                    err_mode="rev_grad", batch_size=4, max_steps=10,
+                    eval_freq=0, train_dir=str(tmp_path), checkpoint_step=6)
+    tr6 = Trainer(cfg6, mesh=make_mesh(6), dataset=ds, quiet=True)
+    restored = np.concatenate(
+        [np.ravel(x) for x in jax.tree.leaves(jax.device_get(tr6.state.params))])
+    np.testing.assert_array_equal(restored, saved)  # exact handoff
+    last = tr6.run()
+    tr6.close()
+    assert int(tr6.state.step) == 11  # resumed at 7, ran through 10
+    assert np.isfinite(last["loss"])

@@ -1,34 +1,12 @@
 #!/bin/bash
-# One-shot round-2 chip job chain: wait for the tunnel TPU to come back,
-# then run the two pending hardware benchmarks sequentially (one client at
-# a time per the tunnel discipline). Safe to re-run; artifacts land in
-# baselines_out/.
+# Round-2 chip jobs (superseded by tools/chip_jobs_r3.sh, which includes
+# both of these plus the round-3 studies — prefer that). Kept as the
+# documented two-job chain: flash-attention hardware check + long-context
+# remat LM run. Waits for the tunnel via the shared bounded probe.
 set -eu
 cd "$(dirname "$0")/.."
 
-for attempt in $(seq 1 40); do
-  # bounded probe: an unbounded in-process jax.devices() blocks ~25 min
-  # inside the plugin's retry loop against a wedged tunnel (PERF.md §4);
-  # timeout exit 124 counts as down
-  if timeout -k 30 300 python - <<'EOF'
-import sys, jax
-try:
-    d = jax.devices()
-    sys.exit(0 if d and d[0].platform != "cpu" else 3)
-except Exception:
-    sys.exit(3)
-EOF
-  then
-    echo "[chip_jobs] TPU up (attempt $attempt)"
-    break
-  fi
-  echo "[chip_jobs] attempt $attempt: TPU still down"
-  if [ "$attempt" = 40 ]; then
-    echo "[chip_jobs] giving up"
-    exit 3
-  fi
-  sleep 180
-done
+tools/wait_tpu.sh 40 180 300
 
 echo "[chip_jobs] running tpu_attn_check (flash vs dense, T=1024..4096)"
 python tools/tpu_attn_check.py --out baselines_out/tpu_attn.json

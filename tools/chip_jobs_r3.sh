@@ -2,8 +2,8 @@
 # Round-3 chip job chain: wait for the tunnel TPU, then run every pending
 # hardware study in priority order (one client at a time per the tunnel
 # discipline). Each step is independent — a failure or a mid-chain tunnel
-# loss keeps earlier artifacts. Safe to re-run; artifacts land in
-# baselines_out/.
+# loss keeps earlier artifacts, but the exit code reflects any failure.
+# Safe to re-run; artifacts land in baselines_out/.
 #
 # Priority order mirrors VERDICT r2 "Next round: do this":
 #   1. bench.py sanity (the driver-captured headline must land)
@@ -19,9 +19,13 @@ cd "$(dirname "$0")/.."
 
 tools/wait_tpu.sh 60 150 120 || exit 3
 
+FAILURES=0
 run() {
   echo "[chip_jobs_r3] ===== $* ====="
-  "$@" || echo "[chip_jobs_r3] FAILED (continuing): $*"
+  if ! "$@"; then
+    echo "[chip_jobs_r3] FAILED (continuing): $*"
+    FAILURES=$((FAILURES + 1))
+  fi
 }
 
 run python bench.py --budget 280
@@ -40,4 +44,5 @@ run python tools/time_to_acc.py --network ResNet18 --dataset Cifar10 \
 run python tools/time_to_acc.py --network ResNet18 --dataset Cifar10 \
   --approach baseline --mode geometric_median --eval-every 5 --max-steps 300 \
   --target 0.9 --out baselines_out/tpu_tta_resnet_geomedian.json
-echo "[chip_jobs_r3] done"
+echo "[chip_jobs_r3] done ($FAILURES failures)"
+exit $((FAILURES > 0 ? 1 : 0))

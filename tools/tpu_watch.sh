@@ -1,13 +1,14 @@
 #!/bin/bash
-# Poll the TPU tunnel with bounded probes until it answers; log transitions.
-# Usage: tools/tpu_watch.sh [interval_s] — writes /tmp/tpu_watch.log
-INT=${1:-120}
+# Poll the TPU tunnel (via the shared bounded probe, tools/wait_tpu.sh)
+# until it answers; log transitions to /tmp/tpu_watch.log.
+# Usage: tools/tpu_watch.sh [interval_s]
+INT=${1:-150}
+cd "$(dirname "$0")/.."
 while true; do
-  if timeout -k 10 90 python -c "import jax; d=jax.devices(); assert d[0].platform!='cpu'" 2>/dev/null; then
+  if tools/wait_tpu.sh 1 0 90 > /dev/null 2>&1; then
     echo "$(date +%H:%M:%S) TPU UP" >> /tmp/tpu_watch.log
     exit 0
-  else
-    echo "$(date +%H:%M:%S) tpu down" >> /tmp/tpu_watch.log
   fi
+  echo "$(date +%H:%M:%S) tpu down" >> /tmp/tpu_watch.log
   sleep "$INT"
 done
