@@ -266,3 +266,84 @@ def test_time_to_acc_tool(tmp_path):
     walls = [c["train_wall_s"] for c in rep["curve"]]
     assert walls == sorted(walls)
     assert rep["real_data_available"] is False
+
+
+def test_tpu_lm_perf_simulate_variant(tmp_path):
+    """The simulate variant (reference-parity 2s+1-lane compute) runs and
+    reports more FLOPs than shared at identical loss (exact decode)."""
+    import json
+
+    from tools import tpu_lm_perf
+
+    out = tmp_path / "lm_sim.json"
+    rc = tpu_lm_perf.main([
+        "--out", str(out), "--cpu-mesh", "4", "--num-workers", "8",
+        "--model-dim", "32", "--model-heads", "2", "--model-layers", "1",
+        "--vocab", "32", "--seq-len", "16", "--batch-size", "2",
+        "--steps", "2", "--reps", "1",
+        "--variants", "lm_cyclic_s1_shared_bf16,lm_cyclic_s1_simulate_bf16",
+    ])
+    rep = json.loads(out.read_text())
+    assert rc == 0
+    assert rep["lm_cyclic_s1_simulate_bf16_step_ms"] > 0
+    assert (rep["lm_cyclic_s1_simulate_bf16_flops_per_step"]
+            > 2.0 * rep["lm_cyclic_s1_shared_bf16_flops_per_step"])
+    assert abs(rep["lm_cyclic_s1_simulate_bf16_loss"]
+               - rep["lm_cyclic_s1_shared_bf16_loss"]) < 1e-3
+
+
+def test_tpu_sweep_tool(tmp_path):
+    """tools/tpu_sweep.py smoke: one grid point, incremental JSON."""
+    import json
+
+    from tools import tpu_sweep
+
+    out = tmp_path / "sweep.json"
+    rc = tpu_sweep.main([
+        "--out", str(out), "--cpu-mesh", "4", "--network", "LeNet",
+        "--num-workers", "8", "--batches", "4", "--dtypes", "float32",
+        "--steps", "2",
+    ])
+    rep = json.loads(out.read_text())
+    assert rc == 0
+    assert rep["points"][0]["step_ms"] > 0
+    assert rep["points"][0]["label"] == "b4_float32"
+
+
+def test_decode_study_tool(tmp_path):
+    """tools/decode_study.py smoke: one (n, s) scaling row with the
+    decode-vs-geomedian ratio."""
+    import json
+
+    from tools import decode_study
+
+    out = tmp_path / "study.json"
+    rc = decode_study.main([
+        "--out", str(out), "--cpu-mesh", "4", "--d", "4096",
+        "--ns", "8", "--ss", "1", "--reps", "2", "--skip-granularity",
+    ])
+    rep = json.loads(out.read_text())
+    assert rc == 0
+    row = rep["scaling"][0]
+    assert row["decode_ms"] > 0 and row["geomedian_ms_same_n"] > 0
+    assert row["decode_vs_geomedian"] > 0
+
+
+def test_convergence_grid_tool(tmp_path):
+    """tools/convergence_grid.py smoke: one row produces a multi-point
+    curve under the shared schedule."""
+    import json
+
+    from tools import convergence_grid
+
+    out = tmp_path / "grid.json"
+    rc = convergence_grid.main([
+        "--out", str(out), "--cpu-mesh", "4", "--network", "FC",
+        "--num-workers", "4", "--batch-size", "8", "--rows", "mean_clean",
+        "--eval-every", "5", "--max-steps", "15", "--target", "0.99",
+    ])
+    rep = json.loads(out.read_text())
+    assert rc == 0
+    curve = rep["rows"]["mean_clean"]["curve"]
+    assert len(curve) >= 2
+    assert [c["step"] for c in curve] == sorted(c["step"] for c in curve)

@@ -28,7 +28,9 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import time_to_acc  # noqa: E402  (sibling tool; shares the measurement loop)
+# sibling tool sharing the measurement loop; resolves in both contexts (the
+# sys.path.insert above puts the repo root first)
+from tools import time_to_acc  # noqa: E402
 
 ROWS = {
     # label -> extra argv for time_to_acc.main

@@ -68,7 +68,9 @@ def main(argv=None) -> int:
 
     import jax
 
-    from tpu_perf import phase_times
+    # resolves in both contexts: as tools.decode_study (tests) and as a
+    # script (the sys.path.insert above puts the repo root first either way)
+    from tools.tpu_perf import phase_times
 
     dev = jax.devices()[0]
     d = args.d
