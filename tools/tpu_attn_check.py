@@ -152,6 +152,7 @@ def main(argv=None) -> int:
         "device_kind": getattr(dev, "device_kind", dev.platform),
         "rows": [],
     }
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
     for t in [int(x) for x in args.seq_lens.split(",")]:
         print(f"[tpu_attn] T={t} ...", file=sys.stderr, flush=True)
         try:
@@ -161,10 +162,9 @@ def main(argv=None) -> int:
             rec = {"seq_len": t, "error": f"{type(e).__name__}: {e}"[:300]}
         print(f"[tpu_attn] {json.dumps(rec)}", file=sys.stderr, flush=True)
         report["rows"].append(rec)
-
-    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
-    with open(args.out, "w") as fh:
-        json.dump(report, fh, indent=1)
+        # rewrite after every row: a mid-run tunnel loss keeps finished rows
+        with open(args.out, "w") as fh:
+            json.dump(report, fh, indent=1)
     print(json.dumps(report))
     return 0
 
