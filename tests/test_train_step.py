@@ -214,3 +214,21 @@ def test_elastic_resume_across_topology_and_approach(tmp_path, ds):
     tr6.close()
     assert int(tr6.state.step) == 11  # resumed at 7, ran through 10
     assert np.isfinite(last["loss"])
+
+
+def test_same_seed_training_is_bitwise_deterministic(ds, mesh):
+    """SURVEY §5.2: SPMD removes the reference's MPI tag-race surface
+    entirely; what remains to guarantee is determinism — two Trainer runs
+    from the same seed must produce bitwise-identical parameters after
+    several coded steps (the property the repetition vote's bitwise
+    equality also rests on)."""
+    cfg = make_cfg(approach="cyclic", worker_fail=1, err_mode="rev_grad",
+                   batch_size=4, max_steps=4)
+    leaves = []
+    for _ in range(2):
+        tr = Trainer(cfg, mesh=mesh, dataset=ds, quiet=True)
+        tr.run()
+        leaves.append(jax.tree.leaves(jax.device_get(tr.state.params)))
+        tr.close()
+    for a, b in zip(*leaves, strict=True):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
