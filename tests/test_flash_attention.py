@@ -206,3 +206,15 @@ def test_interpret_fallback_warns_once(rng):
     with _w.catch_warnings():
         _w.simplefilter("error")  # second call with same shape: silent
         flash_attention(q, k, v, interpret=True)
+
+
+@pytest.mark.slow
+def test_chip_study_shape_parity_interpret(rng):
+    """Interpret-mode parity at the exact shape the hardware study runs
+    first (tools/chip_jobs_r3.sh: T=1024, dh=64) — catches shape-dependent
+    kernel logic bugs before the one-client tunnel is spent on them."""
+    q, k, v = _qkv(rng, b=1, t=1024, h=1, dh=64)
+    want = dense_attention(q, k, v, causal=True)
+    got = flash_attention(q, k, v, force=True, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
