@@ -113,8 +113,19 @@ def add_fit_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
 
 
 def maybe_force_cpu_mesh(args: argparse.Namespace) -> None:
-    """Apply --cpu-mesh N: an N-device virtual CPU mesh instead of accelerators.
-    Must run before any jax computation; safe to call twice."""
+    """Tool bootstrap: enable the persistent XLA compile cache, then apply
+    --cpu-mesh N (an N-device virtual CPU mesh instead of accelerators).
+    Must run before any jax computation; safe to call twice. Every tool and
+    bench.py routes through here so cache policy lives in one place.
+
+    The cache only engages for accelerator runs: tunnel-TPU compiles cost
+    minutes and are the reason the cache exists, while XLA:CPU AOT results
+    are feature-pinned to the compiling machine (reloading them warns about
+    possible SIGILL) and CPU compiles are cheap anyway."""
+    if not (getattr(args, "cpu_mesh", 0) or getattr(args, "cpu_interpret", False)):
+        from draco_tpu.runtime import enable_compile_cache
+
+        enable_compile_cache()
     if getattr(args, "cpu_mesh", 0):
         import os
 

@@ -43,6 +43,20 @@ def _ceil_to(x: int, m: int) -> int:
     return (x + m - 1) // m * m
 
 
+def _cols(stat, ncols):
+    """Widen a lane-broadcast (bq, _LANE) row statistic to ncols columns.
+
+    Mosaic requires the last dim of every block to be _LANE-aligned, so the
+    per-row softmax stats live broadcast across all 128 lanes (every lane of a
+    row holds the same value — the layout jax's own TPU flash kernel uses);
+    to combine a stat with a (bq, ncols) score block, slice when ncols fits
+    inside one lane tile, tile when it spans several.
+    """
+    if ncols <= _LANE:
+        return stat[:, :ncols]
+    return jnp.tile(stat, (1, ncols // _LANE))
+
+
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
@@ -54,40 +68,43 @@ def _fwd_kernel(scale, nk, bq, bk, q_ref, k_ref, v_ref, o_ref, lse_ref,
 
     @pl.when(j == 0)
     def _init():
-        acc_ref[...] = jnp.zeros_like(acc_ref)
-        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
-        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros(acc_ref.shape, jnp.float32)
+        m_ref[...] = jnp.full(m_ref.shape, NEG_INF, jnp.float32)
+        l_ref[...] = jnp.zeros(l_ref.shape, jnp.float32)
 
     # a (i, j) block pair holds >= 1 causal (q_pos >= k_pos) entry iff the
     # block's earliest key is no later than its latest query — comparing raw
     # block indices (j <= i) is only correct when bq == bk
     @pl.when(j * bk <= i * bq + bq - 1)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
+        # matmuls take the input dtype (bf16 inputs ride the fast MXU pass)
+        # and accumulate f32 via preferred_element_type — the flash standard;
+        # all softmax/accumulator algebra stays f32
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        ) * scale  # (bq, bk)
+        ) * scale  # (bq, bk) f32
         q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
         k_pos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
         s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        m_prev = m_ref[...]  # (bq, 1)
-        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        p = jnp.exp(s - m_cur)
-        corr = jnp.exp(m_prev - m_cur)  # (bq, 1)
-        l_ref[...] = l_ref[...] * corr + jnp.sum(p, axis=1, keepdims=True)
-        acc_ref[...] = acc_ref[...] * corr + jax.lax.dot(
-            p, v, preferred_element_type=jnp.float32
-        )
+        m_prev = m_ref[...]  # (bq, _LANE), lane-broadcast
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1)[:, None])
+        p = jnp.exp(s - _cols(m_cur, bk))
+        corr = jnp.exp(m_prev - m_cur)  # (bq, _LANE)
+        l_ref[...] = l_ref[...] * corr + jnp.sum(p, axis=1)[:, None]
+        acc_ref[...] = acc_ref[...] * _cols(corr, acc_ref.shape[1]) + \
+            jax.lax.dot(p.astype(v.dtype), v,
+                        preferred_element_type=jnp.float32)
         m_ref[...] = m_cur
 
     @pl.when(j == nk - 1)
     def _flush():
         l = jnp.maximum(l_ref[...], 1e-30)
-        o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
-        lse_ref[0] = (m_ref[...] + jnp.log(l))[:, 0]
+        o_ref[0] = (acc_ref[...] / _cols(l, o_ref.shape[2])).astype(o_ref.dtype)
+        lse_ref[0] = m_ref[...] + jnp.log(l)
 
 
 @functools.partial(jax.jit,
@@ -95,12 +112,14 @@ def _fwd_kernel(scale, nk, bq, bk, q_ref, k_ref, v_ref, o_ref, lse_ref,
 def _flash_fwd(q, k, v, scale, bq, bk, interpret):
     """q, k, v: (G, T, Dh_padded) f32 (G = B·H folded). ``scale`` comes from
     the TRUE head dim (the lane padding must not change the softmax
-    temperature). Returns (o, lse)."""
+    temperature). Returns (o, lse); lse is (G, T) — the kernel emits it
+    lane-broadcast (G, T, _LANE) to satisfy Mosaic block tiling and the
+    wrapper keeps lane 0."""
     g, t, dh = q.shape
     nq, nk = t // bq, t // bk
     grid = (g, nq, nk)
     kern = functools.partial(_fwd_kernel, scale, nk, bq, bk)
-    return pl.pallas_call(
+    o, lse = pl.pallas_call(
         kern,
         grid=grid,
         in_specs=[
@@ -110,22 +129,23 @@ def _flash_fwd(q, k, v, scale, bq, bk, interpret):
         ],
         out_specs=[
             pl.BlockSpec((1, bq, dh), lambda g, i, j: (g, i, 0)),
-            pl.BlockSpec((1, bq), lambda g, i, j: (g, i)),
+            pl.BlockSpec((1, bq, _LANE), lambda g, i, j: (g, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((g, t, dh), q.dtype),
-            jax.ShapeDtypeStruct((g, t), jnp.float32),
+            jax.ShapeDtypeStruct((g, t, _LANE), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, dh), jnp.float32),
-            pltpu.VMEM((bq, 1), jnp.float32),
-            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, _LANE), jnp.float32),
+            pltpu.VMEM((bq, _LANE), jnp.float32),
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
     )(q, k, v)
+    return o, lse[..., 0]
 
 
 # ---------------------------------------------------------------------------
@@ -133,9 +153,10 @@ def _flash_fwd(q, k, v, scale, bq, bk, interpret):
 # ---------------------------------------------------------------------------
 
 def _p_block(q_ref, k_ref, lse_ref, scale, i, j):
-    """Recompute the masked probability block P = exp(S - lse)."""
-    q = q_ref[0].astype(jnp.float32)
-    k = k_ref[0].astype(jnp.float32)
+    """Recompute the masked probability block P = exp(S - lse). lse_ref
+    holds the (bq, _LANE) lane-broadcast log-sum-exp."""
+    q = q_ref[0]
+    k = k_ref[0]
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     ) * scale
@@ -143,7 +164,7 @@ def _p_block(q_ref, k_ref, lse_ref, scale, i, j):
     q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
     k_pos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
     s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-    return jnp.exp(s - lse_ref[0][:, None])
+    return jnp.exp(s - _cols(lse_ref[0], bk))
 
 
 def _dq_kernel(scale, nk, bq, bk, q_ref, k_ref, v_ref, do_ref, lse_ref,
@@ -157,15 +178,15 @@ def _dq_kernel(scale, nk, bq, bk, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
     @pl.when(j * bk <= i * bq + bq - 1)
     def _compute():
-        p = _p_block(q_ref, k_ref, lse_ref, scale, i, j)  # (bq, bk)
-        do = do_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
+        p = _p_block(q_ref, k_ref, lse_ref, scale, i, j)  # (bq, bk) f32
+        do = do_ref[0]
+        v = v_ref[0]
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # (bq, bk)
-        ds = p * (dp - dcap_ref[0][:, None])
+        )  # (bq, bk) f32
+        ds = p * (dp - _cols(dcap_ref[0], dp.shape[1]))
         dq_acc[...] += jax.lax.dot(
-            ds, k_ref[0].astype(jnp.float32),
+            ds.astype(k_ref.dtype), k_ref[0],
             preferred_element_type=jnp.float32,
         ) * scale
 
@@ -186,18 +207,19 @@ def _dkv_kernel(scale, nq, bq, bk, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
     @pl.when(i * bq + bq - 1 >= j * bk)
     def _compute():
-        p = _p_block(q_ref, k_ref, lse_ref, scale, i, j)  # (bq, bk)
-        do = do_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
+        p = _p_block(q_ref, k_ref, lse_ref, scale, i, j)  # (bq, bk) f32
+        do = do_ref[0]
+        v = v_ref[0]
         dv_acc[...] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32
         )  # pᵀ · do -> (bk, dh)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        ds = p * (dp - dcap_ref[0][:, None])
+        ds = p * (dp - _cols(dcap_ref[0], dp.shape[1]))
         dk_acc[...] += jax.lax.dot_general(
-            ds, q_ref[0].astype(jnp.float32),
+            ds.astype(q_ref.dtype), q_ref[0],
             (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32,
         ) * scale  # dsᵀ · q -> (bk, dh)
 
@@ -213,6 +235,9 @@ def _flash_bwd(q, k, v, o, lse, do, scale, bq, bk, interpret):
     g, t, dh = q.shape
     nq, nk = t // bq, t // bk
     dcap = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    # lane-broadcast the per-row stats so their blocks tile (bq, _LANE)
+    lse = jnp.broadcast_to(lse[..., None], (g, t, _LANE))
+    dcap = jnp.broadcast_to(dcap[..., None], (g, t, _LANE))
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale, nk, bq, bk),
@@ -222,8 +247,8 @@ def _flash_bwd(q, k, v, o, lse, do, scale, bq, bk, interpret):
             pl.BlockSpec((1, bk, dh), lambda g, i, j: (g, j, 0)),
             pl.BlockSpec((1, bk, dh), lambda g, i, j: (g, j, 0)),
             pl.BlockSpec((1, bq, dh), lambda g, i, j: (g, i, 0)),
-            pl.BlockSpec((1, bq), lambda g, i, j: (g, i)),
-            pl.BlockSpec((1, bq), lambda g, i, j: (g, i)),
+            pl.BlockSpec((1, bq, _LANE), lambda g, i, j: (g, i, 0)),
+            pl.BlockSpec((1, bq, _LANE), lambda g, i, j: (g, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, bq, dh), lambda g, i, j: (g, i, 0)),
         out_shape=jax.ShapeDtypeStruct((g, t, dh), q.dtype),
@@ -242,8 +267,8 @@ def _flash_bwd(q, k, v, o, lse, do, scale, bq, bk, interpret):
             pl.BlockSpec((1, bk, dh), lambda g, j, i: (g, j, 0)),
             pl.BlockSpec((1, bk, dh), lambda g, j, i: (g, j, 0)),
             pl.BlockSpec((1, bq, dh), lambda g, j, i: (g, i, 0)),
-            pl.BlockSpec((1, bq), lambda g, j, i: (g, i)),
-            pl.BlockSpec((1, bq), lambda g, j, i: (g, i)),
+            pl.BlockSpec((1, bq, _LANE), lambda g, j, i: (g, i, 0)),
+            pl.BlockSpec((1, bq, _LANE), lambda g, j, i: (g, i, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, bk, dh), lambda g, j, i: (g, j, 0)),
@@ -310,17 +335,23 @@ def flash_attention(q, k, v, *, block_q: int = 128, block_k: int = 128,
     use = force if force is not None else (use_pallas() or interpret)
     # blocks (including T itself when it becomes the single block) must
     # honour the 8-sublane f32 tile
+    # key blocks wider than a lane tile must be whole lane tiles so the
+    # lane-broadcast row stats can be tiled across them (_cols)
+    bad_lane = bk > _LANE and bk % _LANE
     if (not use or t % 8 or bq % 8 or bk % 8 or t % bq or t % bk
-            or dh > _LANE):
+            or dh > _LANE or bad_lane):
         tiling_fail = bool(t % 8 or bq % 8 or bk % 8 or t % bq or t % bk
-                           or dh > _LANE)
+                           or dh > _LANE or bad_lane)
+        constraints = (
+            f"need t%8==0, t%bq==0, t%bk==0, blocks%8==0, dh<={_LANE}, "
+            f"and bk a multiple of {_LANE} when bk>{_LANE}"
+        )
         if force and tiling_fail:
             # a caller that explicitly demanded the O(T·Dh)-memory kernel
             # must not silently get the O(T²) dense path (advisor r2)
             raise ValueError(
                 f"flash_attention(force=True): shape does not tile "
-                f"(t={t}, bq={bq}, bk={bk}, dh={dh}; need t%8==0, "
-                f"t%bq==0, t%bk==0, blocks%8==0, dh<={_LANE})"
+                f"(t={t}, bq={bq}, bk={bk}, dh={dh}; {constraints})"
             )
         if use and tiling_fail:
             key = (t, bq, bk, dh)
@@ -329,8 +360,7 @@ def flash_attention(q, k, v, *, block_q: int = 128, block_k: int = 128,
                 warnings.warn(
                     f"flash_attention: falling back to dense O(T²) attention "
                     f"for non-tiling shape (t={t}, bq={bq}, bk={bk}, "
-                    f"dh={dh}); pad T to a multiple of the block size to "
-                    f"use the blockwise kernel",
+                    f"dh={dh}; {constraints})",
                     stacklevel=2,
                 )
         return dense_attention(q, k, v, causal=True)

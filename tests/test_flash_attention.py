@@ -218,3 +218,35 @@ def test_chip_study_shape_parity_interpret(rng):
     got = flash_attention(q, k, v, force=True, interpret=True)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_bf16_inputs_match_dense_f32(rng):
+    """bf16 q/k/v ride the MXU fast pass (matmuls in input dtype, f32
+    accumulate); values must still track the f32 dense oracle to bf16
+    precision, fwd and grads."""
+    q, k, v = _qkv(rng, t=256, dh=64)
+    qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+    want = dense_attention(q, k, v, causal=True)
+    got = flash_attention(qb, kb, vb, force=True, interpret=True)
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(got, np.float32), np.asarray(want),
+                               rtol=0.05, atol=0.05)
+
+    def loss(attn, *xs):
+        return jnp.sum(jnp.sin(attn(*xs).astype(jnp.float32)))
+
+    g_f = jax.grad(
+        lambda q, k, v: loss(
+            lambda *a: flash_attention(*a, force=True, interpret=True),
+            q, k, v),
+        argnums=(0, 1, 2))(qb, kb, vb)
+    g_d = jax.grad(
+        lambda q, k, v: loss(
+            lambda *a: dense_attention(*a, causal=True), q, k, v),
+        argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", g_f, g_d):
+        a = np.asarray(a, np.float32)
+        b = np.asarray(b)
+        scale = max(np.abs(b).max(), 1e-8)
+        np.testing.assert_allclose(a / scale, b / scale, atol=0.06,
+                                   err_msg=f"d{name} mismatch")

@@ -47,14 +47,9 @@ def main(argv=None) -> int:
                     help="steps folded into each timed scan (scan protocol)")
     args = ap.parse_args(argv)
 
-    if args.cpu_mesh:
-        os.environ["XLA_FLAGS"] = (
-            os.environ.get("XLA_FLAGS", "")
-            + f" --xla_force_host_platform_device_count={args.cpu_mesh}"
-        ).strip()
-        import jax
+    from draco_tpu.cli import maybe_force_cpu_mesh
 
-        jax.config.update("jax_platforms", "cpu")
+    maybe_force_cpu_mesh(args)  # shared bootstrap: compile cache (+ cpu mesh)
 
     from draco_tpu.data.datasets import load_dataset
     from draco_tpu.presets import PRESETS, get_preset

@@ -139,6 +139,10 @@ def main(argv=None) -> int:
                     help="smoke: run tiny shapes in interpret mode on CPU")
     args = ap.parse_args(argv)
 
+    from draco_tpu.cli import maybe_force_cpu_mesh
+
+    maybe_force_cpu_mesh(args)  # shared bootstrap: compile cache (+ cpu mesh)
+
     if args.cpu_interpret:
         import jax
 

@@ -188,15 +188,11 @@ def main(argv=None) -> int:
     ap.add_argument("--large-d", type=int, default=11173962)  # ResNet-18
     args = ap.parse_args(argv)
 
-    interpret = bool(args.cpu_mesh)
-    if args.cpu_mesh:
-        os.environ["XLA_FLAGS"] = (
-            os.environ.get("XLA_FLAGS", "")
-            + f" --xla_force_host_platform_device_count={args.cpu_mesh}"
-        ).strip()
-        import jax
+    from draco_tpu.cli import maybe_force_cpu_mesh
 
-        jax.config.update("jax_platforms", "cpu")
+    maybe_force_cpu_mesh(args)  # shared bootstrap: compile cache (+ cpu mesh)
+
+    interpret = bool(args.cpu_mesh)
 
     import jax
 
