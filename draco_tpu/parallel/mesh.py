@@ -37,6 +37,20 @@ def make_mesh_2d(
     return Mesh(grid, (WORKER_AXIS, SEQ_AXIS))
 
 
+def make_folded_wtp_mesh(num_workers: int) -> Mesh:
+    """(w, tp=1) mesh with the logical workers FOLDED onto the available
+    devices (runtime.make_mesh discipline: equal lane blocks per device, warns
+    when devices idle). The trivial tp axis makes the GSPMD LM builder
+    (tp_step.build_tp_train_setup) applicable on any device count — the
+    single-chip n-lane vmapped regime the perf/convergence tools run in.
+    Distinct from make_mesh_wtp, which demands num_workers × shards physical
+    devices for real tensor sharding."""
+    from draco_tpu.runtime import make_mesh
+
+    fold = make_mesh(num_workers).devices.ravel()
+    return Mesh(np.asarray(fold).reshape(len(fold), 1), (WORKER_AXIS, TP_AXIS))
+
+
 def _make_mesh_w2(axis2: str, num_workers: int, shards: int,
                   devices: Optional[Sequence[jax.Device]]) -> Mesh:
     """(num_workers, shards) mesh with axes (w, axis2); the model-parallel

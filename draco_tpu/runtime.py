@@ -27,6 +27,28 @@ _CACHE_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)),
                           ".jax_cache")
 
 
+def _machine_tag() -> str:
+    """Short fingerprint of the host microarchitecture. XLA:CPU AOT results
+    are feature-pinned to the compiling machine (reloading foreign ones can
+    SIGILL per XLA's own warning); scoping the cache dir by this tag makes a
+    shared/NFS checkout safe across heterogeneous hosts. Accelerator
+    binaries don't need it but lose nothing from the extra path level."""
+    import hashlib
+    import platform as _platform
+
+    feats = ""
+    try:
+        with open("/proc/cpuinfo") as fh:
+            for line in fh:
+                if line.startswith("flags"):
+                    feats = " ".join(sorted(line.split(":", 1)[1].split()))
+                    break
+    except OSError:
+        pass
+    h = hashlib.sha256(feats.encode()).hexdigest()[:8] if feats else "nofeat"
+    return f"{_platform.machine()}-{h}"
+
+
 def enable_compile_cache(path: Optional[str] = None) -> str:
     """Point XLA's persistent compilation cache at a repo-local directory.
 
@@ -37,12 +59,15 @@ def enable_compile_cache(path: Optional[str] = None) -> str:
     the recompile is seconds, so every leg fits any driver window. Safe to
     call repeatedly; a cold cache just means one slow first run.
     """
-    cache = path or os.environ.get("JAX_COMPILATION_CACHE_DIR") or _CACHE_DIR
+    import sys
+
+    base = path or os.environ.get("JAX_COMPILATION_CACHE_DIR") or _CACHE_DIR
+    cache = os.path.join(base, _machine_tag())
     try:
         os.makedirs(cache, exist_ok=True)
     except OSError as e:  # read-only install prefix: run uncached, don't die
         print(f"enable_compile_cache: {cache} unwritable ({e}); compiling "
-              f"uncached", flush=True)
+              f"uncached", file=sys.stderr, flush=True)
         return ""
     jax.config.update("jax_compilation_cache_dir", cache)
     # the default 1 s floor would skip mid-size kernels; cache everything

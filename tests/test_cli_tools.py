@@ -347,3 +347,31 @@ def test_convergence_grid_tool(tmp_path):
     curve = rep["rows"]["mean_clean"]["curve"]
     assert len(curve) >= 2
     assert [c["step"] for c in curve] == sorted(c["step"] for c in curve)
+
+
+def test_lm_time_to_loss_tool(tmp_path):
+    """tools/lm_time_to_loss.py: the LM-scale convergence-under-attack
+    oracle — cyclic decode learns past the undefended mean under one
+    rev_grad adversary, and the wall-clock curve is monotone."""
+    import json
+
+    from tools import lm_time_to_loss
+
+    out = tmp_path / "lm_tta.json"
+    lm_time_to_loss.main([
+        "--out", str(out), "--cpu-mesh", "4", "--num-workers", "8",
+        "--batch-size", "1", "--seq-len", "32", "--model-dim", "32",
+        "--model-heads", "2", "--model-layers", "1", "--vocab", "32",
+        "--max-steps", "20", "--eval-every", "10", "--target", "0.2",
+        "--eval-batches", "2",
+        "--variants", "lm_cyclic_s1_shared,lm_mean_under_attack",
+    ])
+    rep = json.loads(out.read_text())
+    cyc = rep["variants"]["lm_cyclic_s1_shared"]
+    mean = rep["variants"]["lm_mean_under_attack"]
+    assert "error" not in cyc and "error" not in mean
+    # cyclic improves on its own start; the poisoned mean ends up worse
+    assert cyc["curve"][-1]["eval_loss"] < cyc["curve"][0]["eval_loss"]
+    assert cyc["final_eval_loss"] < mean["final_eval_loss"]
+    walls = [c["train_wall_s"] for c in cyc["curve"]]
+    assert walls == sorted(walls)

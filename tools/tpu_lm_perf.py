@@ -109,20 +109,14 @@ def main(argv=None) -> int:
     maybe_force_cpu_mesh(args)
 
     import jax
-    import numpy as np
-    from jax.sharding import Mesh
 
     import bench
     from draco_tpu.config import TrainConfig
-    from draco_tpu.runtime import WORKER_AXIS, make_mesh
-    from draco_tpu.parallel.mesh import TP_AXIS
+    from draco_tpu.parallel.mesh import make_folded_wtp_mesh
 
-    # make_mesh owns the logical-workers→devices fold (and warns loudly when
-    # devices idle); add a trivial tp=1 axis so the GSPMD LM builder applies
-    fold = make_mesh(args.num_workers).devices.ravel()
-    mesh = Mesh(np.asarray(fold).reshape(len(fold), 1), (WORKER_AXIS, TP_AXIS))
+    mesh = make_folded_wtp_mesh(args.num_workers)
     dev = jax.devices()[0]
-    n_dev = len(fold)
+    n_dev = mesh.devices.size
 
     common = dict(
         network="TransformerLM", dataset="synthetic-text",
