@@ -118,10 +118,14 @@ def maybe_force_cpu_mesh(args: argparse.Namespace) -> None:
     Must run before any jax computation; safe to call twice. Every tool and
     bench.py routes through here so cache policy lives in one place.
 
-    The cache only engages for accelerator runs: tunnel-TPU compiles cost
-    minutes and are the reason the cache exists, while XLA:CPU AOT results
-    are feature-pinned to the compiling machine (reloading them warns about
-    possible SIGILL) and CPU compiles are cheap anyway."""
+    The cache is skipped when an explicit CPU mode is requested
+    (--cpu-mesh / --cpu-interpret: CI smokes, where cache churn is waste).
+    It is NOT gated on the resolved backend — probing that here would
+    initialize jax in-process, the exact ~25-minute wedge bench.py's
+    subprocess probes exist to avoid — so a flagless run that lands on CPU
+    does cache XLA:CPU results; that is safe because enable_compile_cache
+    scopes entries by a host-microarch fingerprint (foreign feature-pinned
+    CPU AOT reloads are the SIGILL hazard)."""
     if not (getattr(args, "cpu_mesh", 0) or getattr(args, "cpu_interpret", False)):
         from draco_tpu.runtime import enable_compile_cache
 

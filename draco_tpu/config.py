@@ -295,14 +295,10 @@ class TrainConfig:
                 raise ValueError(
                     f"attn_impl must be dense|flash, got {self.attn_impl}"
                 )
-            if (self.attn_impl == "flash" and self.seq_shards > 1
-                    and self.sp_attn != "a2a"):
-                raise ValueError(
-                    "attn_impl=flash under sequence parallelism requires "
-                    "sp_attn=a2a (the flash kernel runs on each device's "
-                    "full-sequence head group after the scatter); ring "
-                    "attention is already blockwise and takes no inner kernel"
-                )
+            # attn_impl=flash composes with BOTH sp modes: a2a runs the
+            # kernel on each device's full-sequence head group after the
+            # scatter; ring runs it per visiting K/V block with an lse merge
+            # (parallel/ring_attention.ring_flash_attention)
             if self.attn_impl == "flash" and (
                 self.tensor_shards > 1 or self.expert_shards > 1
                 or self.moe_experts > 0

@@ -61,8 +61,8 @@ def _cols(stat, ncols):
 # forward
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(scale, nk, bq, bk, q_ref, k_ref, v_ref, o_ref, lse_ref,
-                acc_ref, m_ref, l_ref):
+def _fwd_kernel(scale, nk, bq, bk, causal, q_ref, k_ref, v_ref, o_ref,
+                lse_ref, acc_ref, m_ref, l_ref):
     i = pl.program_id(1)
     j = pl.program_id(2)
 
@@ -74,8 +74,9 @@ def _fwd_kernel(scale, nk, bq, bk, q_ref, k_ref, v_ref, o_ref, lse_ref,
 
     # a (i, j) block pair holds >= 1 causal (q_pos >= k_pos) entry iff the
     # block's earliest key is no later than its latest query — comparing raw
-    # block indices (j <= i) is only correct when bq == bk
-    @pl.when(j * bk <= i * bq + bq - 1)
+    # block indices (j <= i) is only correct when bq == bk. Non-causal
+    # (the ring's fully-visible past-owner hops) computes every pair.
+    @pl.when((j * bk <= i * bq + bq - 1) if causal else (j >= 0))
     def _compute():
         # matmuls take the input dtype (bf16 inputs ride the fast MXU pass)
         # and accumulate f32 via preferred_element_type — the flash standard;
@@ -87,9 +88,10 @@ def _fwd_kernel(scale, nk, bq, bk, q_ref, k_ref, v_ref, o_ref, lse_ref,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale  # (bq, bk) f32
-        q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-        k_pos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        if causal:
+            q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            k_pos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
         m_prev = m_ref[...]  # (bq, _LANE), lane-broadcast
         m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1)[:, None])
         p = jnp.exp(s - _cols(m_cur, bk))
@@ -108,8 +110,9 @@ def _fwd_kernel(scale, nk, bq, bk, q_ref, k_ref, v_ref, o_ref, lse_ref,
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("scale", "bq", "bk", "interpret"))
-def _flash_fwd(q, k, v, scale, bq, bk, interpret):
+                   static_argnames=("scale", "bq", "bk", "causal",
+                                    "interpret"))
+def _flash_fwd(q, k, v, scale, bq, bk, causal, interpret):
     """q, k, v: (G, T, Dh_padded) f32 (G = B·H folded). ``scale`` comes from
     the TRUE head dim (the lane padding must not change the softmax
     temperature). Returns (o, lse); lse is (G, T) — the kernel emits it
@@ -118,7 +121,7 @@ def _flash_fwd(q, k, v, scale, bq, bk, interpret):
     g, t, dh = q.shape
     nq, nk = t // bq, t // bk
     grid = (g, nq, nk)
-    kern = functools.partial(_fwd_kernel, scale, nk, bq, bk)
+    kern = functools.partial(_fwd_kernel, scale, nk, bq, bk, causal)
     o, lse = pl.pallas_call(
         kern,
         grid=grid,
@@ -152,7 +155,7 @@ def _flash_fwd(q, k, v, scale, bq, bk, interpret):
 # backward
 # ---------------------------------------------------------------------------
 
-def _p_block(q_ref, k_ref, lse_ref, scale, i, j):
+def _p_block(q_ref, k_ref, lse_ref, scale, causal, i, j):
     """Recompute the masked probability block P = exp(S - lse). lse_ref
     holds the (bq, _LANE) lane-broadcast log-sum-exp."""
     q = q_ref[0]
@@ -161,14 +164,21 @@ def _p_block(q_ref, k_ref, lse_ref, scale, i, j):
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     ) * scale
     bq, bk = s.shape
-    q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-    k_pos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-    s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    if causal:
+        q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        k_pos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
     return jnp.exp(s - _cols(lse_ref[0], bk))
 
 
-def _dq_kernel(scale, nk, bq, bk, q_ref, k_ref, v_ref, do_ref, lse_ref,
-               dcap_ref, dq_ref, dq_acc):
+def _dq_kernel(scale, nk, bq, bk, causal, has_dlse, *refs):
+    if has_dlse:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, dcap_ref, dlse_ref,
+         dq_ref, dq_acc) = refs
+    else:  # hot path (lse output unused): no dlse stream, no dead add
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, dcap_ref,
+         dq_ref, dq_acc) = refs
+        dlse_ref = None
     i = pl.program_id(1)
     j = pl.program_id(2)
 
@@ -176,15 +186,19 @@ def _dq_kernel(scale, nk, bq, bk, q_ref, k_ref, v_ref, do_ref, lse_ref,
     def _init():
         dq_acc[...] = jnp.zeros_like(dq_acc)
 
-    @pl.when(j * bk <= i * bq + bq - 1)
+    @pl.when((j * bk <= i * bq + bq - 1) if causal else (j >= 0))
     def _compute():
-        p = _p_block(q_ref, k_ref, lse_ref, scale, i, j)  # (bq, bk) f32
+        p = _p_block(q_ref, k_ref, lse_ref, scale, causal, i, j)  # (bq,bk) f32
         do = do_ref[0]
         v = v_ref[0]
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # (bq, bk) f32
-        ds = p * (dp - _cols(dcap_ref[0], dp.shape[1]))
+        # d lse_i / d s_ij = p_ij, so an lse cotangent adds p * dlse_i
+        dsum = dp - _cols(dcap_ref[0], dp.shape[1])
+        if dlse_ref is not None:
+            dsum = dsum + _cols(dlse_ref[0], dp.shape[1])
+        ds = p * dsum
         dq_acc[...] += jax.lax.dot(
             ds.astype(k_ref.dtype), k_ref[0],
             preferred_element_type=jnp.float32,
@@ -195,8 +209,14 @@ def _dq_kernel(scale, nk, bq, bk, q_ref, k_ref, v_ref, do_ref, lse_ref,
         dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(scale, nq, bq, bk, q_ref, k_ref, v_ref, do_ref, lse_ref,
-                dcap_ref, dk_ref, dv_ref, dk_acc, dv_acc):
+def _dkv_kernel(scale, nq, bq, bk, causal, has_dlse, *refs):
+    if has_dlse:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, dcap_ref, dlse_ref,
+         dk_ref, dv_ref, dk_acc, dv_acc) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, dcap_ref,
+         dk_ref, dv_ref, dk_acc, dv_acc) = refs
+        dlse_ref = None
     j = pl.program_id(1)
     i = pl.program_id(2)
 
@@ -205,9 +225,9 @@ def _dkv_kernel(scale, nq, bq, bk, q_ref, k_ref, v_ref, do_ref, lse_ref,
         dk_acc[...] = jnp.zeros_like(dk_acc)
         dv_acc[...] = jnp.zeros_like(dv_acc)
 
-    @pl.when(i * bq + bq - 1 >= j * bk)
+    @pl.when((i * bq + bq - 1 >= j * bk) if causal else (i >= 0))
     def _compute():
-        p = _p_block(q_ref, k_ref, lse_ref, scale, i, j)  # (bq, bk) f32
+        p = _p_block(q_ref, k_ref, lse_ref, scale, causal, i, j)  # (bq,bk)
         do = do_ref[0]
         v = v_ref[0]
         dv_acc[...] += jax.lax.dot_general(
@@ -217,7 +237,10 @@ def _dkv_kernel(scale, nq, bq, bk, q_ref, k_ref, v_ref, do_ref, lse_ref,
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        ds = p * (dp - _cols(dcap_ref[0], dp.shape[1]))
+        dsum = dp - _cols(dcap_ref[0], dp.shape[1])
+        if dlse_ref is not None:
+            dsum = dsum + _cols(dlse_ref[0], dp.shape[1])
+        ds = p * dsum
         dk_acc[...] += jax.lax.dot_general(
             ds.astype(q_ref.dtype), q_ref[0],
             (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32,
@@ -230,49 +253,69 @@ def _dkv_kernel(scale, nq, bq, bk, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("scale", "bq", "bk", "interpret"))
-def _flash_bwd(q, k, v, o, lse, do, scale, bq, bk, interpret):
+                   static_argnames=("scale", "bq", "bk", "causal",
+                                    "interpret"))
+def _flash_bwd(q, k, v, o, lse, do, dlse, scale, bq, bk, causal, interpret):
+    """dlse=None is the hot path (lse output unused): the kernels take one
+    fewer input stream and skip the dead add."""
     g, t, dh = q.shape
     nq, nk = t // bq, t // bk
     dcap = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
     # lane-broadcast the per-row stats so their blocks tile (bq, _LANE)
     lse = jnp.broadcast_to(lse[..., None], (g, t, _LANE))
     dcap = jnp.broadcast_to(dcap[..., None], (g, t, _LANE))
+    has_dlse = dlse is not None
+    stats = [lse, dcap]
+    if has_dlse:
+        stats.append(jnp.broadcast_to(dlse.astype(jnp.float32)[..., None],
+                                      (g, t, _LANE)))
 
+    def q_row(g, i, j):
+        return (g, i, 0)
+
+    def k_row(g, i, j):
+        return (g, j, 0)
+
+    stat_specs = [pl.BlockSpec((1, bq, _LANE), q_row)] * len(stats)
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, scale, nk, bq, bk),
+        functools.partial(_dq_kernel, scale, nk, bq, bk, causal, has_dlse),
         grid=(g, nq, nk),
         in_specs=[
-            pl.BlockSpec((1, bq, dh), lambda g, i, j: (g, i, 0)),
-            pl.BlockSpec((1, bk, dh), lambda g, i, j: (g, j, 0)),
-            pl.BlockSpec((1, bk, dh), lambda g, i, j: (g, j, 0)),
-            pl.BlockSpec((1, bq, dh), lambda g, i, j: (g, i, 0)),
-            pl.BlockSpec((1, bq, _LANE), lambda g, i, j: (g, i, 0)),
-            pl.BlockSpec((1, bq, _LANE), lambda g, i, j: (g, i, 0)),
+            pl.BlockSpec((1, bq, dh), q_row),
+            pl.BlockSpec((1, bk, dh), k_row),
+            pl.BlockSpec((1, bk, dh), k_row),
+            pl.BlockSpec((1, bq, dh), q_row),
+            *stat_specs,
         ],
-        out_specs=pl.BlockSpec((1, bq, dh), lambda g, i, j: (g, i, 0)),
+        out_specs=pl.BlockSpec((1, bq, dh), q_row),
         out_shape=jax.ShapeDtypeStruct((g, t, dh), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, dh), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(q, k, v, do, lse, dcap)
+    )(q, k, v, do, *stats)
 
+    def q_row2(g, j, i):
+        return (g, i, 0)
+
+    def k_row2(g, j, i):
+        return (g, j, 0)
+
+    stat_specs2 = [pl.BlockSpec((1, bq, _LANE), q_row2)] * len(stats)
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, scale, nq, bq, bk),
+        functools.partial(_dkv_kernel, scale, nq, bq, bk, causal, has_dlse),
         grid=(g, nk, nq),
         in_specs=[
-            pl.BlockSpec((1, bq, dh), lambda g, j, i: (g, i, 0)),
-            pl.BlockSpec((1, bk, dh), lambda g, j, i: (g, j, 0)),
-            pl.BlockSpec((1, bk, dh), lambda g, j, i: (g, j, 0)),
-            pl.BlockSpec((1, bq, dh), lambda g, j, i: (g, i, 0)),
-            pl.BlockSpec((1, bq, _LANE), lambda g, j, i: (g, i, 0)),
-            pl.BlockSpec((1, bq, _LANE), lambda g, j, i: (g, i, 0)),
+            pl.BlockSpec((1, bq, dh), q_row2),
+            pl.BlockSpec((1, bk, dh), k_row2),
+            pl.BlockSpec((1, bk, dh), k_row2),
+            pl.BlockSpec((1, bq, dh), q_row2),
+            *stat_specs2,
         ],
         out_specs=[
-            pl.BlockSpec((1, bk, dh), lambda g, j, i: (g, j, 0)),
-            pl.BlockSpec((1, bk, dh), lambda g, j, i: (g, j, 0)),
+            pl.BlockSpec((1, bk, dh), k_row2),
+            pl.BlockSpec((1, bk, dh), k_row2),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((g, t, dh), k.dtype),
@@ -286,31 +329,55 @@ def _flash_bwd(q, k, v, o, lse, do, scale, bq, bk, interpret):
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(q, k, v, do, lse, dcap)
+    )(q, k, v, do, *stats)
     return dq, dk, dv
 
 
 # ---------------------------------------------------------------------------
-# custom-vjp core on (G, T, Dh)
+# custom-vjp cores on (G, T, Dh). Two variants sharing fwd/bwd kernels:
+# _flash_core returns o only (the hot path — its backward has no dlse
+# stream); _flash_core_lse returns (o, lse) with lse differentiable
+# (d lse/d s = softmax), which is what lets the ring composition weight
+# and merge per-hop outputs under grad.
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_core(q, k, v, scale, bq, bk, interpret):
-    o, _ = _flash_fwd(q, k, v, scale, bq, bk, interpret)
-    return o
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_core(q, k, v, scale, bq, bk, causal, interpret):
+    return _flash_fwd(q, k, v, scale, bq, bk, causal, interpret)[0]
 
 
-def _flash_core_fwd(q, k, v, scale, bq, bk, interpret):
-    o, lse = _flash_fwd(q, k, v, scale, bq, bk, interpret)
+def _flash_core_fwd(q, k, v, scale, bq, bk, causal, interpret):
+    o, lse = _flash_fwd(q, k, v, scale, bq, bk, causal, interpret)
     return o, (q, k, v, o, lse)
 
 
-def _flash_core_bwd(scale, bq, bk, interpret, res, do):
+def _flash_core_bwd(scale, bq, bk, causal, interpret, res, do):
     q, k, v, o, lse = res
-    return _flash_bwd(q, k, v, o, lse, do, scale, bq, bk, interpret)
+    return _flash_bwd(q, k, v, o, lse, do, None, scale, bq, bk, causal,
+                      interpret)
 
 
 _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_core_lse(q, k, v, scale, bq, bk, causal, interpret):
+    return _flash_fwd(q, k, v, scale, bq, bk, causal, interpret)
+
+
+def _flash_core_lse_fwd(q, k, v, scale, bq, bk, causal, interpret):
+    o, lse = _flash_fwd(q, k, v, scale, bq, bk, causal, interpret)
+    return (o, lse), (q, k, v, o, lse)
+
+
+def _flash_core_lse_bwd(scale, bq, bk, causal, interpret, res, cts):
+    q, k, v, o, lse = res
+    do, dlse = cts
+    return _flash_bwd(q, k, v, o, lse, do, dlse, scale, bq, bk, causal,
+                      interpret)
+
+
+_flash_core_lse.defvjp(_flash_core_lse_fwd, _flash_core_lse_bwd)
 
 
 # ---------------------------------------------------------------------------
@@ -332,39 +399,50 @@ def flash_attention(q, k, v, *, block_q: int = 128, block_k: int = 128,
     b, t, h, dh = q.shape
     bq = min(block_q, t)
     bk = min(block_k, t)
-    use = force if force is not None else (use_pallas() or interpret)
-    # blocks (including T itself when it becomes the single block) must
-    # honour the 8-sublane f32 tile
-    # key blocks wider than a lane tile must be whole lane tiles so the
-    # lane-broadcast row stats can be tiled across them (_cols)
-    bad_lane = bk > _LANE and bk % _LANE
-    if (not use or t % 8 or bq % 8 or bk % 8 or t % bq or t % bk
-            or dh > _LANE or bad_lane):
-        tiling_fail = bool(t % 8 or bq % 8 or bk % 8 or t % bq or t % bk
-                           or dh > _LANE or bad_lane)
-        constraints = (
-            f"need t%8==0, t%bq==0, t%bk==0, blocks%8==0, dh<={_LANE}, "
-            f"and bk a multiple of {_LANE} when bk>{_LANE}"
-        )
-        if force and tiling_fail:
-            # a caller that explicitly demanded the O(T·Dh)-memory kernel
-            # must not silently get the O(T²) dense path (advisor r2)
-            raise ValueError(
-                f"flash_attention(force=True): shape does not tile "
-                f"(t={t}, bq={bq}, bk={bk}, dh={dh}; {constraints})"
-            )
-        if use and tiling_fail:
-            key = (t, bq, bk, dh)
-            if key not in _FALLBACK_WARNED:
-                _FALLBACK_WARNED.add(key)
-                warnings.warn(
-                    f"flash_attention: falling back to dense O(T²) attention "
-                    f"for non-tiling shape (t={t}, bq={bq}, bk={bk}, "
-                    f"dh={dh}; {constraints})",
-                    stacklevel=2,
-                )
+    if not _kernel_eligible(t, bq, bk, dh, force, interpret):
         return dense_attention(q, k, v, causal=True)
+    return _run_folded(q, k, v, bq, bk, True, interpret, want_lse=False)
 
+
+def _kernel_eligible(t, bq, bk, dh, force, interpret) -> bool:
+    """Shared kernel-vs-dense dispatch for both public wrappers. Blocks
+    (including T itself when it becomes the single block) must honour the
+    8-sublane f32 tile, and key blocks wider than a lane tile must be whole
+    lane tiles so the lane-broadcast row stats can tile across them (_cols).
+    force=True on a non-tiling shape raises — a caller that explicitly
+    demanded the O(T·Dh)-memory kernel must not silently get the O(T²)
+    dense path (advisor r2); a TPU caller falling back warns once."""
+    use = force if force is not None else (use_pallas() or interpret)
+    tiling_fail = bool(t % 8 or bq % 8 or bk % 8 or t % bq or t % bk
+                       or dh > _LANE or (bk > _LANE and bk % _LANE))
+    if use and not tiling_fail:
+        return True
+    constraints = (
+        f"need t%8==0, t%bq==0, t%bk==0, blocks%8==0, dh<={_LANE}, "
+        f"and bk a multiple of {_LANE} when bk>{_LANE}"
+    )
+    if force and tiling_fail:
+        raise ValueError(
+            f"flash_attention(force=True): shape does not tile "
+            f"(t={t}, bq={bq}, bk={bk}, dh={dh}; {constraints})"
+        )
+    if use and tiling_fail:
+        key = (t, bq, bk, dh)
+        if key not in _FALLBACK_WARNED:
+            _FALLBACK_WARNED.add(key)
+            warnings.warn(
+                f"flash_attention: falling back to dense O(T²) attention "
+                f"for non-tiling shape (t={t}, bq={bq}, bk={bk}, "
+                f"dh={dh}; {constraints})",
+                stacklevel=2,
+            )
+    return False
+
+
+def _run_folded(q, k, v, bq, bk, causal, interpret, want_lse):
+    """(B,T,H,Dh) qkv -> folded kernel call -> o (B,T,H,Dh), or
+    (o, lse (B,T,H)) with a differentiable lse when want_lse."""
+    b, t, h, dh = q.shape
     dh_p = _ceil_to(dh, _LANE)
 
     def fold(x):
@@ -373,10 +451,34 @@ def flash_attention(q, k, v, *, block_q: int = 128, block_k: int = 128,
             x = jnp.pad(x, ((0, 0), (0, 0), (0, dh_p - dh)))
         return x
 
-    o = _flash_core(fold(q), fold(k), fold(v), 1.0 / (dh ** 0.5),
-                    bq, bk, interpret)
-    o = o[..., :dh].reshape(b, h, t, dh)
-    return jnp.moveaxis(o, 1, 2)  # (B, T, H, Dh)
+    args = (fold(q), fold(k), fold(v), 1.0 / (dh ** 0.5),
+            bq, bk, causal, interpret)
+
+    def unfold(o):
+        return jnp.moveaxis(o[..., :dh].reshape(b, h, t, dh), 1, 2)
+
+    if not want_lse:
+        return unfold(_flash_core(*args))
+    o, lse = _flash_core_lse(*args)
+    return unfold(o), jnp.moveaxis(lse.reshape(b, h, t), 1, 2)  # (B, T, H)
+
+
+def flash_attention_with_lse(q, k, v, *, causal: bool = True,
+                             block_q: int = 128, block_k: int = 128,
+                             force=None, interpret: bool = False):
+    """(o, lse) pair for the ring composition (parallel/ring_attention.
+    ring_flash_attention): lse is the per-row log-sum-exp in (B, T, H), and
+    is differentiable (the kernels' VJP carries d lse/d s = softmax), which
+    is what lets normalized per-hop outputs merge under grad. Falls back to
+    the dense streaming path (with lse) off-TPU or for non-tiling shapes."""
+    from draco_tpu.parallel.ring_attention import dense_attention_lse
+
+    b, t, h, dh = q.shape
+    bq = min(block_q, t)
+    bk = min(block_k, t)
+    if not _kernel_eligible(t, bq, bk, dh, force, interpret):
+        return dense_attention_lse(q, k, v, causal=causal)
+    return _run_folded(q, k, v, bq, bk, causal, interpret, want_lse=True)
 
 
 def attn_impl_fn(cfg):

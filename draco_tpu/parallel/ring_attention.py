@@ -54,6 +54,13 @@ def dense_attention(q, k, v, q_offset=0, k_offset=0, causal: bool = True):
 
     Used as the sp=1 fallback and as the oracle in tests.
     """
+    return dense_attention_lse(q, k, v, q_offset, k_offset, causal)[0]
+
+
+def dense_attention_lse(q, k, v, q_offset=0, k_offset=0, causal: bool = True):
+    """dense_attention that also returns the per-row log-sum-exp (B, T, H)
+    f32 — the dense counterpart of ops/flash_attention.flash_attention_with_lse
+    (its off-TPU / non-tiling fallback, and the small-shape oracle)."""
     b, tq, h, dh = q.shape
     tk = k.shape[1]
     scale = 1.0 / (dh**0.5)
@@ -63,7 +70,76 @@ def dense_attention(q, k, v, q_offset=0, k_offset=0, causal: bool = True):
     m = jnp.full((b, tq, h), NEG_INF, jnp.float32)
     l = jnp.zeros((b, tq, h), jnp.float32)
     o, m, l = _block_attn(q, k, v, q_pos, k_pos, scale, causal, o, m, l)
-    return (o / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
+    lse = m + jnp.log(jnp.maximum(l, 1e-30))
+    return (o / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype), lse
+
+
+def ring_flash_attention(
+    q,
+    k,
+    v,
+    axis_name: Optional[str],
+    causal: bool = True,
+    attn_with_lse=None,
+):
+    """Ring attention with a blockwise-kernel inner: O(T_local·Dh) memory at
+    BOTH levels. The plain ring (ring_attention) streams K/V blocks across
+    chips but each hop still materialises the (T_local, T_local) score block
+    on-chip; here every hop runs the flash kernel (ops/flash_attention) —
+    causal for the self hop, non-causal for fully-visible past-owner hops,
+    skipped entirely (lax.cond) for future owners — and the normalized
+    per-hop (o, lse) pairs merge by log-sum-exp weights. The kernel's lse
+    output is differentiable, so the merge backpropagates exactly.
+
+    Same contract as ring_attention: (B, T_local, H, Dh) per shard, called
+    inside shard_map; axis_name=None degrades to the single-shard kernel.
+    """
+    if attn_with_lse is None:
+        from draco_tpu.ops.flash_attention import flash_attention_with_lse
+
+        attn_with_lse = flash_attention_with_lse
+    if axis_name is None:
+        o, _ = attn_with_lse(q, k, v, causal=causal)
+        return o
+
+    sp = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % sp) for i in range(sp)]
+
+    # hop 0: this shard's own block (the only hop needing the causal mask)
+    o0, lse0 = attn_with_lse(q, k, v, causal=causal)
+    k_blk = lax.ppermute(k, axis_name, perm)
+    v_blk = lax.ppermute(v, axis_name, perm)
+
+    def hop(carry, r):
+        o_acc, lse_acc, k_blk, v_blk = carry
+        owner = (idx - r) % sp
+        # causal ring: a visiting block is visible iff its owner precedes
+        # this shard (then it is FULLY visible — no mask needed); the
+        # non-causal ring sees every block
+        visible = (owner < idx) | jnp.asarray(not causal)
+
+        def seen(_):
+            o_h, lse_h = attn_with_lse(q, k_blk, v_blk, causal=False)
+            return o_h.astype(jnp.float32), lse_h
+
+        def skipped(_):
+            return (jnp.zeros(q.shape, jnp.float32),
+                    jnp.full(q.shape[:2] + (q.shape[2],), NEG_INF,
+                             jnp.float32))
+
+        o_h, lse_h = lax.cond(visible, seen, skipped, None)
+        lse_new = jnp.logaddexp(lse_acc, lse_h)
+        w1 = jnp.exp(lse_acc - lse_new)
+        w2 = jnp.exp(lse_h - lse_new)
+        o_new = o_acc * w1[..., None] + o_h * w2[..., None]
+        k_nxt = lax.ppermute(k_blk, axis_name, perm)
+        v_nxt = lax.ppermute(v_blk, axis_name, perm)
+        return (o_new, lse_new, k_nxt, v_nxt), None
+
+    carry = (o0.astype(jnp.float32), lse0, k_blk, v_blk)
+    (o, _, _, _), _ = lax.scan(hop, carry, jnp.arange(1, sp))
+    return o.astype(q.dtype)
 
 
 def ring_attention(

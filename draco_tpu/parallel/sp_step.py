@@ -90,9 +90,16 @@ def build_sp_train_setup(cfg: TrainConfig, mesh) -> SPTrainSetup:
         # single-shard long-context path: the Pallas blockwise kernel
         # (per-device inside shard_map — no GSPMD partitioning involved)
         attn = flash
+    elif flash is not None and cfg.sp_attn == "ring":
+        # ring + flash: the kernel attends each visiting K/V block
+        # (causal self hop, unmasked past hops, future hops skipped) and
+        # per-hop outputs merge by differentiable lse weights
+        from draco_tpu.parallel.ring_attention import ring_flash_attention
+
+        attn = functools.partial(ring_flash_attention, axis_name=SEQ_AXIS)
     elif flash is not None:
         # Ulysses + flash: head-scatter a2a, then the flash kernel on each
-        # device's full-sequence head group (validate() enforces sp_attn=a2a)
+        # device's full-sequence head group
         attn = functools.partial(a2a_attention, axis_name=SEQ_AXIS,
                                  inner=flash)
     else:
