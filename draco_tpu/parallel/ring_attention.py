@@ -108,11 +108,14 @@ def ring_flash_attention(
 
     # hop 0: this shard's own block (the only hop needing the causal mask)
     o0, lse0 = attn_with_lse(q, k, v, causal=causal)
-    k_blk = lax.ppermute(k, axis_name, perm)
-    v_blk = lax.ppermute(v, axis_name, perm)
 
     def hop(carry, r):
-        o_acc, lse_acc, k_blk, v_blk = carry
+        o_acc, lse_acc, k_prev, v_prev = carry
+        # permute at hop START: after r hops this shard holds the block
+        # owned by (idx - r) mod sp, and the final hop's blocks are used
+        # (a trailing permute would be sp-th = wasted ICI traffic)
+        k_blk = lax.ppermute(k_prev, axis_name, perm)
+        v_blk = lax.ppermute(v_prev, axis_name, perm)
         owner = (idx - r) % sp
         # causal ring: a visiting block is visible iff its owner precedes
         # this shard (then it is FULLY visible — no mask needed); the
@@ -133,11 +136,9 @@ def ring_flash_attention(
         w1 = jnp.exp(lse_acc - lse_new)
         w2 = jnp.exp(lse_h - lse_new)
         o_new = o_acc * w1[..., None] + o_h * w2[..., None]
-        k_nxt = lax.ppermute(k_blk, axis_name, perm)
-        v_nxt = lax.ppermute(v_blk, axis_name, perm)
-        return (o_new, lse_new, k_nxt, v_nxt), None
+        return (o_new, lse_new, k_blk, v_blk), None
 
-    carry = (o0.astype(jnp.float32), lse0, k_blk, v_blk)
+    carry = (o0.astype(jnp.float32), lse0, k, v)
     (o, _, _, _), _ = lax.scan(hop, carry, jnp.arange(1, sp))
     return o.astype(q.dtype)
 
@@ -169,15 +170,20 @@ def ring_attention(
     l0 = jnp.zeros((b, t, h), jnp.float32)
     perm = [(i, (i + 1) % sp) for i in range(sp)]
 
+    # hop 0 (own block) outside the scan so every scan iteration permutes
+    # FIRST and the final hop's blocks are used — no trailing wasted permute
+    o0, m0, l0 = _block_attn(q, k, v, q_pos, q_pos, scale, causal, o0, m0, l0)
+
     def ring_step(carry, r):
-        o, m, l, k_blk, v_blk = carry
+        o, m, l, k_prev, v_prev = carry
+        k_blk = lax.ppermute(k_prev, axis_name, perm)
+        v_blk = lax.ppermute(v_prev, axis_name, perm)
         # after r hops this shard holds the block owned by (idx - r) mod sp
         owner = (idx - r) % sp
         k_pos = owner * t + jnp.arange(t)
         o, m, l = _block_attn(q, k_blk, v_blk, q_pos, k_pos, scale, causal, o, m, l)
-        k_nxt = lax.ppermute(k_blk, axis_name, perm)
-        v_nxt = lax.ppermute(v_blk, axis_name, perm)
-        return (o, m, l, k_nxt, v_nxt), None
+        return (o, m, l, k_blk, v_blk), None
 
-    (o, m, l, _, _), _ = lax.scan(ring_step, (o0, m0, l0, k, v), jnp.arange(sp))
+    (o, m, l, _, _), _ = lax.scan(ring_step, (o0, m0, l0, k, v),
+                                  jnp.arange(1, sp))
     return (o / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)

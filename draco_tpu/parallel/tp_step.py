@@ -131,10 +131,17 @@ def _build_gspmd_train_setup(cfg: TrainConfig, mesh, *, mp_axis: str,
         )
 
     cdtype = jnp.dtype(cfg.compute_dtype)
+    # flash applies in the folded (tp=1) regime the perf/convergence tools
+    # run in; real tensor sharding with flash is rejected by cfg.validate()
+    # (GSPMD cannot partition the opaque pallas_call across head shards —
+    # the sp paths compose it explicitly instead, sp_step.py)
+    from draco_tpu.ops.flash_attention import attn_impl_fn
+
+    attn_fn = attn_impl_fn(cfg) if mp_size == 1 else None
     model = TransformerLM(
         vocab=cfg.vocab, dim=cfg.model_dim, heads=cfg.model_heads,
-        layers=cfg.model_layers, attn_fn=None, experts=experts, dtype=cdtype,
-        remat=cfg.remat,
+        layers=cfg.model_layers, attn_fn=attn_fn, experts=experts,
+        dtype=cdtype, remat=cfg.remat,
     )
     root = jax.random.key(cfg.seed)
     init_toks = jnp.zeros((1, min(cfg.seq_len, 8)), jnp.int32)

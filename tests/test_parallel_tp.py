@@ -119,3 +119,31 @@ def test_tp_cyclic_simulate_matches_shared():
         _flat(jax.device_get(st_sh.params)),
         rtol=1e-3, atol=1e-5,
     )
+
+
+def test_tp_folded_accepts_flash():
+    """The folded (tp=1) LM regime — what the perf/convergence tools run —
+    accepts attn_impl=flash; the kernel (dense fallback off-TPU) slots in
+    as the Block attention with an unchanged training contract."""
+    import numpy as np
+
+    from draco_tpu.config import TrainConfig
+    from draco_tpu.parallel.mesh import make_folded_wtp_mesh
+    from draco_tpu.parallel.sp_step import synthetic_text
+    from draco_tpu.parallel.tp_step import build_tp_train_setup
+
+    cfg = TrainConfig(
+        network="TransformerLM", dataset="synthetic-text", batch_size=2,
+        num_workers=4, approach="baseline", mode="normal", worker_fail=0,
+        seq_len=16, vocab=32, model_dim=32, model_heads=2, model_layers=1,
+        attn_impl="flash", max_steps=2, eval_freq=0,
+        train_dir="", log_every=1000,
+    )
+    cfg.validate()
+    mesh = make_folded_wtp_mesh(4)
+    setup = build_tp_train_setup(cfg, mesh)
+    toks = synthetic_text(cfg.seed, 1, 4, 2, 16, 32)
+    import jax.numpy as jnp
+    st, metrics = setup.train_step(setup.state, jnp.asarray(toks),
+                                   jnp.zeros((4,), bool))
+    assert np.isfinite(float(metrics["loss"]))

@@ -138,6 +138,11 @@ def main(argv=None) -> int:
         # (cyclic_worker.py:122-146) — the r-cost VERDICT r2 item 6 asks for
         "lm_cyclic_s1_simulate_bf16": dict(common, approach="cyclic",
                                            redundancy="simulate"),
+        # the same coded step with the Pallas flash kernel in place of
+        # dense attention — the long-context hot-op on the training path
+        "lm_cyclic_s1_shared_bf16_flash": dict(common, approach="cyclic",
+                                               redundancy="shared",
+                                               attn_impl="flash"),
         "lm_geomedian_bf16": dict(common, approach="baseline",
                                   mode="geometric_median"),
         "lm_krum_bf16": dict(common, approach="baseline", mode="krum"),
