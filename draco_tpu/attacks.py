@@ -53,10 +53,60 @@ def attack_cyclic(enc_re: jnp.ndarray, enc_im: jnp.ndarray, err_mode: str, magni
     raise ValueError(f"unknown err_mode: {err_mode}")
 
 
+def _honest_stats(grads: jnp.ndarray, mask: jnp.ndarray):
+    """Mean and std over honest rows only — what a colluding adversary that
+    can observe its peers (the standard omniscient threat model of ALIE/IPM)
+    would estimate."""
+    w = (~mask).astype(grads.dtype)[:, None]
+    cnt = jnp.maximum(jnp.sum(w), 1.0)
+    mu = jnp.sum(grads * w, axis=0) / cnt
+    var = jnp.sum((grads - mu) ** 2 * w, axis=0) / cnt
+    return mu, jnp.sqrt(var)
+
+
+def _alie_z(n: int, n_mal: int) -> float:
+    """ALIE's evasion quantile (Baruch et al. 2019, 'A Little Is Enough'):
+    the largest z such that the perturbed value still looks like a
+    non-outlier to a trimmed/median rule over n workers with n_mal
+    colluders: z = Phi^-1((n - n_mal - s)/(n - n_mal)), s = floor(n/2+1) - n_mal."""
+    import math
+    import statistics
+
+    s = math.floor(n / 2 + 1) - n_mal
+    p = max(min((n - n_mal - s) / max(n - n_mal, 1), 1.0 - 1e-6), 1e-6)
+    return statistics.NormalDist().inv_cdf(p)
+
+
 def inject_plain(
-    grads: jnp.ndarray, mask: jnp.ndarray, err_mode: str, magnitude: float = ADVERSARY
+    grads: jnp.ndarray, mask: jnp.ndarray, err_mode: str,
+    magnitude: float = ADVERSARY, n_mal: int = 1,
 ) -> jnp.ndarray:
-    """grads: (n, d); mask: (n,) bool — True rows are Byzantine."""
+    """grads: (n, d); mask: (n,) bool — True rows are Byzantine.
+
+    Beyond the reference's three modes, two modern colluding attacks that
+    stress APPROXIMATE robust rules (cyclic decode is exact and rejects any
+    of them identically; reference parity owes neither):
+
+      alie : mu - z*sigma of the honest rows, z the evasion quantile of
+             Baruch et al. 2019 — hides inside the empirical variance
+      ipm  : -0.5 * mu of the honest rows (inner-product manipulation,
+             Xie et al. 2020) — flips the aggregate's direction while
+             staying small
+
+    ``n_mal`` is the STATIC colluder count (config worker_fail — the mask is
+    traced under jit, so the quantile cannot read it). Both attacks scale
+    linearly with ``magnitude`` relative to the reference's default (-100):
+    canonical at the default CLI knob, proportionally stronger/weaker when
+    --adversarial is set."""
+    if err_mode in ("alie", "ipm"):
+        n = grads.shape[0]
+        scale = magnitude / ADVERSARY  # 1.0 at the reference default
+        mu, sigma = _honest_stats(grads, mask)
+        if err_mode == "alie":
+            bad = mu - scale * _alie_z(n, max(n_mal, 1)) * sigma
+        else:
+            bad = -0.5 * scale * mu
+        return jnp.where(mask[:, None], bad[None, :], grads)
     return jnp.where(mask[:, None], attack_plain(grads, err_mode, magnitude), grads)
 
 

@@ -41,7 +41,9 @@ def add_fit_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                    help="repetition redundancy r for maj_vote")
     p.add_argument("--worker-fail", type=int, default=0, help="s Byzantine workers")
     p.add_argument("--err-mode", type=str, default="rev_grad",
-                   choices=["rev_grad", "constant", "random"])
+                   choices=["rev_grad", "constant", "random", "alie", "ipm"],
+                   help="reference modes + colluding attacks on approximate "
+                        "robust aggregation (alie: Baruch'19, ipm: Xie'20)")
     p.add_argument("--adversarial", type=float, default=-100.0,
                    help="attack magnitude (reference hardcoded -100)")
     p.add_argument("--adversary-count", type=int, default=None,

@@ -56,7 +56,7 @@ class TrainConfig:
     worker_fail: int = 0  # s, number of Byzantine workers (distributed_nn.py:68)
 
     # --- adversary simulation (reference: distributed_nn.py:64-67) ---
-    err_mode: str = "rev_grad"  # rev_grad | constant | random
+    err_mode: str = "rev_grad"  # rev_grad | constant | random | alie | ipm
     adversarial: float = -100.0  # attack magnitude (model_ops/utils.py:3-4)
 
     # --- straggler simulation (TPU-native; supersedes the reference's
@@ -175,8 +175,17 @@ class TrainConfig:
             raise ValueError(
                 f"{self.mode} requires num_workers > 2 * worker_fail"
             )
-        if self.err_mode not in ("rev_grad", "constant", "random"):
+        if self.err_mode not in ("rev_grad", "constant", "random",
+                                 "alie", "ipm"):
             raise ValueError(f"unknown err_mode: {self.err_mode}")
+        if self.err_mode in ("alie", "ipm") and self.approach == "cyclic":
+            raise ValueError(
+                f"err_mode={self.err_mode} targets approximate robust "
+                f"aggregation (baseline modes / maj_vote); the cyclic path's "
+                f"attack surface is the encoded rows, where decode is exact "
+                f"and any per-row corruption is removed — use rev_grad/"
+                f"constant there (attacks.py)"
+            )
         if self.approach == "maj_vote":
             if self.num_workers % self.group_size != 0:
                 raise ValueError(

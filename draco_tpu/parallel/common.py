@@ -64,7 +64,8 @@ def aggregate_flat_grads(grads: jnp.ndarray, adv_mask, cfg, code, rand_factor,
             agg, _honest = cyclic_mod.decode(code, enc_re, enc_im,
                                              rand_factor, present=present)
         return agg
-    grads = attacks.inject_plain(grads, adv_mask, cfg.err_mode, cfg.adversarial)
+    grads = attacks.inject_plain(grads, adv_mask, cfg.err_mode, cfg.adversarial,
+                                 n_mal=cfg.num_adversaries)
     return aggregation.aggregate(
         grads, cfg.mode, s=cfg.worker_fail,
         geomedian_iters=cfg.geomedian_iters, present=present,

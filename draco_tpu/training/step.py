@@ -201,7 +201,8 @@ def build_train_setup(cfg: TrainConfig, mesh, dataset_name: Optional[str] = None
                 state.params, state.batch_stats, x, y, dkeys
             )
             grads = jax.lax.with_sharding_constraint(grads, shard_w)
-            grads = attacks.inject_plain(grads, adv_mask, cfg.err_mode, adv_mag)
+            grads = attacks.inject_plain(grads, adv_mask, cfg.err_mode, adv_mag,
+                                         n_mal=cfg.num_adversaries)
             agg = aggregation.aggregate(grads, cfg.mode, s=cfg.worker_fail,
                                         geomedian_iters=cfg.geomedian_iters,
                                         present=present)
@@ -229,7 +230,8 @@ def build_train_setup(cfg: TrainConfig, mesh, dataset_name: Optional[str] = None
                 state.params, state.batch_stats, x, y, dkeys
             )
             grads = jax.lax.with_sharding_constraint(grads, shard_w)
-            grads = attacks.inject_plain(grads, adv_mask, cfg.err_mode, adv_mag)
+            grads = attacks.inject_plain(grads, adv_mask, cfg.err_mode, adv_mag,
+                                         n_mal=cfg.num_adversaries)
             voted = rep_mod.majority_vote(rep_code, grads, present=present)
             new_state = apply_update(state, voted, new_stats)
             return new_state, _metrics(losses, precs, present)

@@ -315,6 +315,98 @@ class TestAttacks:
         np.testing.assert_allclose(np.asarray(o_im)[1], np.asarray(im)[1], rtol=1e-6)
 
 
+class TestColludingAttacks:
+    """alie / ipm (beyond-reference): omniscient colluders computing their
+    payload from honest-row statistics."""
+
+    def test_ipm_payload_and_honest_rows(self, rng):
+        from draco_tpu import attacks
+
+        g = jnp.asarray(rng.randn(8, 16).astype(np.float32))
+        mask = jnp.asarray(np.arange(8) == 3)
+        out = np.asarray(attacks.inject_plain(g, mask, "ipm", n_mal=1))
+        honest = np.asarray(g)[np.arange(8) != 3]
+        np.testing.assert_allclose(out[3], -0.5 * honest.mean(0), rtol=1e-5,
+                                   atol=1e-6)
+        np.testing.assert_allclose(out[np.arange(8) != 3], honest, rtol=1e-6)
+
+    def test_alie_payload_hides_in_variance(self, rng):
+        from draco_tpu import attacks
+        from draco_tpu.attacks import _alie_z
+
+        g = jnp.asarray(rng.randn(8, 16).astype(np.float32))
+        mask = jnp.asarray(np.arange(8) == 0)
+        out = np.asarray(attacks.inject_plain(g, mask, "alie", n_mal=1))
+        honest = np.asarray(g)[1:]
+        mu, sigma = honest.mean(0), honest.std(0)
+        z = _alie_z(8, 1)
+        np.testing.assert_allclose(out[0], mu - z * sigma, rtol=1e-4,
+                                   atol=1e-5)
+        # the payload stays inside the honest spread (that is the attack)
+        assert np.all(np.abs(out[0] - mu) <= 3.1 * sigma + 1e-6)
+
+    def test_ipm_poisons_mean_but_not_coord_median(self, rng):
+        from draco_tpu import attacks
+
+        # tight honest cluster so the robust rule has signal
+        g = jnp.asarray((rng.randn(8, 32) * 0.01 + 1.0).astype(np.float32))
+        mask = jnp.asarray(np.arange(8) < 2)
+        out = attacks.inject_plain(g, mask, "ipm", n_mal=2)
+        honest_mean = np.asarray(g)[2:].mean(0)
+        mean_agg = np.asarray(jnp.mean(out, axis=0))
+        med_agg = np.asarray(aggregation.coordinate_median(out))
+        # mean dragged toward -0.5*mu by the colluders; median stays put
+        assert np.abs(mean_agg - honest_mean).max() > 0.3
+        assert np.abs(med_agg - honest_mean).max() < 0.05
+
+    def test_jit_static_quantile(self, rng):
+        """n_mal is static config, so alie traces under jit."""
+        import jax
+
+        from draco_tpu import attacks
+
+        g = jnp.asarray(rng.randn(8, 8).astype(np.float32))
+        mask = jnp.asarray(np.arange(8) == 1)
+        f = jax.jit(lambda g, m: attacks.inject_plain(g, m, "alie", n_mal=1))
+        out = np.asarray(f(g, mask))
+        assert np.isfinite(out).all()
+
+    def test_cyclic_rejects_colluding_modes(self):
+        from draco_tpu.config import TrainConfig
+
+        with pytest.raises(ValueError, match="decode is exact"):
+            TrainConfig(network="LeNet", dataset="synthetic-mnist",
+                        approach="cyclic", num_workers=8, worker_fail=1,
+                        err_mode="ipm", batch_size=4).validate()
+
+    def test_mean_under_ipm_trains_worse_than_median(self):
+        """End-to-end under a strong ipm (magnitude 8x the canonical eps,
+        2/8 colluders): the mean update's direction REVERSES
+        ((6*mu - 8*mu)/8 = -0.25*mu) so the undefended run must stall or
+        diverge, while coord-median discards the colluders and learns."""
+        from draco_tpu.config import TrainConfig
+        from draco_tpu.data.datasets import load_dataset
+        from draco_tpu.runtime import make_mesh
+        from draco_tpu.training.trainer import Trainer
+
+        losses = {}
+        for mode in ("normal", "coord_median"):
+            cfg = TrainConfig(
+                network="FC", dataset="synthetic-mnist", batch_size=16,
+                lr=0.05, num_workers=8, approach="baseline", mode=mode,
+                worker_fail=2, err_mode="ipm", adversarial=-800.0,
+                max_steps=30, eval_freq=0, train_dir="", log_every=1000,
+            )
+            ds = load_dataset("synthetic-mnist")
+            tr = Trainer(cfg, mesh=make_mesh(8), dataset=ds, quiet=True)
+            last = tr.run()
+            losses[mode] = float(last["loss"])
+            tr.close()
+        # the attack must visibly bite the mean AND median must beat it
+        assert losses["coord_median"] < 2.0, losses
+        assert losses["normal"] > losses["coord_median"] + 0.2, losses
+
+
 class TestSchedules:
     def test_adversary_schedule_deterministic(self):
         from draco_tpu import rng as drng
