@@ -97,6 +97,22 @@ class TestMajVote:
             )
         np.testing.assert_array_equal(params[0], params[1])
 
+    @pytest.mark.parametrize("err_mode", ["alie", "ipm"])
+    def test_vote_discards_colluding_attacks(self, ds, mesh, err_mode):
+        """A colluding payload (identical across colluders by construction)
+        is still a bitwise minority inside an honest-majority group, so the
+        vote's filtered update equals the clean run exactly — even for the
+        attacks that evade approximate aggregation rules."""
+        params = {}
+        for wf in (0, 1):
+            cfg = make_cfg(approach="maj_vote", group_size=4, worker_fail=wf,
+                           err_mode=err_mode, max_steps=8)
+            tr, _, _ = run_steps(cfg, ds, mesh, 8)
+            params[wf] = np.concatenate(
+                [np.ravel(x) for x in jax.tree.leaves(jax.device_get(tr.state.params))]
+            )
+        np.testing.assert_array_equal(params[0], params[1])
+
     def test_vote_equals_clean_mean_of_groups(self, ds, mesh):
         # with no adversaries, vote = mean over groups of the shared batch
         # gradient; training must track the plain run on the same group batches
