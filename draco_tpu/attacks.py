@@ -25,6 +25,7 @@ import jax.numpy as jnp
 
 ADVERSARY = -100.0
 CONST = -100.0
+_ALIE_INERT_WARNED = set()  # one warning per inert (n, n_mal) pair
 
 
 def attack_plain(grads: jnp.ndarray, err_mode: str, magnitude: float = ADVERSARY) -> jnp.ndarray:
@@ -103,7 +104,19 @@ def inject_plain(
         scale = magnitude / ADVERSARY  # 1.0 at the reference default
         mu, sigma = _honest_stats(grads, mask)
         if err_mode == "alie":
-            bad = mu - scale * _alie_z(n, max(n_mal, 1)) * sigma
+            z = _alie_z(n, max(n_mal, 1))
+            if z <= 0 and (n, n_mal) not in _ALIE_INERT_WARNED:
+                _ALIE_INERT_WARNED.add((n, n_mal))
+                import warnings
+
+                warnings.warn(
+                    f"alie is inert at n={n}, n_mal={n_mal}: the evasion "
+                    f"quantile z={z:.3f} <= 0, so the payload is (at most) "
+                    f"the honest mean — the attack needs more workers or "
+                    f"more colluders to have any z to hide behind",
+                    stacklevel=2,
+                )
+            bad = mu - scale * z * sigma
         else:
             bad = -0.5 * scale * mu
         return jnp.where(mask[:, None], bad[None, :], grads)

@@ -61,6 +61,18 @@ def enable_compile_cache(path: Optional[str] = None) -> str:
     """
     import sys
 
+    # If a backend is ALREADY initialized and it's plain CPU, skip: CPU
+    # compiles are cheap and the AOT reload warning is noise (nested tools —
+    # e.g. convergence_grid driving time_to_acc rows — land here). Only
+    # queried when initialized, so this can never trigger the in-process
+    # tunnel init the bootstrap must avoid.
+    try:
+        import jax._src.xla_bridge as _xb
+
+        if _xb.backends_are_initialized() and jax.default_backend() == "cpu":
+            return ""
+    except Exception:
+        pass
     base = path or os.environ.get("JAX_COMPILATION_CACHE_DIR") or _CACHE_DIR
     cache = os.path.join(base, _machine_tag())
     try:

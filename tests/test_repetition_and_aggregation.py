@@ -335,15 +335,28 @@ class TestColludingAttacks:
         from draco_tpu.attacks import _alie_z
 
         g = jnp.asarray(rng.randn(8, 16).astype(np.float32))
-        mask = jnp.asarray(np.arange(8) == 0)
-        out = np.asarray(attacks.inject_plain(g, mask, "alie", n_mal=1))
-        honest = np.asarray(g)[1:]
+        mask = jnp.asarray(np.arange(8) < 3)  # z(8,3)=0.253 > 0: a REAL payload
+        out = np.asarray(attacks.inject_plain(g, mask, "alie", n_mal=3))
+        honest = np.asarray(g)[3:]
         mu, sigma = honest.mean(0), honest.std(0)
-        z = _alie_z(8, 1)
+        z = _alie_z(8, 3)
+        assert z > 0, "test premise: quantile must be positive at (8, 3)"
         np.testing.assert_allclose(out[0], mu - z * sigma, rtol=1e-4,
                                    atol=1e-5)
         # the payload stays inside the honest spread (that is the attack)
         assert np.all(np.abs(out[0] - mu) <= 3.1 * sigma + 1e-6)
+
+    def test_alie_warns_when_inert(self, rng):
+        import warnings
+
+        from draco_tpu import attacks
+
+        g = jnp.asarray(rng.randn(8, 4).astype(np.float32))
+        mask = jnp.asarray(np.arange(8) == 0)
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            attacks.inject_plain(g, mask, "alie", n_mal=1)  # z(8,1) < 0
+        assert any("inert" in str(w.message) for w in caught)
 
     def test_ipm_poisons_mean_but_not_coord_median(self, rng):
         from draco_tpu import attacks

@@ -86,13 +86,14 @@ class TestMajVote:
 
     @pytest.mark.parametrize("err_mode,group_size,wf", [
         ("rev_grad", 4, 1),   # reference attack, single adversary per group
-        ("alie", 4, 1),       # single omniscient adversary
-        ("ipm", 4, 1),
+        ("ipm", 4, 1),        # single omniscient adversary
         # both colluders in ONE group (group_size = n), sending bitwise-
-        # identical ALIE payloads — a 2-vs-6 minority the vote must discard
+        # identical ipm payloads — a 2-vs-6 minority the vote must discard
         # (the case where identical malicious rows could out-count honest
-        # rows if the honest-majority budget were mis-checked)
-        ("alie", 8, 2),
+        # rows if the honest-majority budget were mis-checked). alie is
+        # inert at n=8 (z <= 0, attacks.py warns) so ipm is the colluding
+        # payload with teeth here.
+        ("ipm", 8, 2),
     ])
     def test_vote_attacked_equals_clean(self, ds, mesh, err_mode, group_size,
                                         wf):

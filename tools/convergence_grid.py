@@ -12,8 +12,9 @@ routine artifact the reference establishes with its convergence oracle
 Rows: cyclic simulate + shared, maj_vote (r=4 | n=8), the three
 reference-parity baselines (mean / geo-median / krum) and the four
 beyond-reference aggregators (coord_median / trimmed_mean / multi_krum /
-bulyan) — all under attack — plus a clean mean run as the matched-accuracy
-anchor.
+bulyan) — all under one rev_grad adversary — plus a clean mean anchor, and
+a colluding-attack block (ipm / alie rows with their own worker_fail and
+magnitude, recorded per row in the artifact's config blocks).
 
 Usage: python tools/convergence_grid.py --cpu-mesh 8 [--eval-every 5]
        [--max-steps 150] [--rows cyclic_sim,geomedian,...]
@@ -46,6 +47,29 @@ ROWS = {
     "maj_vote": ["--approach", "maj_vote", "--group-size", "4"],
     "cyclic_sim": ["--approach", "cyclic", "--redundancy", "simulate"],
     "cyclic_shared": ["--approach", "cyclic", "--redundancy", "shared"],
+    # --- colluding attacks (beyond-reference, attacks.py) -----------------
+    # strong ipm (8x canonical eps) with 2/8 colluders REVERSES the plain
+    # mean's update ((6 - 8)/8 = -0.25 mu); the robust rules must hold.
+    "mean_ipm": ["--approach", "baseline", "--mode", "normal",
+                 "--err-mode", "ipm", "--adversarial", "-800",
+                 "--worker-fail", "2"],
+    "geomedian_ipm": ["--approach", "baseline", "--mode", "geometric_median",
+                      "--err-mode", "ipm", "--adversarial", "-800",
+                      "--worker-fail", "2"],
+    "coord_median_ipm": ["--approach", "baseline", "--mode", "coord_median",
+                         "--err-mode", "ipm", "--adversarial", "-800",
+                         "--worker-fail", "2"],
+    # alie's evasion quantile needs colluder mass to be positive at n=8:
+    # z(8,3)=0.253 (z(8,1) is NEGATIVE and z(8,2)=0 — an inert payload,
+    # attacks.py warns); 8x magnitude makes it a real ~2-sigma deviation
+    "krum_alie": ["--approach", "baseline", "--mode", "krum",
+                  "--err-mode", "alie", "--worker-fail", "3",
+                  "--adversarial", "-800"],
+    # vote vs colluders: 2 identical -4mu payloads inside ONE group of 8 —
+    # a bitwise minority against 6 identical honest rows
+    "maj_vote_ipm": ["--approach", "maj_vote", "--group-size", "8",
+                     "--worker-fail", "2", "--err-mode", "ipm",
+                     "--adversarial", "-800"],
 }
 
 
@@ -102,8 +126,10 @@ def main(argv=None) -> int:
             "batch_size_per_worker": args.batch_size,
             "eval_every": args.eval_every, "max_steps": args.max_steps,
             "target_prec1": args.target,
-            "attack": "rev_grad, 1 adversary (seeded schedule shared "
-                      "across rows; mean_clean row is the no-attack anchor)",
+            "attack": "per-row (each row's config block records err_mode/"
+                      "worker_fail/adversarial; default rows: rev_grad, 1 "
+                      "adversary on the shared seeded schedule; mean_clean "
+                      "is the no-attack anchor)",
         },
         "rows": grid,
     }

@@ -36,6 +36,9 @@ def main(argv=None) -> int:
                     help="aggregation for --approach baseline")
     ap.add_argument("--worker-fail", type=int, default=1)
     ap.add_argument("--err-mode", type=str, default="rev_grad")
+    ap.add_argument("--adversarial", type=float, default=-100.0,
+                    help="attack magnitude (reference default -100; alie/ipm "
+                         "scale linearly relative to it)")
     ap.add_argument("--redundancy", type=str, default="simulate",
                     help="cyclic compute regime: simulate (reference-parity "
                          "2s+1 lanes) | shared (one-copy fast path)")
@@ -68,7 +71,8 @@ def main(argv=None) -> int:
         group_size=args.group_size,
         batch_size=args.batch_size, lr=args.lr, momentum=0.9,
         num_workers=args.num_workers, worker_fail=args.worker_fail,
-        err_mode=args.err_mode, max_steps=args.max_steps, eval_freq=0,
+        err_mode=args.err_mode, adversarial=args.adversarial,
+        max_steps=args.max_steps, eval_freq=0,
         train_dir="", log_every=10**9,
     )
     ds = load_dataset(cfg.dataset, cfg.data_dir)
@@ -112,7 +116,8 @@ def main(argv=None) -> int:
             "approach": args.approach, "mode": args.mode,
             "redundancy": args.redundancy, "group_size": args.group_size,
             "worker_fail": args.worker_fail,
-            "err_mode": args.err_mode, "num_workers": args.num_workers,
+            "err_mode": args.err_mode, "adversarial": args.adversarial,
+            "num_workers": args.num_workers,
             "batch_size_per_worker": args.batch_size, "lr": args.lr,
         },
         "target_prec1": args.target,
