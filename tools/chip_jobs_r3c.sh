@@ -1,0 +1,25 @@
+#!/bin/bash
+# Round-3 third chip chain: the remat utilization frontier (b256+ needs
+# activation rematerialisation — the no-remat simulate path OOMs HBM at
+# b256, PERF.md §1a) and the per-layer decode granularity row that the
+# r3 outage killed. Runs after chip_jobs_r3b.sh.
+set -u
+cd "$(dirname "$0")/.."
+
+tools/wait_tpu.sh 40 150 120 || exit 3
+
+FAILURES=0
+run() {
+  echo "[chip_jobs_r3c] ===== $* ====="
+  if ! "$@"; then
+    echo "[chip_jobs_r3c] FAILED (continuing): $*"
+    FAILURES=$((FAILURES + 1))
+  fi
+}
+
+run python tools/tpu_sweep.py --remat --batches 128,256,512 \
+  --dtypes bfloat16 --out baselines_out/tpu_sweep_remat.json
+run python tools/decode_study.py --ns 8 --ss 1 \
+  --out baselines_out/decode_study_granularity.json
+echo "[chip_jobs_r3c] done ($FAILURES failures)"
+exit $((FAILURES > 0 ? 1 : 0))
