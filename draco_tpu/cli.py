@@ -23,7 +23,10 @@ def add_fit_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     p.add_argument("--test-batch-size", type=int, default=1000)
     p.add_argument("--lr", type=float, default=0.01)
     p.add_argument("--momentum", type=float, default=0.9)
-    p.add_argument("--optimizer", type=str, default="sgd", choices=["sgd", "adam"])
+    p.add_argument("--optimizer", type=str, default="sgd",
+                   choices=["sgd", "adam", "adamw"])
+    p.add_argument("--weight-decay", type=float, default=0.01,
+                   help="adamw's decoupled weight decay (sgd/adam ignore it)")
     p.add_argument("--max-steps", type=int, default=10000)
     p.add_argument("--network", type=str, default="LeNet")
     p.add_argument("--dataset", type=str, default="MNIST")
@@ -152,6 +155,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         batch_size=args.batch_size,
         test_batch_size=args.test_batch_size,
         optimizer=args.optimizer,
+        weight_decay=args.weight_decay,
         lr=args.lr,
         momentum=args.momentum,
         max_steps=args.max_steps,

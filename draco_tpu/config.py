@@ -34,9 +34,10 @@ class TrainConfig:
     test_batch_size: int = 1000
 
     # --- optimization (reference: distributed_nn.py:31-43) ---
-    optimizer: str = "sgd"  # sgd | adam  (SGDModified / AdamModified semantics)
+    optimizer: str = "sgd"  # sgd | adam (reference parity) | adamw (decoupled decay)
     lr: float = 0.01
     momentum: float = 0.9
+    weight_decay: float = 0.01  # adamw's decoupled decay (unused by sgd/adam)
     max_steps: int = 10000
 
     # --- distributed topology ---

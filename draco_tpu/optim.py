@@ -98,9 +98,37 @@ def adam_modified(
     return optax.GradientTransformation(init, update)
 
 
-def build_optimizer(name: str, lr: float, momentum: float = 0.0) -> optax.GradientTransformation:
+def adamw_modified(
+    lr: float = 1e-3, b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8,
+    weight_decay: float = 0.01,
+) -> optax.GradientTransformation:
+    """torch.optim.AdamW update rule (decoupled weight decay,
+    Loshchilov & Hutter '19): p ← p·(1 − lr·λ), then the Adam step on the
+    RAW gradient. Beyond-reference (the reference predates AdamW's
+    dominance) but the LM paths' natural optimizer; same
+    aggregated-gradient-as-argument contract as the parity rules above."""
+    adam = adam_modified(lr=lr, b1=b1, b2=b2, eps=eps, weight_decay=0.0)
+
+    def init(params):
+        return adam.init(params)
+
+    def update(grads, state, params=None):
+        updates, new_state = adam.update(grads, state, params)
+        if weight_decay != 0.0:
+            updates = jax.tree.map(
+                lambda u, p: u - lr * weight_decay * p, updates, params
+            )
+        return updates, new_state
+
+    return optax.GradientTransformation(init, update)
+
+
+def build_optimizer(name: str, lr: float, momentum: float = 0.0,
+                    weight_decay: float = 0.01) -> optax.GradientTransformation:
     if name == "sgd":
         return sgd_modified(lr=lr, momentum=momentum)
     if name == "adam":
         return adam_modified(lr=lr)
+    if name == "adamw":
+        return adamw_modified(lr=lr, weight_decay=weight_decay)
     raise ValueError(f"unknown optimizer: {name}")

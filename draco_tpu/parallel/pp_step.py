@@ -182,7 +182,8 @@ def build_pp_train_setup(cfg: TrainConfig, mesh) -> PPTrainSetup:
         "final_ln": final_ln.init(k_ln, init_x.astype(jnp.float32))["params"],
     }
 
-    opt = optim.build_optimizer(cfg.optimizer, cfg.lr, cfg.momentum)
+    opt = optim.build_optimizer(cfg.optimizer, cfg.lr, cfg.momentum,
+                                 weight_decay=cfg.weight_decay)
     unravel, dim, leaf_offsets = _make_unravel(params)
 
     # parameter residence between steps: stage stacks shard their leading

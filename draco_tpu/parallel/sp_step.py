@@ -123,7 +123,8 @@ def build_sp_train_setup(cfg: TrainConfig, mesh) -> SPTrainSetup:
     init_toks = jnp.zeros((1, min(cfg.seq_len, 8)), jnp.int32)
     params = init_model.init({"params": root}, init_toks, train=True)["params"]
 
-    opt = optim.build_optimizer(cfg.optimizer, cfg.lr, cfg.momentum)
+    opt = optim.build_optimizer(cfg.optimizer, cfg.lr, cfg.momentum,
+                                 weight_decay=cfg.weight_decay)
     unravel, dim, leaf_offsets = _make_unravel(params)
 
     repl = NamedSharding(mesh, P())

@@ -147,7 +147,8 @@ def _build_gspmd_train_setup(cfg: TrainConfig, mesh, *, mp_axis: str,
     init_toks = jnp.zeros((1, min(cfg.seq_len, 8)), jnp.int32)
     params = model.init({"params": root}, init_toks, train=True)["params"]
 
-    opt = optim.build_optimizer(cfg.optimizer, cfg.lr, cfg.momentum)
+    opt = optim.build_optimizer(cfg.optimizer, cfg.lr, cfg.momentum,
+                                 weight_decay=cfg.weight_decay)
     unravel, dim, leaf_offsets = _make_unravel(params)
 
     repl = NamedSharding(mesh, P())

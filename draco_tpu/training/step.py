@@ -123,7 +123,8 @@ def build_train_setup(cfg: TrainConfig, mesh, dataset_name: Optional[str] = None
         else None
     )
 
-    opt = optim.build_optimizer(cfg.optimizer, cfg.lr, cfg.momentum)
+    opt = optim.build_optimizer(cfg.optimizer, cfg.lr, cfg.momentum,
+                                 weight_decay=cfg.weight_decay)
     opt_state = opt.init(params)
     unravel, dim, leaf_offsets = _make_unravel(params)
 

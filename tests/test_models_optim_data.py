@@ -101,6 +101,28 @@ class TestOptim:
         np.testing.assert_allclose(np.asarray(params["w"]), tp.detach().numpy(), rtol=1e-4, atol=1e-6)
 
 
+    def test_adamw_matches_torch(self, rng):
+        import torch
+
+        w0 = rng.randn(4, 4).astype(np.float32)
+        grads = [rng.randn(4, 4).astype(np.float32) for _ in range(4)]
+
+        tp = torch.nn.Parameter(torch.tensor(w0.copy()))
+        topt = torch.optim.AdamW([tp], lr=0.01, weight_decay=0.05)
+        for g in grads:
+            tp.grad = torch.tensor(g)
+            topt.step()
+
+        jopt = optim.adamw_modified(lr=0.01, weight_decay=0.05)
+        params = {"w": jnp.asarray(w0)}
+        state = jopt.init(params)
+        for g in grads:
+            updates, state = jopt.update({"w": jnp.asarray(g)}, state, params)
+            params = jax.tree.map(lambda p, u: p + u, params, updates)
+        np.testing.assert_allclose(np.asarray(params["w"]), tp.detach().numpy(),
+                                   rtol=1e-4, atol=1e-6)
+
+
 class TestData:
     def test_synthetic_fallback_shapes(self):
         ds = datasets.load_dataset("synthetic-mnist", synthetic_train=256, synthetic_test=64)

@@ -11,8 +11,11 @@ fetch-synchronised scanned protocol as bench.py.
 The JSON is (re)written after every point, so a mid-run tunnel loss keeps
 the completed points.
 
-Usage: python tools/tpu_sweep.py [--out baselines_out/tpu_sweep.json]
-       [--batches 32,64,128,256] [--dtypes float32,bfloat16] [--cpu-mesh 8]
+Usage: python tools/tpu_sweep.py [--batches 32,64,128,256]
+       [--dtypes float32,bfloat16] [--remat] [--cpu-mesh 8] [--out PATH]
+       (--out defaults to baselines_out/tpu_sweep.json, or
+       tpu_sweep_remat.json under --remat so the two frontiers never
+       clobber each other)
 """
 
 from __future__ import annotations
