@@ -27,6 +27,11 @@ def add_fit_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                    choices=["sgd", "adam", "adamw"])
     p.add_argument("--weight-decay", type=float, default=0.01,
                    help="adamw's decoupled weight decay (sgd/adam ignore it)")
+    p.add_argument("--lr-schedule", type=str, default="constant",
+                   choices=["constant", "cosine"],
+                   help="cosine: linear warmup then cosine decay to 10%% "
+                        "of --lr over --max-steps")
+    p.add_argument("--warmup-steps", type=int, default=0)
     p.add_argument("--max-steps", type=int, default=10000)
     p.add_argument("--network", type=str, default="LeNet")
     p.add_argument("--dataset", type=str, default="MNIST")
@@ -156,6 +161,8 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         test_batch_size=args.test_batch_size,
         optimizer=args.optimizer,
         weight_decay=args.weight_decay,
+        lr_schedule=args.lr_schedule,
+        warmup_steps=args.warmup_steps,
         lr=args.lr,
         momentum=args.momentum,
         max_steps=args.max_steps,

@@ -38,6 +38,8 @@ class TrainConfig:
     lr: float = 0.01
     momentum: float = 0.9
     weight_decay: float = 0.01  # adamw's decoupled decay (unused by sgd/adam)
+    lr_schedule: str = "constant"  # constant | cosine (warmup + cosine to 10%)
+    warmup_steps: int = 0  # linear warmup length for lr_schedule=cosine
     max_steps: int = 10000
 
     # --- distributed topology ---
@@ -175,6 +177,13 @@ class TrainConfig:
                 and self.num_workers <= 2 * self.worker_fail):
             raise ValueError(
                 f"{self.mode} requires num_workers > 2 * worker_fail"
+            )
+        if self.lr_schedule not in ("constant", "cosine"):
+            raise ValueError(f"unknown lr_schedule: {self.lr_schedule}")
+        if self.warmup_steps > 0 and self.lr_schedule == "constant":
+            raise ValueError(
+                "warmup_steps > 0 has no effect with lr_schedule=constant — "
+                "set --lr-schedule cosine (or drop --warmup-steps)"
             )
         if self.err_mode not in ("rev_grad", "constant", "random",
                                  "alie", "ipm"):

@@ -123,12 +123,54 @@ def adamw_modified(
     return optax.GradientTransformation(init, update)
 
 
+def lr_schedule(name: str, lr: float, warmup_steps: int = 0,
+                total_steps: int = 0):
+    """step (0-based update count) -> learning-rate multiplier path.
+
+    "constant": lr. "cosine": linear warmup over ``warmup_steps`` then a
+    cosine decay to 10% of peak at ``total_steps`` (the standard LM recipe;
+    beyond-reference — the reference trains at fixed lr)."""
+    if name == "constant":
+        return lambda t: lr
+    if name == "cosine":
+        # deliberately NOT optax.warmup_cosine_decay_schedule: its warmup
+        # ramps from init_value at t=0, giving a wasted ~zero-lr first
+        # update; this ramp hits (t+1)/warmup so step 0 already moves and
+        # step warmup-1 is exactly peak. Numerics are pinned by
+        # tests/test_models_optim_data.py::test_cosine_schedule_shape.
+        floor = 0.1 * lr
+
+        def sched(t):
+            t = jnp.asarray(t, jnp.float32)
+            warm = lr * (t + 1.0) / max(warmup_steps, 1)
+            span = max(total_steps - warmup_steps, 1)
+            frac = jnp.clip((t - warmup_steps) / span, 0.0, 1.0)
+            cos = floor + (lr - floor) * 0.5 * (1.0 + jnp.cos(jnp.pi * frac))
+            return jnp.where(t < warmup_steps, warm, cos)
+
+        return sched
+    raise ValueError(f"unknown lr schedule: {name}")
+
+
 def build_optimizer(name: str, lr: float, momentum: float = 0.0,
-                    weight_decay: float = 0.01) -> optax.GradientTransformation:
-    if name == "sgd":
-        return sgd_modified(lr=lr, momentum=momentum)
-    if name == "adam":
-        return adam_modified(lr=lr)
-    if name == "adamw":
-        return adamw_modified(lr=lr, weight_decay=weight_decay)
-    raise ValueError(f"unknown optimizer: {name}")
+                    weight_decay: float = 0.01, schedule: str = "constant",
+                    warmup_steps: int = 0,
+                    total_steps: int = 0) -> optax.GradientTransformation:
+    """The torch-parity rules bake ``-lr`` into their updates; under a
+    schedule they run at lr=1 (their direction algebra — momentum buffers,
+    bias correction, decoupled decay — is lr-independent) and
+    ``optax.scale_by_schedule`` applies the time-varying rate, so every
+    rule composes with every schedule."""
+    def base(rate: float) -> optax.GradientTransformation:
+        if name == "sgd":
+            return sgd_modified(lr=rate, momentum=momentum)
+        if name == "adam":
+            return adam_modified(lr=rate)
+        if name == "adamw":
+            return adamw_modified(lr=rate, weight_decay=weight_decay)
+        raise ValueError(f"unknown optimizer: {name}")
+
+    if schedule == "constant":
+        return base(lr)
+    sched = lr_schedule(schedule, lr, warmup_steps, total_steps)
+    return optax.chain(base(1.0), optax.scale_by_schedule(sched))

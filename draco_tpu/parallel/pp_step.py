@@ -183,7 +183,10 @@ def build_pp_train_setup(cfg: TrainConfig, mesh) -> PPTrainSetup:
     }
 
     opt = optim.build_optimizer(cfg.optimizer, cfg.lr, cfg.momentum,
-                                 weight_decay=cfg.weight_decay)
+                                 weight_decay=cfg.weight_decay,
+                                 schedule=cfg.lr_schedule,
+                                 warmup_steps=cfg.warmup_steps,
+                                 total_steps=cfg.max_steps)
     unravel, dim, leaf_offsets = _make_unravel(params)
 
     # parameter residence between steps: stage stacks shard their leading

@@ -123,6 +123,46 @@ class TestOptim:
                                    rtol=1e-4, atol=1e-6)
 
 
+    def test_cosine_schedule_shape(self):
+        sched = optim.lr_schedule("cosine", lr=0.1, warmup_steps=10,
+                                  total_steps=110)
+        # warmup ramps linearly to peak
+        np.testing.assert_allclose(float(sched(0)), 0.01, rtol=1e-5)
+        np.testing.assert_allclose(float(sched(9)), 0.1, rtol=1e-5)
+        # peak right after warmup, floor (10% of peak) at the end
+        np.testing.assert_allclose(float(sched(10)), 0.1, rtol=1e-5)
+        np.testing.assert_allclose(float(sched(110)), 0.01, rtol=1e-4)
+        # monotone decay in between
+        vals = [float(sched(t)) for t in range(10, 111, 10)]
+        assert vals == sorted(vals, reverse=True)
+
+    def test_scheduled_sgd_equals_manual_lr_sequence(self, rng):
+        """A scheduled rule must match running the fixed-lr rule with the
+        schedule's rate at each step — the composition contract."""
+        w0 = rng.randn(3, 3).astype(np.float32)
+        grads = [rng.randn(3, 3).astype(np.float32) for _ in range(5)]
+        sched = optim.lr_schedule("cosine", lr=0.1, warmup_steps=2,
+                                  total_steps=5)
+
+        opt = optim.build_optimizer("sgd", lr=0.1, momentum=0.9,
+                                    schedule="cosine", warmup_steps=2,
+                                    total_steps=5)
+        params = {"w": jnp.asarray(w0)}
+        state = opt.init(params)
+        for g in grads:
+            updates, state = opt.update({"w": jnp.asarray(g)}, state, params)
+            params = jax.tree.map(lambda p, u: p + u, params, updates)
+
+        # manual: same momentum buffer algebra, rate applied per step
+        buf = np.zeros_like(w0)
+        w = w0.copy()
+        for t, g in enumerate(grads):
+            buf = g if t == 0 else 0.9 * buf + g
+            w = w - float(sched(t)) * buf
+        np.testing.assert_allclose(np.asarray(params["w"]), w, rtol=1e-5,
+                                   atol=1e-6)
+
+
 class TestData:
     def test_synthetic_fallback_shapes(self):
         ds = datasets.load_dataset("synthetic-mnist", synthetic_train=256, synthetic_test=64)
