@@ -32,6 +32,10 @@ def add_fit_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                    help="cosine: linear warmup then cosine decay to 10%% "
                         "of --lr over --max-steps")
     p.add_argument("--warmup-steps", type=int, default=0)
+    p.add_argument("--clip-norm", type=float, default=0.0,
+                   help=">0: clip the decoded/aggregated gradient by global "
+                        "norm before the optimizer (post-aggregation, so it "
+                        "never changes what the Byzantine filter sees)")
     p.add_argument("--max-steps", type=int, default=10000)
     p.add_argument("--network", type=str, default="LeNet")
     p.add_argument("--dataset", type=str, default="MNIST")
@@ -163,6 +167,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         weight_decay=args.weight_decay,
         lr_schedule=args.lr_schedule,
         warmup_steps=args.warmup_steps,
+        clip_norm=args.clip_norm,
         lr=args.lr,
         momentum=args.momentum,
         max_steps=args.max_steps,

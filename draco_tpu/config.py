@@ -40,6 +40,7 @@ class TrainConfig:
     weight_decay: float = 0.01  # adamw's decoupled decay (unused by sgd/adam)
     lr_schedule: str = "constant"  # constant | cosine (warmup + cosine to 10%)
     warmup_steps: int = 0  # linear warmup length for lr_schedule=cosine
+    clip_norm: float = 0.0  # >0: global-norm clip of the aggregated gradient
     max_steps: int = 10000
 
     # --- distributed topology ---
@@ -180,6 +181,10 @@ class TrainConfig:
             )
         if self.lr_schedule not in ("constant", "cosine"):
             raise ValueError(f"unknown lr_schedule: {self.lr_schedule}")
+        if self.warmup_steps < 0:
+            raise ValueError(f"warmup_steps must be >= 0, got {self.warmup_steps}")
+        if self.clip_norm < 0:
+            raise ValueError(f"clip_norm must be >= 0, got {self.clip_norm}")
         if self.warmup_steps > 0 and self.lr_schedule == "constant":
             raise ValueError(
                 "warmup_steps > 0 has no effect with lr_schedule=constant — "

@@ -154,13 +154,31 @@ def lr_schedule(name: str, lr: float, warmup_steps: int = 0,
 
 def build_optimizer(name: str, lr: float, momentum: float = 0.0,
                     weight_decay: float = 0.01, schedule: str = "constant",
-                    warmup_steps: int = 0,
-                    total_steps: int = 0) -> optax.GradientTransformation:
+                    warmup_steps: int = 0, total_steps: int = 0,
+                    clip_norm: float = 0.0) -> optax.GradientTransformation:
     """The torch-parity rules bake ``-lr`` into their updates; under a
     schedule they run at lr=1 (their direction algebra — momentum buffers,
     bias correction, decoupled decay — is lr-independent) and
     ``optax.scale_by_schedule`` applies the time-varying rate, so every
-    rule composes with every schedule."""
+    rule composes with every schedule.
+
+    ``clip_norm`` > 0 clips the incoming gradient by global norm BEFORE the
+    rule. In this framework the optimizer consumes the already
+    decoded/aggregated gradient, so clipping is post-aggregation — it
+    bounds step size without interacting with Byzantine filtering (a
+    per-worker pre-aggregation clip would change what the vote/decode/
+    median see and is deliberately not offered). The clip is applied as a
+    STATELESS wrapper (not an optax.chain stage), so toggling it across a
+    resume keeps the checkpointed opt-state structure restorable; changing
+    the schedule FAMILY (constant <-> cosine) does change the structure
+    and needs a fresh opt state."""
+    if schedule != "constant" and total_steps <= 0:
+        raise ValueError(
+            f"schedule={schedule!r} needs total_steps > 0 (got "
+            f"{total_steps}); without it the decay span collapses and the "
+            f"whole run trains at the floor rate"
+        )
+
     def base(rate: float) -> optax.GradientTransformation:
         if name == "sgd":
             return sgd_modified(lr=rate, momentum=momentum)
@@ -171,6 +189,28 @@ def build_optimizer(name: str, lr: float, momentum: float = 0.0,
         raise ValueError(f"unknown optimizer: {name}")
 
     if schedule == "constant":
-        return base(lr)
-    sched = lr_schedule(schedule, lr, warmup_steps, total_steps)
-    return optax.chain(base(1.0), optax.scale_by_schedule(sched))
+        core = base(lr)
+    else:
+        sched = lr_schedule(schedule, lr, warmup_steps, total_steps)
+        core = optax.chain(base(1.0), optax.scale_by_schedule(sched))
+    if clip_norm > 0.0:
+        def clipped_update(grads, state, params=None):
+            g_norm = optax.global_norm(grads)
+            scale = jnp.minimum(1.0, clip_norm / jnp.maximum(g_norm, 1e-16))
+            grads = jax.tree.map(lambda g: g * scale, grads)
+            return core.update(grads, state, params)
+
+        return optax.GradientTransformation(core.init, clipped_update)
+    return core
+
+
+def build_optimizer_from_cfg(cfg) -> optax.GradientTransformation:
+    """One mapping from TrainConfig to the optimizer, shared by every
+    training path (step.py and parallel/{pp,tp,sp}_step.py) so a new knob
+    cannot be threaded into three of four topologies."""
+    return build_optimizer(
+        cfg.optimizer, cfg.lr, cfg.momentum,
+        weight_decay=cfg.weight_decay, schedule=cfg.lr_schedule,
+        warmup_steps=cfg.warmup_steps, total_steps=cfg.max_steps,
+        clip_norm=cfg.clip_norm,
+    )

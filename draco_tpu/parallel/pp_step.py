@@ -182,11 +182,7 @@ def build_pp_train_setup(cfg: TrainConfig, mesh) -> PPTrainSetup:
         "final_ln": final_ln.init(k_ln, init_x.astype(jnp.float32))["params"],
     }
 
-    opt = optim.build_optimizer(cfg.optimizer, cfg.lr, cfg.momentum,
-                                 weight_decay=cfg.weight_decay,
-                                 schedule=cfg.lr_schedule,
-                                 warmup_steps=cfg.warmup_steps,
-                                 total_steps=cfg.max_steps)
+    opt = optim.build_optimizer_from_cfg(cfg)
     unravel, dim, leaf_offsets = _make_unravel(params)
 
     # parameter residence between steps: stage stacks shard their leading

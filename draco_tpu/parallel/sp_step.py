@@ -123,11 +123,7 @@ def build_sp_train_setup(cfg: TrainConfig, mesh) -> SPTrainSetup:
     init_toks = jnp.zeros((1, min(cfg.seq_len, 8)), jnp.int32)
     params = init_model.init({"params": root}, init_toks, train=True)["params"]
 
-    opt = optim.build_optimizer(cfg.optimizer, cfg.lr, cfg.momentum,
-                                 weight_decay=cfg.weight_decay,
-                                 schedule=cfg.lr_schedule,
-                                 warmup_steps=cfg.warmup_steps,
-                                 total_steps=cfg.max_steps)
+    opt = optim.build_optimizer_from_cfg(cfg)
     unravel, dim, leaf_offsets = _make_unravel(params)
 
     repl = NamedSharding(mesh, P())

@@ -147,11 +147,7 @@ def _build_gspmd_train_setup(cfg: TrainConfig, mesh, *, mp_axis: str,
     init_toks = jnp.zeros((1, min(cfg.seq_len, 8)), jnp.int32)
     params = model.init({"params": root}, init_toks, train=True)["params"]
 
-    opt = optim.build_optimizer(cfg.optimizer, cfg.lr, cfg.momentum,
-                                 weight_decay=cfg.weight_decay,
-                                 schedule=cfg.lr_schedule,
-                                 warmup_steps=cfg.warmup_steps,
-                                 total_steps=cfg.max_steps)
+    opt = optim.build_optimizer_from_cfg(cfg)
     unravel, dim, leaf_offsets = _make_unravel(params)
 
     repl = NamedSharding(mesh, P())

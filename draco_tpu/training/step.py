@@ -123,11 +123,7 @@ def build_train_setup(cfg: TrainConfig, mesh, dataset_name: Optional[str] = None
         else None
     )
 
-    opt = optim.build_optimizer(cfg.optimizer, cfg.lr, cfg.momentum,
-                                 weight_decay=cfg.weight_decay,
-                                 schedule=cfg.lr_schedule,
-                                 warmup_steps=cfg.warmup_steps,
-                                 total_steps=cfg.max_steps)
+    opt = optim.build_optimizer_from_cfg(cfg)
     opt_state = opt.init(params)
     unravel, dim, leaf_offsets = _make_unravel(params)
 

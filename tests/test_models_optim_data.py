@@ -1,6 +1,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import optax
 import pytest
 
 from draco_tpu import models, optim
@@ -161,6 +162,22 @@ class TestOptim:
             w = w - float(sched(t)) * buf
         np.testing.assert_allclose(np.asarray(params["w"]), w, rtol=1e-5,
                                    atol=1e-6)
+
+
+    def test_clip_norm_bounds_update(self, rng):
+        """clip 1.0 on a huge gradient: the sgd (lr=1, no momentum) update's
+        global norm equals the clip; a small gradient passes untouched."""
+        big = {"w": jnp.full((4, 4), 100.0)}
+        small = {"w": jnp.full((4, 4), 1e-3)}
+        opt = optim.build_optimizer("sgd", lr=1.0, momentum=0.0,
+                                    clip_norm=1.0)
+        state = opt.init(big)
+        up, _ = opt.update(big, state, big)
+        np.testing.assert_allclose(
+            float(optax.global_norm(up)), 1.0, rtol=1e-5)
+        up, _ = opt.update(small, state, small)
+        np.testing.assert_allclose(np.asarray(up["w"]),
+                                   -np.asarray(small["w"]), rtol=1e-6)
 
 
 class TestData:
