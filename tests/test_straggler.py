@@ -111,6 +111,24 @@ def test_masked_krum_never_picks_absent_or_adversary(rng):
     assert any(np.allclose(picked, g[i]) for i in range(n) if present[i] and i != 2)
 
 
+def test_masked_coord_median_under_colluding_attack(rng):
+    """Stragglers AND colluders together: 2 absent rows + 2 strong-ipm
+    colluders among 8 — coord-median over the present rows must stay with
+    the honest cluster (the attack payload is a bitwise-shared outlier per
+    coordinate once the fill rows are excluded)."""
+    from draco_tpu import attacks
+
+    g = (rng.randn(8, 33) * 0.01 + 1.0).astype(np.float32)
+    adv = np.asarray(np.arange(8) < 2)
+    present = np.array([1, 1, 1, 0, 1, 1, 0, 1], dtype=bool)
+    attacked = attacks.inject_plain(jnp.asarray(g), jnp.asarray(adv), "ipm",
+                                    magnitude=-800.0, n_mal=2)
+    out = aggregation.coordinate_median(attacked,
+                                        present=jnp.asarray(present))
+    honest = g[present & ~adv]
+    assert np.abs(np.asarray(out) - honest.mean(0)).max() < 0.05
+
+
 def test_vote_with_absent_members(rng):
     code = repetition.build_repetition_code(6, 3)
     d = 19
