@@ -96,12 +96,16 @@ def inject_plain(
 
     ``n_mal`` is the STATIC colluder count (config worker_fail — the mask is
     traced under jit, so the quantile cannot read it). Both attacks scale
-    linearly with ``magnitude`` relative to the reference's default (-100):
+    linearly with |magnitude| relative to the reference's default (-100):
     canonical at the default CLI knob, proportionally stronger/weaker when
-    --adversarial is set."""
+    --adversarial is set. The SIGN of the knob is deliberately ignored here —
+    it encodes direction for rev_grad's multiplicative payload, but alie/ipm
+    fix their own direction (evade below the mean / oppose the mean); letting
+    a positive --adversarial flip them would silently turn ipm into +0.5*mu,
+    a benign nudge toward the honest aggregate."""
     if err_mode in ("alie", "ipm"):
         n = grads.shape[0]
-        scale = magnitude / ADVERSARY  # 1.0 at the reference default
+        scale = abs(magnitude) / abs(ADVERSARY)  # 1.0 at the reference default
         mu, sigma = _honest_stats(grads, mask)
         if err_mode == "alie":
             z = _alie_z(n, max(n_mal, 1))

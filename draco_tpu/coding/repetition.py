@@ -7,19 +7,65 @@ majority of members — implemented there as a Boyer–Moore pass with bitwise
 np.array_equal (rep_master.py:154-168) — then averages the group winners.
 
 TPU-native formulation: per-worker gradients form (n, d); reshape to
-(G, r, d); the vote is an argmax over per-member "agreement counts" computed
-from the exact pairwise-equality matrix. Exact equality is sound here for the
-same reason it is in the reference: group members run the identical
-deterministic computation on identical inputs (a vmap lane under XLA), so
-honest replicas agree bitwise while an attacked row differs.
+(G, r, d); the vote is an argmax over per-member "agreement counts". Equality
+testing is sound here for the same reason it is in the reference: group
+members run the identical deterministic computation on identical inputs (a
+vmap lane under XLA), so honest replicas agree bitwise while an attacked row
+differs.
+
+Cost: the vote is O(r·d) per group, not O(r²·d) — each row is folded to two
+position-sensitive 32-bit hashes of its raw bits (one O(d) pass per row) and
+the (r, r) agreement matrix is built from those 64-bit fingerprints instead
+of materialising the (G, r, r, d) elementwise-equality tensor. Honest
+replicas are bit-identical, so hash-equality <=> bit-equality up to a ~2^-64
+accidental collision; none of the in-scope error modes (rev_grad / constant /
+random / alie / ipm, attacks.py) can steer a hash preimage. Note the
+fingerprint compares raw BITS where the old elementwise `==` compared values:
+-0.0 vs +0.0 now count as a disagreement (stricter) and a NaN row now agrees
+with its own bit-identical replicas (the reference's np.array_equal treats
+NaN as always-unequal, rep_master.py:154-168 — either way a lone NaN row
+loses the vote to an honest majority).
 """
 
 from __future__ import annotations
 
 import dataclasses
 
+import jax
 import jax.numpy as jnp
 import numpy as np
+
+
+def _row_fingerprints(rows: jnp.ndarray):
+    """(G, r, d) -> two (G, r) uint32 weighted-sum hashes of each row's bits.
+
+    Weights vary with position so permuted or shifted payloads don't collide
+    the way a plain wrapping sum would; arithmetic wraps mod 2^32 by summing
+    in uint32. The two weight sequences must be INDEPENDENT functions of the
+    position: w1 is affine in j (a Weyl sequence), but a second affine
+    sequence would make (h1, h2) jointly depend only on the two moments
+    (Σ bits, Σ j·bits) — one ~2^-63 check dressed up as two. w2 is therefore
+    splitmix32-finalised (xor-shift/multiply avalanche of j), which is not
+    affine in j, so the pair carries genuinely independent ~2^-64 collision
+    odds. All elementwise uint32 ops: still one O(d) pass per row.
+    """
+    if rows.dtype.itemsize not in (2, 4):
+        raise ValueError(
+            f"majority_vote fingerprints support 2/4-byte element dtypes "
+            f"(bf16/f16/f32/i32 — what the gradient stack ever holds), got "
+            f"{rows.dtype}"
+        )
+    uint = {2: jnp.uint16, 4: jnp.uint32}[rows.dtype.itemsize]
+    bits = jax.lax.bitcast_convert_type(rows, uint).astype(jnp.uint32)
+    j = jax.lax.iota(jnp.uint32, bits.shape[-1])
+    w1 = j * jnp.uint32(2654435761) + jnp.uint32(0x9E3779B1)
+    z = (j + jnp.uint32(0x9E3779B9))  # splitmix32 finaliser
+    z = (z ^ (z >> 16)) * jnp.uint32(0x85EBCA6B)
+    z = (z ^ (z >> 13)) * jnp.uint32(0xC2B2AE35)
+    w2 = (z ^ (z >> 16)) | jnp.uint32(1)  # odd => bijective per-position weight
+    h1 = jnp.sum(bits * w1, axis=-1, dtype=jnp.uint32)
+    h2 = jnp.sum(bits * w2, axis=-1, dtype=jnp.uint32)
+    return h1, h2
 
 
 @dataclasses.dataclass(frozen=True)
@@ -55,8 +101,10 @@ def majority_vote(code: RepetitionCode, grads: jnp.ndarray,
     """
     g, r = code.num_groups, code.r
     rows = grads.reshape(g, r, -1)
-    # pairwise exact-equality counts, (G, r): agree[g, i] = #{j : row_i == row_j}
-    eq = jnp.all(rows[:, :, None, :] == rows[:, None, :, :], axis=-1)
+    # pairwise-equality counts, (G, r): agree[g, i] = #{j : row_i == row_j},
+    # via 64-bit row fingerprints (O(r·d)) — see module docstring
+    h1, h2 = _row_fingerprints(rows)
+    eq = (h1[:, :, None] == h1[:, None, :]) & (h2[:, :, None] == h2[:, None, :])
     if present is None:
         agree = jnp.sum(eq, axis=-1)
         winner = jnp.argmax(agree, axis=-1)  # (G,)

@@ -168,10 +168,14 @@ def build_optimizer(name: str, lr: float, momentum: float = 0.0,
     bounds step size without interacting with Byzantine filtering (a
     per-worker pre-aggregation clip would change what the vote/decode/
     median see and is deliberately not offered). The clip is applied as a
-    STATELESS wrapper (not an optax.chain stage), so toggling it across a
-    resume keeps the checkpointed opt-state structure restorable; changing
-    the schedule FAMILY (constant <-> cosine) does change the structure
-    and needs a fresh opt state."""
+    STATELESS wrapper (not an optax.chain stage), and EVERY schedule —
+    constant included (lr_schedule's degenerate branch) — goes through the
+    same chain(rule, scale_by_schedule) composition, so the opt-state
+    pytree structure is invariant across every knob: any checkpoint written
+    by this version restores under any schedule family or clip setting.
+    (Constant-schedule checkpoints written BEFORE this change carry the bare
+    rule's state without the schedule-count leaf and need a fresh opt state
+    — a one-time break, traded for structural invariance ever after.)"""
     if schedule != "constant" and total_steps <= 0:
         raise ValueError(
             f"schedule={schedule!r} needs total_steps > 0 (got "
@@ -188,11 +192,8 @@ def build_optimizer(name: str, lr: float, momentum: float = 0.0,
             return adamw_modified(lr=rate, weight_decay=weight_decay)
         raise ValueError(f"unknown optimizer: {name}")
 
-    if schedule == "constant":
-        core = base(lr)
-    else:
-        sched = lr_schedule(schedule, lr, warmup_steps, total_steps)
-        core = optax.chain(base(1.0), optax.scale_by_schedule(sched))
+    sched = lr_schedule(schedule, lr, warmup_steps, total_steps)
+    core = optax.chain(base(1.0), optax.scale_by_schedule(sched))
     if clip_norm > 0.0:
         def clipped_update(grads, state, params=None):
             g_norm = optax.global_norm(grads)

@@ -16,28 +16,45 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def evaluate_params(model, params, batch_stats, xs, ys, batch_size=1000):
+def masked_full_split_eval(count_fn, xs, ys, batch_size):
+    """Accuracy over ALL n samples: fixed-shape batches, with the ragged
+    final batch padded up to the compiled shape and masked out of the counts
+    (reference evaluates the full split, distributed_evaluator.py:92-110;
+    the pre-r4 loop dropped the n % bs tail). ``count_fn(x, y, valid) ->
+    (correct@1 count, correct@5 count)`` over the valid mask. Shared by
+    Trainer.evaluate and the checkpoint-polling evaluator so the pad/mask
+    edge cases live in exactly one place."""
     n = len(xs)
+    if n == 0:
+        return 0.0, 0.0
     bs = min(batch_size, n)
-    p1s, p5s = [], []
+    c1 = c5 = 0.0
+    for i in range(0, n, bs):
+        x = np.asarray(xs[i : i + bs])
+        y = np.asarray(ys[i : i + bs])
+        k = len(x)
+        if k < bs:
+            x = np.concatenate([x, np.repeat(x[:1], bs - k, axis=0)])
+            y = np.concatenate([y, np.repeat(y[:1], bs - k, axis=0)])
+        p1, p5 = count_fn(x, y, np.arange(bs) < k)
+        c1 += float(p1)
+        c5 += float(p5)
+    return c1 / n, c5 / n
+
+
+def evaluate_params(model, params, batch_stats, xs, ys, batch_size=1000):
     vs = {"params": params}
     if batch_stats is not None:
         vs["batch_stats"] = batch_stats
 
     @jax.jit
-    def _eval(x, y):
+    def _count(x, y, valid):
         logits = model.apply(vs, x, train=False)
-        top1 = jnp.mean((jnp.argmax(logits, -1) == y).astype(jnp.float32))
-        top5 = jnp.mean(
-            jnp.any(jax.lax.top_k(logits, 5)[1] == y[:, None], axis=1).astype(jnp.float32)
-        )
-        return top1, top5
+        ok1 = (jnp.argmax(logits, -1) == y) & valid
+        ok5 = jnp.any(jax.lax.top_k(logits, 5)[1] == y[:, None], axis=1) & valid
+        return jnp.sum(ok1.astype(jnp.float32)), jnp.sum(ok5.astype(jnp.float32))
 
-    for i in range(0, n - bs + 1, bs):
-        p1, p5 = _eval(jnp.asarray(xs[i : i + bs]), jnp.asarray(ys[i : i + bs]))
-        p1s.append(float(p1))
-        p5s.append(float(p5))
-    return float(np.mean(p1s)), float(np.mean(p5s))
+    return masked_full_split_eval(_count, xs, ys, batch_size)
 
 
 def main(argv=None):

@@ -67,7 +67,7 @@ class TrainSetup(NamedTuple):
     model: Any
     state: TrainState
     train_step: Any  # (state, x, y, adv_mask) -> (state, metrics)
-    eval_step: Any  # (state, x, y) -> (prec1, prec5)
+    eval_step: Any  # (state, x, y, valid) -> (correct@1 count, correct@5 count)
     code: Any  # CyclicCode | RepetitionCode | None
     unravel: Any  # flat (d,) -> params pytree
     dim: int
@@ -339,18 +339,21 @@ def build_train_setup(cfg: TrainConfig, mesh, dataset_name: Optional[str] = None
         raise ValueError(cfg.approach)
 
     # ---- eval ------------------------------------------------------------
-    def eval_body(state: TrainState, x, y):
+    def eval_body(state: TrainState, x, y, valid):
+        """Returns correct-prediction COUNTS over the ``valid`` mask (not
+        means): the trainer pads the final ragged batch up to the compiled
+        shape and divides the summed counts by the true test-set size, so no
+        tail sample is dropped and every batch weighs by its real length
+        (reference evaluates the full split, distributed_evaluator.py:92-110)."""
         vs = {"params": state.params}
         if has_bn:
             # evaluate with worker-0's running stats (reference evaluates a
             # single worker's checkpointed state, distributed_evaluator.py:119)
             vs["batch_stats"] = jax.tree.map(lambda t: t[0], state.batch_stats)
         logits = model.apply(vs, x, train=False)
-        top1 = jnp.mean((jnp.argmax(logits, -1) == y).astype(jnp.float32))
-        top5 = jnp.mean(
-            jnp.any(jax.lax.top_k(logits, 5)[1] == y[:, None], axis=1).astype(jnp.float32)
-        )
-        return top1, top5
+        ok1 = (jnp.argmax(logits, -1) == y) & valid
+        ok5 = jnp.any(jax.lax.top_k(logits, 5)[1] == y[:, None], axis=1) & valid
+        return jnp.sum(ok1.astype(jnp.float32)), jnp.sum(ok5.astype(jnp.float32))
 
     with mesh:
         train_step = jax.jit(step_body, donate_argnums=(0,))

@@ -141,20 +141,18 @@ class Trainer:
 
     # ---- eval ------------------------------------------------------------
     def evaluate(self, step: int, batch_size: Optional[int] = None) -> dict:
-        n = len(self.ds.test_x)
-        bs = min(batch_size or self.cfg.test_batch_size, n)
-        p1s, p5s = [], []
-        for i in range(0, n - bs + 1, bs):
-            x = np.asarray(self.ds.test_x[i : i + bs])
-            y = np.asarray(self.ds.test_y[i : i + bs])
-            p1, p5 = self.setup.eval_step(self.state, x, y)
-            p1s.append(float(p1))
-            p5s.append(float(p5))
-        rec = {
-            "step": step,
-            "prec1_test": float(np.mean(p1s)) if p1s else 0.0,
-            "prec5_test": float(np.mean(p5s)) if p5s else 0.0,
-        }
+        """Full-split accuracy: the ragged final batch (n % bs != 0) is padded
+        up to the compiled batch shape and masked out of the counts, so every
+        test sample is scored exactly once (shared pad/mask loop:
+        evaluator.masked_full_split_eval)."""
+        from draco_tpu.training.evaluator import masked_full_split_eval
+
+        p1, p5 = masked_full_split_eval(
+            lambda x, y, valid: self.setup.eval_step(self.state, x, y, valid),
+            self.ds.test_x, self.ds.test_y,
+            batch_size or self.cfg.test_batch_size,
+        )
+        rec = {"step": step, "prec1_test": p1, "prec5_test": p5}
         self.writer.write(rec)
         return rec
 

@@ -124,6 +124,40 @@ class TestOptim:
                                    rtol=1e-4, atol=1e-6)
 
 
+    def test_opt_state_structure_invariant_across_schedules(self):
+        """Resuming a checkpoint across a schedule-family switch requires the
+        opt-state pytree structure not to depend on the family (r3 advisor
+        finding): constant is built as a degenerate schedule inside the same
+        chain, with or without clip_norm (a stateless wrapper)."""
+        params = {"w": jnp.zeros((3,))}
+        structures = {
+            jax.tree.structure(
+                optim.build_optimizer("sgd", 0.1, momentum=0.9,
+                                      schedule=schedule, total_steps=100,
+                                      clip_norm=clip).init(params)
+            )
+            for schedule in ("constant", "cosine")
+            for clip in (0.0, 1.0)
+        }
+        assert len(structures) == 1
+
+    def test_constant_schedule_build_matches_bare_rule(self, rng):
+        """The degenerate-constant chain must update identically to the bare
+        torch-parity rule it wraps."""
+        w0 = rng.randn(5, 2).astype(np.float32)
+        grads = [rng.randn(5, 2).astype(np.float32) for _ in range(4)]
+        results = []
+        for opt in (optim.build_optimizer("sgd", 0.1, momentum=0.9,
+                                          schedule="constant"),
+                    optim.sgd_modified(lr=0.1, momentum=0.9)):
+            params = {"w": jnp.asarray(w0)}
+            state = opt.init(params)
+            for g in grads:
+                updates, state = opt.update({"w": jnp.asarray(g)}, state, params)
+                params = jax.tree.map(lambda p, u: p + u, params, updates)
+            results.append(np.asarray(params["w"]))
+        np.testing.assert_allclose(results[0], results[1], rtol=1e-6, atol=1e-7)
+
     def test_cosine_schedule_shape(self):
         sched = optim.lr_schedule("cosine", lr=0.1, warmup_steps=10,
                                   total_steps=110)

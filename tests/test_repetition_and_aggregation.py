@@ -35,6 +35,28 @@ class TestMajorityVote:
         with pytest.raises(ValueError):
             repetition.build_repetition_code(7, 3)
 
+    def test_vote_on_bfloat16_rows(self, rng):
+        """The O(r·d) fingerprint vote bitcasts rows; cover the 2-byte-dtype
+        path (bf16 lanes hand the vote bf16 gradients)."""
+        n, r, d = 6, 3, 33
+        code = repetition.build_repetition_code(n, r)
+        honest = rng.randn(code.num_groups, d).astype(np.float32)
+        grads = jnp.asarray(np.repeat(honest, r, axis=0)).astype(jnp.bfloat16)
+        grads = grads.at[2].set(-grads[2])  # minority corruption in group 0
+        out = repetition.majority_vote(code, grads)
+        want = np.asarray(jnp.asarray(honest).astype(jnp.bfloat16)
+                          .astype(jnp.float32)).mean(axis=0)
+        np.testing.assert_allclose(np.asarray(out.astype(jnp.float32)), want,
+                                   rtol=2e-2, atol=1e-2)
+
+    def test_vote_tiebreak_is_lowest_index(self):
+        """r=2 with one adversary ties the agreement counts; argmax must
+        deterministically pick the lowest row index (documented tie-break)."""
+        code = repetition.build_repetition_code(2, 2)
+        rows = np.stack([np.full(5, 7.0), np.full(5, -7.0)]).astype(np.float32)
+        out = repetition.majority_vote(code, jnp.asarray(rows))
+        np.testing.assert_array_equal(np.asarray(out), rows[0])
+
 
 def krum_oracle(grad_list, n, s):
     """Direct transcription of the reference loop semantics
@@ -357,6 +379,21 @@ class TestColludingAttacks:
             warnings.simplefilter("always")
             attacks.inject_plain(g, mask, "alie", n_mal=1)  # z(8,1) < 0
         assert any("inert" in str(w.message) for w in caught)
+
+    def test_sign_of_magnitude_cannot_invert_payload(self, rng):
+        """A positive --adversarial must not flip alie/ipm direction (the
+        knob's sign encodes direction only for rev_grad's multiplicative
+        payload) — regression for the r3 advisor finding."""
+        from draco_tpu import attacks
+
+        g = jnp.asarray(rng.randn(8, 16).astype(np.float32))
+        mask = jnp.asarray(np.arange(8) < 3)
+        for mode in ("alie", "ipm"):
+            neg = np.asarray(attacks.inject_plain(g, mask, mode,
+                                                  magnitude=-100.0, n_mal=3))
+            pos = np.asarray(attacks.inject_plain(g, mask, mode,
+                                                  magnitude=100.0, n_mal=3))
+            np.testing.assert_array_equal(pos, neg)
 
     def test_ipm_poisons_mean_but_not_coord_median(self, rng):
         from draco_tpu import attacks

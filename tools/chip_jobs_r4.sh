@@ -1,0 +1,39 @@
+#!/bin/bash
+# Round-4 chip chain driver: run the two queued round-3 chains (r3b: flash
+# kernel hardware compile + warmed driver bench + TTA; r3c: remat frontier +
+# decode granularity) with an outer retry loop, so a tunnel flap mid-chain
+# restarts the remaining work instead of abandoning it. All chain jobs are
+# idempotent (artifacts rewritten incrementally; TTA legs skip if their
+# artifact exists), so re-running a completed chain is cheap except for the
+# bench warm leg.
+#
+# Run under tmux (a parked client can sit for hours; see
+# .claude/skills/verify/SKILL.md "TPU tunnel discipline").
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p baselines_out
+
+stamp() { date -u +"%Y-%m-%dT%H:%M:%SZ"; }
+
+for round in 1 2 3 4 5 6; do
+  echo "[chip_jobs_r4 $(stamp)] ===== outer attempt $round ====="
+  if [ ! -f baselines_out/.r3b_done ]; then
+    bash tools/chip_jobs_r3b.sh >> baselines_out/chip_jobs_r3b.log 2>&1
+    rc=$?
+    echo "[chip_jobs_r4 $(stamp)] r3b exited rc=$rc"
+    [ "$rc" = 0 ] && touch baselines_out/.r3b_done
+  fi
+  if [ -f baselines_out/.r3b_done ] && [ ! -f baselines_out/.r3c_done ]; then
+    bash tools/chip_jobs_r3c.sh >> baselines_out/chip_jobs_r3c.log 2>&1
+    rc=$?
+    echo "[chip_jobs_r4 $(stamp)] r3c exited rc=$rc"
+    [ "$rc" = 0 ] && touch baselines_out/.r3c_done
+  fi
+  if [ -f baselines_out/.r3b_done ] && [ -f baselines_out/.r3c_done ]; then
+    echo "[chip_jobs_r4 $(stamp)] all chains complete"
+    exit 0
+  fi
+  sleep 120
+done
+echo "[chip_jobs_r4 $(stamp)] gave up after 6 outer attempts"
+exit 1

@@ -1,0 +1,43 @@
+#!/bin/bash
+# Round-4 second chip chain (run AFTER chip_jobs_r4.sh completes r3b+r3c):
+# the scale-up evidence VERDICT r3 item 5 asks for — one LM perf point big
+# enough that the decode-vs-geomedian gap and MFU are measured where they
+# matter (d≈160M, T=2048, remat+flash), plus a long-context ring+flash row.
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p baselines_out
+
+tools/wait_tpu.sh 60 150 120 || exit 3
+
+FAILURES=0
+run() {
+  echo "[chip_jobs_r4b] ===== $* ====="
+  if ! "$@"; then
+    echo "[chip_jobs_r4b] FAILED (continuing): $*"
+    FAILURES=$((FAILURES + 1))
+  fi
+}
+
+# d ≈ 159M (dim 1024, 12 blocks, vocab 8192): the (8, d) f32 gradient stack
+# is 5.1 GB, params+momentum 1.3 GB — fits 16G HBM with remat on.
+run python tools/tpu_lm_perf.py --steps 4 --reps 2 \
+  --model-dim 1024 --model-heads 16 --model-layers 12 \
+  --seq-len 2048 --batch-size 2 --remat \
+  --variants lm_cyclic_s1_shared_bf16_flash,lm_cyclic_s1_shared_bf16,lm_geomedian_bf16 \
+  --out baselines_out/tpu_lm_perf_big.json
+
+# same scale, reference-parity redundant compute (r=3 lanes): smaller batch
+# to keep the 3x activation footprint inside HBM
+run python tools/tpu_lm_perf.py --steps 4 --reps 2 \
+  --model-dim 1024 --model-heads 16 --model-layers 12 \
+  --seq-len 2048 --batch-size 1 --remat \
+  --variants lm_cyclic_s1_simulate_bf16 \
+  --out baselines_out/tpu_lm_perf_big_simulate.json
+
+# re-time the maj_vote preset after the O(r·d) fingerprint-vote rewrite
+# (r3 verdict weak #6: 40.0 ms with the O(r²·d) pairwise-equality vote)
+run python tools/run_baselines.py --max-steps 12 --protocol scan \
+  --only rep-resnet18
+
+echo "[chip_jobs_r4b] done ($FAILURES failures)"
+exit $((FAILURES > 0 ? 1 : 0))

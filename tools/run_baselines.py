@@ -45,6 +45,9 @@ def main(argv=None) -> int:
                          "steps (auto: scan on accelerators, eager on CPU)")
     ap.add_argument("--scan-steps", type=int, default=10,
                     help="steps folded into each timed scan (scan protocol)")
+    ap.add_argument("--only", type=str, default="",
+                    help="comma-separated subset of preset names to run "
+                         "(default: all five)")
     args = ap.parse_args(argv)
 
     from draco_tpu.cli import maybe_force_cpu_mesh
@@ -59,8 +62,15 @@ def main(argv=None) -> int:
     os.makedirs(args.out_dir, exist_ok=True)
     results_path = os.path.join(args.out_dir, "results.jsonl")
     rc = 0
+    names = list(PRESETS)
+    if args.only:
+        keep = {v.strip() for v in args.only.split(",") if v.strip()}
+        unknown = keep - set(names)
+        if unknown:
+            raise SystemExit(f"unknown presets {sorted(unknown)}; have {names}")
+        names = [n for n in names if n in keep]
     with open(results_path, "w" if args.fresh else "a") as fh:
-        for name in PRESETS:
+        for name in names:
             overrides = dict(max_steps=args.max_steps, eval_freq=0,
                              train_dir="", log_every=10**9)
             if args.smoke:
