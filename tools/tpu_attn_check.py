@@ -92,7 +92,7 @@ def check_one(t, b, h, dh, reps, interpret=False):
         is_oom = ("RESOURCE_EXHAUSTED" in msg or "out of memory" in msg.lower()
                   or "OOM" in msg)
         rec["dense"] = "oom" if is_oom else "failed"
-        rec["dense_error"] = msg[:200]
+        rec["dense_error"] = msg[:2500]
 
     # optional third column: jax's bundled reference Pallas flash op (same
     # blockwise algorithm, upstream-tuned) — an external yardstick for the
@@ -123,14 +123,16 @@ def check_one(t, b, h, dh, reps, interpret=False):
                 timeit_chained(fb_step(ref), qh, (kh, vh), reps=reps) * 1e3,
                 3)
         except Exception as e:
-            rec["jaxref_error"] = f"{type(e).__name__}: {e}"[:200]
+            rec["jaxref_error"] = f"{type(e).__name__}: {e}"[:2500]
     return rec
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", type=str, default="baselines_out/tpu_attn.json")
-    ap.add_argument("--seq-lens", type=str, default="1024,2048,4096")
+    # T=256 first: the cheapest hardware compile of the kernel — separates
+    # "Mosaic rejects the kernel at all" from long-T-specific failures
+    ap.add_argument("--seq-lens", type=str, default="256,1024,2048,4096")
     ap.add_argument("--batch", type=int, default=4)
     ap.add_argument("--heads", type=int, default=12)
     ap.add_argument("--head-dim", type=int, default=64)
@@ -163,7 +165,9 @@ def main(argv=None) -> int:
             rec = check_one(t, args.batch, args.heads, args.head_dim,
                             args.reps, interpret=args.cpu_interpret)
         except Exception as e:
-            rec = {"seq_len": t, "error": f"{type(e).__name__}: {e}"[:300]}
+            # keep enough of a Mosaic/compile error to act on it within the
+            # same tunnel window (300 chars cut the tiling detail in r3)
+            rec = {"seq_len": t, "error": f"{type(e).__name__}: {e}"[:2500]}
         print(f"[tpu_attn] {json.dumps(rec)}", file=sys.stderr, flush=True)
         report["rows"].append(rec)
         # rewrite after every row: a mid-run tunnel loss keeps finished rows
