@@ -406,11 +406,21 @@ def measure(args, metric_name, error=None, detail=None):
     else:
         _emit(record(value_ms, ratio_sim, full_extra))
 
+    def complete_without_shared(reason):
+        # the shared leg is the cpu-basis ratio source; without it, complete
+        # the record honestly on the only basis left rather than leaving the
+        # tail line marked 'pending' with a null ratio
+        base_extra["vs_baseline_basis"] = "simulate_redundancy"
+        _emit(record(value_ms, ratio_sim,
+                     dict(full_extra, shared_leg_error=reason)))
+
     # TPU-native fast path: identical decode semantics, each batch gradient
     # computed once (valid because SPMD adversaries are simulated, not
     # mutually-untrusting processes — config.py `redundancy`); reported
     # alongside the reference-parity number, never in its place
     if _remaining() < 30.0:
+        if cpu_basis:
+            complete_without_shared("budget exhausted before shared leg")
         return _LAST_RECORD
     _PHASE["name"] = "shared_leg"
     try:
@@ -429,12 +439,7 @@ def measure(args, metric_name, error=None, detail=None):
         print(f"bench: shared-redundancy leg failed, completing 2-leg "
               f"record: {type(e).__name__}: {e}", file=sys.stderr, flush=True)
         if cpu_basis:
-            # complete the record honestly on the only basis left rather
-            # than leaving the tail line marked 'pending' with a null ratio
-            base_extra["vs_baseline_basis"] = "simulate_redundancy"
-            _emit(record(value_ms, ratio_sim,
-                         dict(full_extra,
-                              shared_leg_error=f"{type(e).__name__}: {e}")))
+            complete_without_shared(f"{type(e).__name__}: {e}")
     return _LAST_RECORD
 
 

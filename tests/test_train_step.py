@@ -283,6 +283,31 @@ def test_elastic_resume_across_topology_and_approach(tmp_path, ds):
     assert np.isfinite(last["loss"])
 
 
+def test_resume_across_schedule_family_switch(tmp_path, ds, mesh):
+    """A checkpoint written under lr_schedule=constant restores into a cosine
+    run (and keeps training): the opt-state pytree is schedule-invariant
+    (optim.build_optimizer routes every family through the same
+    chain(rule, scale_by_schedule)) — end-to-end pin of the r3 advisor
+    finding that a family switch used to fail or misrestore."""
+    cfg_const = make_cfg(max_steps=6, eval_freq=3, train_dir=str(tmp_path))
+    tr1 = Trainer(cfg_const, mesh=mesh, dataset=ds, quiet=True)
+    tr1.run()
+    tr1.close()
+    saved = np.concatenate(
+        [np.ravel(x) for x in jax.tree.leaves(jax.device_get(tr1.state.params))])
+
+    cfg_cos = make_cfg(max_steps=12, eval_freq=0, train_dir=str(tmp_path),
+                       checkpoint_step=6, lr_schedule="cosine",
+                       warmup_steps=2)
+    tr2 = Trainer(cfg_cos, mesh=mesh, dataset=ds, quiet=True)
+    restored = np.concatenate(
+        [np.ravel(x) for x in jax.tree.leaves(jax.device_get(tr2.state.params))])
+    np.testing.assert_array_equal(restored, saved)
+    last = tr2.run()
+    tr2.close()
+    assert int(tr2.state.step) == 13 and np.isfinite(last["loss"])
+
+
 def test_same_seed_training_is_bitwise_deterministic(ds, mesh):
     """SURVEY §5.2: SPMD removes the reference's MPI tag-race surface
     entirely; what remains to guarantee is determinism — two Trainer runs
