@@ -59,6 +59,10 @@ def main(argv=None) -> int:
     ap.add_argument("--steps", type=int, default=10)
     ap.add_argument("--reps", type=int, default=10)
     ap.add_argument("--skip-granularity", action="store_true")
+    ap.add_argument("--gran-network", type=str, default="ResNet18",
+                    help="model for the granularity full-step rows (smoke: "
+                         "LeNet)")
+    ap.add_argument("--gran-batch-size", type=int, default=32)
     ap.add_argument("--cpu-mesh", type=int, default=0)
     args = ap.parse_args(argv)
 
@@ -85,6 +89,10 @@ def main(argv=None) -> int:
         "grad_dim": d,
         "geomedian_iters": 80,
         "scaling": [],
+        # provenance for the full-step rows: a LeNet smoke must never be
+        # mistakable for the flagship ResNet18/b32 evidence
+        "granularity_network": args.gran_network,
+        "granularity_batch_size": args.gran_batch_size,
         "granularity": {},
     }
     os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
@@ -138,7 +146,8 @@ def main(argv=None) -> int:
         mesh = make_mesh(8)
         for gran in ("global", "layer"):
             kw = dict(
-                network="ResNet18", dataset="Cifar10", batch_size=32,
+                network=args.gran_network, dataset="Cifar10",
+                batch_size=args.gran_batch_size,
                 lr=0.01, momentum=0.9, num_workers=8, worker_fail=1,
                 err_mode="rev_grad", approach="cyclic",
                 redundancy="simulate", decode_granularity=gran,
