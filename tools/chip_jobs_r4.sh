@@ -7,8 +7,11 @@
 # artifact exists), so re-running a completed chain is cheap except for the
 # bench warm leg.
 #
-# Run under tmux (a parked client can sit for hours; see
-# .claude/skills/verify/SKILL.md "TPU tunnel discipline").
+# Launch detached — no tmux in this image:
+#   setsid nohup bash tools/chip_jobs_r4.sh > baselines_out/chip_jobs_r4.log 2>&1 &
+# A parked client can sit for hours; see .claude/skills/verify/SKILL.md
+# "TPU tunnel discipline". NOTE: never edit this file while it is running —
+# bash reads scripts by byte offset and an edit corrupts the continuation.
 set -u
 cd "$(dirname "$0")/.."
 mkdir -p baselines_out
