@@ -329,13 +329,19 @@ def measure(args, metric_name, error=None, detail=None):
     # On a host-CPU run (the tpu-unavailable fallback) the r=2s+1 simulate
     # lanes SERIALISE on the host, so simulate-vs-geomedian measures the
     # redundancy artifact, not the decode (the reference's r× compute runs
-    # concurrently across n machines). There vs_baseline is computed from the
-    # shared leg — algebraically identical decode at 1/r the FLOPs — while
-    # the headline value/flops stay the simulate leg's (series-consistent
-    # with prior rounds; the basis field documents the split). On
-    # accelerators the reference-parity simulate leg is the basis for both.
-    # (BENCH_r03 showed regression-shaped 0.692 for exactly this reason
-    # while the same record's shared leg was 2.21x.)
+    # concurrently across n machines). There the PREFERRED vs_baseline basis
+    # is the shared leg — algebraically identical decode at 1/r the FLOPs —
+    # while the headline value/flops stay the simulate leg's. Emission is
+    # complete-first (VERDICT r4 weak #8): the two-leg record goes out whole
+    # on the simulate basis the moment the geomedian leg lands, and the
+    # shared leg, if it finishes, re-emits with the basis upgraded — so once
+    # the geomedian leg lands, no later kill can strand a pending record
+    # with a null ratio as the tail line. (Before the geomedian leg a null
+    # ratio is unavoidable: there is no baseline to divide by yet.)
+    # On accelerators the reference-parity simulate leg is the basis, full
+    # stop. (BENCH_r03 showed regression-shaped 0.692 on the simulate basis
+    # for exactly the serialisation reason while the same record's shared
+    # leg was 2.21x.)
     cpu_basis = platform == "cpu"
     base_extra = {
         "network": args.network,
@@ -346,9 +352,7 @@ def measure(args, metric_name, error=None, detail=None):
         "platform": platform,
         "device_kind": device_kind,
         "compute_dtype": "float32",
-        "vs_baseline_basis": (
-            "shared_redundancy" if cpu_basis else "simulate_redundancy"
-        ),
+        "vs_baseline_basis": "simulate_redundancy",
     }
 
     def record(value_ms, vs_baseline, extra):
@@ -400,17 +404,20 @@ def measure(args, metric_name, error=None, detail=None):
     )
     value_ms = round(t_cyclic * 1000.0, 3)
     ratio_sim = round(t_geomed / t_cyclic, 4)
+    # complete-first: this record already carries a valid ratio on the
+    # simulate basis; on CPU the shared leg only *upgrades* the basis later
     if cpu_basis:
-        _emit(record(value_ms, None,
-                     dict(full_extra, partial="shared leg pending")))
+        _emit(record(value_ms, ratio_sim,
+                     dict(full_extra,
+                          note="host-CPU run: simulate lanes serialise; "
+                               "shared-basis upgrade follows if budget "
+                               "allows")))
     else:
         _emit(record(value_ms, ratio_sim, full_extra))
 
     def complete_without_shared(reason):
-        # the shared leg is the cpu-basis ratio source; without it, complete
-        # the record honestly on the only basis left rather than leaving the
-        # tail line marked 'pending' with a null ratio
-        base_extra["vs_baseline_basis"] = "simulate_redundancy"
+        # the previous emission is already a complete simulate-basis record;
+        # re-emit only to attach why the basis upgrade didn't happen
         _emit(record(value_ms, ratio_sim,
                      dict(full_extra, shared_leg_error=reason)))
 
@@ -433,6 +440,8 @@ def measure(args, metric_name, error=None, detail=None):
             shared_redundancy_step_ms=round(t_shared * 1000.0, 3),
             shared_vs_geomedian=round(t_geomed / t_shared, 4),
         )
+        if cpu_basis:
+            base_extra["vs_baseline_basis"] = "shared_redundancy"
         ratio = round(t_geomed / t_shared, 4) if cpu_basis else ratio_sim
         _emit(record(value_ms, ratio, shared_extra))
     except Exception as e:

@@ -51,6 +51,11 @@ def add_fit_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                    help="logical workers n (the reference's mpirun -n minus the PS)")
     p.add_argument("--group-size", type=int, default=3,
                    help="repetition redundancy r for maj_vote")
+    p.add_argument("--vote-check", type=str, default="fingerprint",
+                   choices=["fingerprint", "exact"],
+                   help="maj_vote row-equality check: salted O(r*d) "
+                        "fingerprints vs collision-free O(r^2*d) exact "
+                        "bit-equality (for mutually-untrusting deployments)")
     p.add_argument("--worker-fail", type=int, default=0, help="s Byzantine workers")
     p.add_argument("--err-mode", type=str, default="rev_grad",
                    choices=["rev_grad", "constant", "random", "alie", "ipm"],
@@ -175,6 +180,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         approach=args.approach,
         mode=args.mode,
         group_size=args.group_size,
+        vote_check=args.vote_check,
         worker_fail=args.worker_fail,
         err_mode=args.err_mode,
         adversarial=args.adversarial,

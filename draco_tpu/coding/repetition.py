@@ -18,13 +18,46 @@ position-sensitive 32-bit hashes of its raw bits (one O(d) pass per row) and
 the (r, r) agreement matrix is built from those 64-bit fingerprints instead
 of materialising the (G, r, r, d) elementwise-equality tensor. Honest
 replicas are bit-identical, so hash-equality <=> bit-equality up to a ~2^-64
-accidental collision; none of the in-scope error modes (rev_grad / constant /
-random / alie / ipm, attacks.py) can steer a hash preimage. Note the
-fingerprint compares raw BITS where the old elementwise `==` compared values:
--0.0 vs +0.0 now count as a disagreement (stricter) and a NaN row now agrees
-with its own bit-identical replicas (the reference's np.array_equal treats
-NaN as always-unequal, rep_master.py:154-168 — either way a lone NaN row
-loses the vote to an honest majority).
+accidental collision. Note the fingerprint compares raw BITS where the old
+elementwise `==` compared values: -0.0 vs +0.0 now count as a disagreement
+(stricter) and a NaN row now agrees with its own bit-identical replicas (the
+reference's np.array_equal treats NaN as always-unequal,
+rep_master.py:154-168 — either way a lone NaN row loses the vote to an
+honest majority).
+
+Adversarial collision resistance — the honest threat-model ladder:
+
+1. *Oblivious corruption* (the in-scope simulated error modes: rev_grad /
+   constant / random / alie / ipm, attacks.py): any ~2^-64 pair of hashes
+   suffices; collisions are accidental only.
+2. *Adaptive adversary who does NOT know the salt*: the per-position mixing
+   must be nonlinear and position-asymmetric. A linear hash
+   h = Σ bits_j·w_j mod 2^32 — even with secret odd weights — is
+   constructibly collidable (flip the top bit of any two positions: the
+   difference 2^31·(w_i + w_j) vanishes because w_i + w_j is even). An
+   XOR-symmetric salted avalanche sum Σ mix(bits_j ^ pos_j ^ s) is ALSO
+   collidable salt-independently (swap the ``bits ^ pos`` values between
+   two positions: the salt XORs out and the term multiset is unchanged).
+   Here position therefore enters by *wrapping addition between two
+   avalanche rounds* — Σ mix(mix(bits_j ^ s) + posmix_j) — so a
+   salt-oblivious forgery would need a differential pair of the avalanche
+   with constant output difference across all salts, which splitmix32 does
+   not admit; only swapping bit-identical elements "collides", and that is
+   the identity. (Regression-tested against both constructions' attacks.)
+3. *Adversary who knows the salt*: each term is an invertible function of
+   the element, so a colliding row is constructible by inverting the
+   avalanche — NO seed-derived fingerprint can beat this. The training
+   step derives its per-step key from ``cfg.seed`` (step.py), and the
+   reference's whole discipline is that every participant shares that seed
+   (rng.py, reference src/util.py:17), so an in-protocol white-box
+   adversary is in this tier. For real mutually-untrusting deployments
+   either source the key from PS-private entropy (pass your own ``key``)
+   or set ``vote_check="exact"`` — bitwise np.array_equal semantics, the
+   reference's exact-recovery guarantee (rep_master.py:162) at O(r²·d)
+   memory traffic.
+
+With ``key=None`` the salts are fixed public constants: bit-exact
+deterministic, fine for tiers 1 and (heuristically) 2, direct-call/test use.
 """
 
 from __future__ import annotations
@@ -36,35 +69,57 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def _row_fingerprints(rows: jnp.ndarray):
-    """(G, r, d) -> two (G, r) uint32 weighted-sum hashes of each row's bits.
+def _splitmix32(z: jnp.ndarray) -> jnp.ndarray:
+    """splitmix32 finaliser: a bijective xor-shift/multiply avalanche. Every
+    output bit depends nonlinearly on every input bit — the property the
+    collision argument in the module docstring rests on."""
+    z = (z ^ (z >> 16)) * jnp.uint32(0x85EBCA6B)
+    z = (z ^ (z >> 13)) * jnp.uint32(0xC2B2AE35)
+    return z ^ (z >> 16)
 
-    Weights vary with position so permuted or shifted payloads don't collide
-    the way a plain wrapping sum would; arithmetic wraps mod 2^32 by summing
-    in uint32. The two weight sequences must be INDEPENDENT functions of the
-    position: w1 is affine in j (a Weyl sequence), but a second affine
-    sequence would make (h1, h2) jointly depend only on the two moments
-    (Σ bits, Σ j·bits) — one ~2^-63 check dressed up as two. w2 is therefore
-    splitmix32-finalised (xor-shift/multiply avalanche of j), which is not
-    affine in j, so the pair carries genuinely independent ~2^-64 collision
-    odds. All elementwise uint32 ops: still one O(d) pass per row.
-    """
+
+def _row_bits(rows: jnp.ndarray) -> jnp.ndarray:
+    """Validate the element dtype and bitcast to the matching uint — the one
+    place the vote's bit-compare domain (2/4-byte elements) is defined."""
     if rows.dtype.itemsize not in (2, 4):
         raise ValueError(
-            f"majority_vote fingerprints support 2/4-byte element dtypes "
+            f"majority_vote supports 2/4-byte element dtypes "
             f"(bf16/f16/f32/i32 — what the gradient stack ever holds), got "
             f"{rows.dtype}"
         )
     uint = {2: jnp.uint16, 4: jnp.uint32}[rows.dtype.itemsize]
-    bits = jax.lax.bitcast_convert_type(rows, uint).astype(jnp.uint32)
+    return jax.lax.bitcast_convert_type(rows, uint)
+
+
+def _row_fingerprints(rows: jnp.ndarray, key=None):
+    """(G, r, d) -> two (G, r) uint32 mix-then-sum hashes of each row's bits.
+
+    Per position j: keyed avalanche of the element's bits, wrapping-ADD the
+    avalanched position, avalanche again, then wrapping-sum over j. The
+    shape of the construction is load-bearing (module docstring tier 2): the
+    outer avalanche over (inner ^-keyed mix + position) is what kills both
+    the linear top-bit-pair attack and the salt-independent position-swap
+    attack — position must NOT enter by XOR next to the salt, or the salt
+    commutes out of a swap. Two salts give two hashes whose joint accidental
+    collision odds are ~2^-64; with ``key`` they are drawn from the PRNG,
+    with ``key=None`` they are fixed public constants (deterministic
+    direct-call/test path). All elementwise uint32 ops: one O(d) pass per
+    row either way.
+    """
+    bits = _row_bits(rows).astype(jnp.uint32)
     j = jax.lax.iota(jnp.uint32, bits.shape[-1])
-    w1 = j * jnp.uint32(2654435761) + jnp.uint32(0x9E3779B1)
-    z = (j + jnp.uint32(0x9E3779B9))  # splitmix32 finaliser
-    z = (z ^ (z >> 16)) * jnp.uint32(0x85EBCA6B)
-    z = (z ^ (z >> 13)) * jnp.uint32(0xC2B2AE35)
-    w2 = (z ^ (z >> 16)) | jnp.uint32(1)  # odd => bijective per-position weight
-    h1 = jnp.sum(bits * w1, axis=-1, dtype=jnp.uint32)
-    h2 = jnp.sum(bits * w2, axis=-1, dtype=jnp.uint32)
+    if key is None:
+        s1 = jnp.uint32(0x9E3779B1)
+        s2 = jnp.uint32(0xC2B2AE35)
+    else:
+        salts = jax.random.bits(key, (2,), jnp.uint32)
+        s1, s2 = salts[0], salts[1]
+    posmix = _splitmix32(j * jnp.uint32(2654435761) + jnp.uint32(0x9E3779B9))
+    h1 = jnp.sum(_splitmix32(_splitmix32(bits ^ s1) + posmix),
+                 axis=-1, dtype=jnp.uint32)
+    h2 = jnp.sum(_splitmix32(_splitmix32(bits ^ s2 ^ jnp.uint32(0x7F4A7C15))
+                             + posmix),
+                 axis=-1, dtype=jnp.uint32)
     return h1, h2
 
 
@@ -91,20 +146,40 @@ def build_repetition_code(n: int, r: int) -> RepetitionCode:
 
 
 def majority_vote(code: RepetitionCode, grads: jnp.ndarray,
-                  present=None) -> jnp.ndarray:
+                  present=None, key=None,
+                  method: str = "fingerprint") -> jnp.ndarray:
     """grads: (n, d) -> (d,) mean over groups of each group's majority row.
 
     ``present``: optional (n,) bool — absent members (stragglers) neither
     vote nor can win; a group with no present member contributes nothing and
     the group mean renormalises. (The reference PS blocks forever on a
     missing member, rep_master.py:104-116.)
+
+    ``key``: optional PRNG key salting the row fingerprints; pass a per-step
+    key (the training step does) so a salt-oblivious adaptive adversary
+    cannot construct a fingerprint collision — see module docstring.
+
+    ``method``: ``"fingerprint"`` (default, O(r·d) memory traffic) or
+    ``"exact"`` — full pairwise bit-equality at O(r²·d), no collision
+    surface at all; the right choice when adversaries may know the
+    experiment seed (module docstring tier 3; reference exact-recovery
+    semantics, rep_master.py:162).
     """
     g, r = code.num_groups, code.r
     rows = grads.reshape(g, r, -1)
-    # pairwise-equality counts, (G, r): agree[g, i] = #{j : row_i == row_j},
-    # via 64-bit row fingerprints (O(r·d)) — see module docstring
-    h1, h2 = _row_fingerprints(rows)
-    eq = (h1[:, :, None] == h1[:, None, :]) & (h2[:, :, None] == h2[:, None, :])
+    # pairwise-equality counts, (G, r): agree[g, i] = #{j : row_i == row_j}
+    if method == "exact":
+        bits = _row_bits(rows)
+        eq = jnp.all(bits[:, :, None, :] == bits[:, None, :, :], axis=-1)
+    elif method == "fingerprint":
+        # 64-bit row fingerprints (O(r·d)) — see module docstring
+        h1, h2 = _row_fingerprints(rows, key=key)
+        eq = ((h1[:, :, None] == h1[:, None, :])
+              & (h2[:, :, None] == h2[:, None, :]))
+    else:
+        raise ValueError(
+            f"method must be 'fingerprint' or 'exact', got {method!r}"
+        )
     if present is None:
         agree = jnp.sum(eq, axis=-1)
         winner = jnp.argmax(agree, axis=-1)  # (G,)

@@ -57,6 +57,13 @@ class TrainConfig:
     # coord_median | trimmed_mean | multi_krum | bulyan (aggregation.py).
     mode: str = "normal"
     group_size: int = 3  # r, repetition redundancy (reference: distributed_nn.py:70)
+    # maj_vote row-equality check: "fingerprint" = O(r·d) salted-hash vote
+    # (per-step key, sound unless adversaries know the experiment seed);
+    # "exact" = O(r²·d) full pairwise bit-equality, the reference's
+    # exact-recovery semantics (rep_master.py:162) with no collision
+    # surface — pick it for mutually-untrusting deployments
+    # (coding/repetition.py module docstring, threat-model ladder).
+    vote_check: str = "fingerprint"
     worker_fail: int = 0  # s, number of Byzantine workers (distributed_nn.py:68)
 
     # --- adversary simulation (reference: distributed_nn.py:64-67) ---
@@ -202,6 +209,11 @@ class TrainConfig:
                 f"constant there (attacks.py)"
             )
         if self.approach == "maj_vote":
+            if self.vote_check not in ("fingerprint", "exact"):
+                raise ValueError(
+                    f"vote_check must be 'fingerprint' or 'exact', got "
+                    f"{self.vote_check!r}"
+                )
             if self.num_workers % self.group_size != 0:
                 raise ValueError(
                     "maj_vote requires num_workers divisible by group_size "

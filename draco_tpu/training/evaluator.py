@@ -19,11 +19,18 @@ import numpy as np
 def masked_full_split_eval(count_fn, xs, ys, batch_size):
     """Accuracy over ALL n samples: fixed-shape batches, with the ragged
     final batch padded up to the compiled shape and masked out of the counts
-    (reference evaluates the full split, distributed_evaluator.py:92-110;
-    the pre-r4 loop dropped the n % bs tail). ``count_fn(x, y, valid) ->
+    (the pre-r4 loop dropped the n % bs tail). ``count_fn(x, y, valid) ->
     (correct@1 count, correct@5 count)`` over the valid mask. Shared by
     Trainer.evaluate and the checkpoint-polling evaluator so the pad/mask
-    edge cases live in exactly one place."""
+    edge cases live in exactly one place.
+
+    Deliberate deviation from the reference: the reference also covers the
+    full split but averages *per-batch accuracies*
+    (prec_counter / batch_counter, distributed_evaluator.py:105-107), which
+    overweights a ragged final batch; this implementation sums correct
+    counts and divides by n — exact sample-weighted accuracy. The two differ
+    whenever n % bs != 0, so numbers here can legitimately diverge from the
+    reference's by up to ~bs/n of the tail-batch accuracy gap."""
     n = len(xs)
     if n == 0:
         return 0.0, 0.0

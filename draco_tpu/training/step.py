@@ -232,7 +232,14 @@ def build_train_setup(cfg: TrainConfig, mesh, dataset_name: Optional[str] = None
             grads = jax.lax.with_sharding_constraint(grads, shard_w)
             grads = attacks.inject_plain(grads, adv_mask, cfg.err_mode, adv_mag,
                                          n_mal=cfg.num_adversaries)
-            voted = rep_mod.majority_vote(rep_code, grads, present=present)
+            # per-step fingerprint salt, identical on every device (folded
+            # from replicated state.step). Being seed-derived it is NOT
+            # secret from a participant that knows the experiment seed —
+            # cfg.vote_check="exact" is the collision-free option for that
+            # threat model (repetition.py module docstring, tier 3).
+            vkey = drng.fold(jax.random.key(cfg.seed + 4), state.step)
+            voted = rep_mod.majority_vote(rep_code, grads, present=present,
+                                          key=vkey, method=cfg.vote_check)
             new_state = apply_update(state, voted, new_stats)
             return new_state, _metrics(losses, precs, present)
 

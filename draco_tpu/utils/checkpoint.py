@@ -91,9 +91,35 @@ def _load_dcg(path: str, abstract_state: Any) -> Any:
 def load(train_dir: str, step: int, abstract_state: Any) -> Any:
     path = _path(train_dir, step)
     if os.path.isfile(path + ".dcg"):
+        # no hint wrapping here: the .dcg loader fails on IO/corruption, a
+        # class of error the opt-state-unification explanation never fits
         return _load_dcg(path + ".dcg", abstract_state)
-    with ocp.StandardCheckpointer() as ckptr:
-        return ckptr.restore(path, abstract_state)
+    try:
+        with ocp.StandardCheckpointer() as ckptr:
+            return ckptr.restore(path, abstract_state)
+    except Exception as e:  # re-raise with a format-version hint when the
+        # failure is a pytree-structure mismatch: the raw Orbax error gives
+        # no clue that pre-unification constant-schedule checkpoints (opt
+        # state was the bare rule's, optim.py docstring) legitimately
+        # cannot restore into the current chain(rule, scale_by_schedule)
+        # structure. Gate requires structure-AND-match (or treedef) in the
+        # message so IO errors whose *paths* contain words like 'tree'
+        # don't get dressed up as a version problem.
+        msg = str(e).lower()
+        if ("structure" in msg and "match" in msg) or "treedef" in msg:
+            raise ValueError(
+                f"checkpoint restore of '{path}' failed with a pytree "
+                f"structure mismatch: {e}\n"
+                f"If this checkpoint was written before the opt-state "
+                f"unification (constant lr schedules now carry the same "
+                f"chain(rule, scale_by_schedule) state as every other "
+                f"schedule — draco_tpu/optim.py), its optimizer state has "
+                f"the old structure and cannot be restored; restart with a "
+                f"fresh optimizer state (params restore fine via a "
+                f"params-only abstract state) or re-save under the current "
+                f"version."
+            ) from e
+        raise
 
 
 def exists(train_dir: str, step: int) -> bool:
