@@ -161,6 +161,42 @@ class TestFingerprintCollisionResistance:
                                        key=jax.random.key(123))
         np.testing.assert_allclose(np.asarray(out), honest[0], rtol=1e-6)
 
+    def test_fingerprint_vote_equals_exact_vote_randomized(self, rng):
+        """Property check: over random group contents with crafted duplicate
+        patterns (the full input domain of the vote), the fingerprint path
+        and the exact path must elect bitwise-identical winners — the two
+        methods differ only in collision surface, never in semantics."""
+        import jax
+
+        n, r, d = 8, 4, 17
+        code = repetition.build_repetition_code(n, r)
+        for trial in range(8):
+            rows = rng.randn(n, d).astype(np.float32)
+            # plant duplicate patterns: copy random rows over random rows
+            # within each group so agreement counts take nontrivial values
+            for g0 in range(code.num_groups):
+                base = g0 * r
+                for _ in range(rng.randint(0, 4)):
+                    src, dst = rng.randint(0, r, size=2)
+                    rows[base + dst] = rows[base + src]
+            present = (rng.rand(n) > 0.2) if trial % 2 else None
+            kw = dict(present=None if present is None
+                      else jnp.asarray(present))
+            out_fp = repetition.majority_vote(
+                code, jnp.asarray(rows), key=jax.random.key(trial), **kw)
+            out_ex = repetition.majority_vote(
+                code, jnp.asarray(rows), method="exact", **kw)
+            np.testing.assert_array_equal(
+                np.asarray(out_fp), np.asarray(out_ex),
+                err_msg=f"trial {trial} (present={present})")
+
+    def test_vote_check_config_validation(self):
+        from draco_tpu.config import TrainConfig
+
+        with pytest.raises(ValueError, match="vote_check"):
+            TrainConfig(approach="maj_vote", num_workers=9, group_size=3,
+                        vote_check="sha256").validate()
+
     def test_key_changes_fingerprints_but_not_vote(self, rng):
         """Salts drawn from different keys must change the hash values
         (else the key isn't live) while the vote outcome — a function only
