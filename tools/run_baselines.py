@@ -48,6 +48,11 @@ def main(argv=None) -> int:
     ap.add_argument("--only", type=str, default="",
                     help="comma-separated subset of preset names to run "
                          "(default: all five)")
+    ap.add_argument("--vote-check", type=str, default="",
+                    choices=["", "fingerprint", "exact"],
+                    help="override the maj_vote row-equality method for the "
+                         "rep presets (empty: preset default) — lets the "
+                         "chip decide fingerprint-vs-exact at equal config")
     args = ap.parse_args(argv)
 
     from draco_tpu.cli import maybe_force_cpu_mesh
@@ -73,6 +78,11 @@ def main(argv=None) -> int:
         for name in names:
             overrides = dict(max_steps=args.max_steps, eval_freq=0,
                              train_dir="", log_every=10**9)
+            if args.vote_check and name.startswith("rep-"):
+                # only the rep presets run maj_vote; stamping the override
+                # into other rows would split equal-config groupings on an
+                # inert field
+                overrides["vote_check"] = args.vote_check
             if args.smoke:
                 overrides.update(
                     dataset="synthetic-mnist" if "lenet" in name else "synthetic-cifar10",
