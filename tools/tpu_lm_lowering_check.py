@@ -1,0 +1,132 @@
+#!/usr/bin/env python
+"""Offline TPU-lowering audit of the d≈159M LM chip programs (round 5).
+
+The `lm_big` rung of tools/chip_jobs_r5.sh stakes a large slice of the one
+tunnel window on programs that have NEVER compiled anywhere: TransformerLM
+dim=1024/heads=16/layers=12 (d ≈ 159M params), T=2048, bf16, remat, on the
+folded w×tp GSPMD mesh — cyclic shared + Pallas flash, cyclic shared,
+geomedian, and cyclic simulate (r=3 redundant lanes). A Python-side
+lowering bug there (Pallas tiling, sharding rule, remat/scan interaction)
+would burn the window for nothing.
+
+This tool cross-platform exports the full scanned train-step programs for
+`platforms=["tpu"]` on the CPU host (`jax.export`), which runs the whole
+StableHLO + Pallas TPU lowering stack without a chip (methodology +
+negative control: tools/tpu_attn_lowering_check.py). Drift-proofing: the
+variant configs, input staging, and scan loop are IMPORTED from
+tools/tpu_lm_perf.py (build_lm_variants / stage_scan_inputs /
+make_scan_loop) — the audit lowers the same program the chip rung times,
+by construction. The host runs with ONE virtual device, so
+make_folded_wtp_mesh folds all 8 logical workers onto a single device —
+the exact layout the single-chip rung uses (every on-chip artifact records
+devices_used: 1); an 8-device layout would exercise different GSPMD
+shardings than the chip will.
+
+What it cannot prove: Mosaic machine-code compilation and HBM fit — the
+chip rung closes those.
+
+  python tools/tpu_lm_lowering_check.py \
+      [--out baselines_out/tpu_lm_big_lowering.json]
+
+Builds ~159M-param states on host RAM (~1-2 min per variant); the report
+is rewritten after every row, so an interrupt keeps finished rows.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def audit_variant(name, cfg_kw, steps=2):
+    import jax
+    import jax.export
+
+    from draco_tpu.config import TrainConfig
+    from draco_tpu.parallel.mesh import make_folded_wtp_mesh
+    from draco_tpu.parallel.tp_step import build_tp_train_setup
+    from tools.tpu_lm_perf import make_scan_loop, stage_scan_inputs
+
+    cfg = TrainConfig(**cfg_kw)
+    mesh = make_folded_wtp_mesh(cfg.num_workers)
+    t0 = time.time()
+    try:
+        setup = build_tp_train_setup(cfg, mesh)
+        xs, ms = stage_scan_inputs(cfg, steps)
+        loop = make_scan_loop(setup)
+        with mesh:
+            jax.export.export(jax.jit(loop), platforms=["tpu"])(
+                setup.state, xs, ms)
+        n_params = sum(x.size for x in jax.tree.leaves(setup.state.params))
+        return {"variant": name, "ok": True, "params": int(n_params),
+                "devices_in_mesh": int(mesh.devices.size),
+                "seconds": round(time.time() - t0, 1)}
+    except Exception as e:
+        return {"variant": name, "ok": False,
+                "seconds": round(time.time() - t0, 1),
+                "error": f"{type(e).__name__}: {str(e)[:400]}"}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", type=str,
+                    default="baselines_out/tpu_lm_big_lowering.json")
+    args = ap.parse_args(argv)
+
+    # ONE virtual device: the chip folds all logical workers onto a single
+    # device and the audit must lower that exact layout (docstring)
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=1")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from tools.tpu_lm_perf import build_lm_variants
+
+    # EXACT chip_jobs_r5.sh lm_big rung shapes, via the shared constructor
+    big = dict(num_workers=8, seq_len=2048, vocab=8192, model_dim=1024,
+               model_heads=16, model_layers=12, remat=True, max_steps=5)
+    v_b2 = build_lm_variants(batch_size=2, **big)
+    v_b1 = build_lm_variants(batch_size=1, **big)
+    variants = [
+        ("lm_cyclic_s1_shared_bf16_flash", v_b2["lm_cyclic_s1_shared_bf16_flash"]),
+        ("lm_cyclic_s1_shared_bf16", v_b2["lm_cyclic_s1_shared_bf16"]),
+        ("lm_geomedian_bf16", v_b2["lm_geomedian_bf16"]),
+        ("lm_cyclic_s1_simulate_bf16", v_b1["lm_cyclic_s1_simulate_bf16"]),
+    ]
+
+    report = {
+        "method": "jax.export cross-platform lowering, platforms=['tpu'], "
+                  "CPU host with ONE virtual device (the chip's folded "
+                  "layout), full scanned train-step programs at the exact "
+                  "chip_jobs_r5.sh lm_big rung shapes, configs imported "
+                  "from tools/tpu_lm_perf.py",
+        "all_ok": None,
+        "rows": [],
+    }
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+
+    def flush():
+        with open(args.out, "w") as fh:
+            json.dump(report, fh, indent=1)
+
+    for name, kw in variants:
+        row = audit_variant(name, kw)
+        report["rows"].append(row)
+        report["all_ok"] = all(r["ok"] for r in report["rows"])
+        flush()
+        print(f"[lm_lowering] {name}: "
+              f"{'ok' if row['ok'] else row['error'][:120]} "
+              f"({row['seconds']}s)", file=sys.stderr, flush=True)
+
+    print(json.dumps({"all_ok": report["all_ok"]}))
+    return 0 if report["all_ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -35,19 +35,16 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def run_lm(cfg, mesh, steps, warmup=1, reps=2):
-    """(ms/step, flops/step, last loss) of the jitted LM train step scan."""
-    import jax
+def stage_scan_inputs(cfg, steps):
+    """Pre-staged (xs tokens, adversary masks) for `steps` scanned steps —
+    the one source of truth for the LM timing/audit input protocol (also
+    imported by tools/tpu_lm_lowering_check.py)."""
     import jax.numpy as jnp
     import numpy as np
 
-    import bench
     from draco_tpu import rng as drng
     from draco_tpu.parallel.sp_step import synthetic_text
-    from draco_tpu.parallel.tp_step import build_tp_train_setup
-    from draco_tpu.utils.timing import time_scanned_steps
 
-    setup = build_tp_train_setup(cfg, mesh)
     adv = drng.adversary_schedule(cfg.seed, steps + 1, cfg.num_workers,
                                   cfg.num_adversaries)
     xs = jnp.asarray(np.stack([
@@ -56,6 +53,13 @@ def run_lm(cfg, mesh, steps, warmup=1, reps=2):
         for s in range(1, steps + 1)
     ]))
     ms = jnp.asarray(np.stack([np.asarray(adv[s]) for s in range(1, steps + 1)]))
+    return xs, ms
+
+
+def make_scan_loop(setup):
+    """The scanned multi-step train loop the timing protocol jits — shared
+    with the lowering audit so both always export/compile the same program."""
+    import jax
 
     def loop(state, xs, ms):
         def body(st, batch):
@@ -63,6 +67,22 @@ def run_lm(cfg, mesh, steps, warmup=1, reps=2):
             st, metrics = setup.train_step(st, toks, mask)
             return st, metrics["loss"]
         return jax.lax.scan(body, state, (xs, ms))
+
+    return loop
+
+
+def run_lm(cfg, mesh, steps, warmup=1, reps=2):
+    """(ms/step, flops/step, last loss) of the jitted LM train step scan."""
+    import jax
+    import numpy as np
+
+    import bench
+    from draco_tpu.parallel.tp_step import build_tp_train_setup
+    from draco_tpu.utils.timing import time_scanned_steps
+
+    setup = build_tp_train_setup(cfg, mesh)
+    xs, ms = stage_scan_inputs(cfg, steps)
+    loop = make_scan_loop(setup)
 
     with mesh:
         compiled = jax.jit(loop).lower(setup.state, xs, ms).compile()
@@ -82,6 +102,44 @@ def run_lm(cfg, mesh, steps, warmup=1, reps=2):
         compiled, setup.state, (xs, ms), steps=steps, warmup=warmup, reps=reps
     )
     return dt * 1e3, flops, float(np.asarray(jax.device_get(losses))[-1])
+
+
+def build_lm_variants(*, batch_size, num_workers, seq_len, vocab, model_dim,
+                      model_heads, model_layers, remat, max_steps):
+    """The canonical LM benchmark variant configs (one source of truth —
+    also imported by tools/tpu_lm_lowering_check.py so the offline lowering
+    audit can never drift from what this tool measures on chip)."""
+    common = dict(
+        network="TransformerLM", dataset="synthetic-text",
+        batch_size=batch_size, lr=0.01, momentum=0.9,
+        num_workers=num_workers, worker_fail=1, err_mode="rev_grad",
+        seq_len=seq_len, vocab=vocab, model_dim=model_dim,
+        model_heads=model_heads, model_layers=model_layers,
+        compute_dtype="bfloat16", remat=remat,
+        max_steps=max_steps, eval_freq=0,
+        train_dir="", log_every=10**9,
+    )
+    return {
+        # redundancy must be EXPLICIT here: the LM paths honour it now
+        # (parallel/tp_step.py simulate lanes); the shared variant would
+        # otherwise silently inherit the config default "simulate"
+        "lm_cyclic_s1_shared_bf16": dict(common, approach="cyclic",
+                                         redundancy="shared"),
+        # reference-parity r=2s+1 redundant compute at LM scale
+        # (cyclic_worker.py:122-146) — the r-cost VERDICT r2 item 6 asks for
+        "lm_cyclic_s1_simulate_bf16": dict(common, approach="cyclic",
+                                           redundancy="simulate"),
+        # the same coded step with the Pallas flash kernel in place of
+        # dense attention — the long-context hot-op on the training path
+        "lm_cyclic_s1_shared_bf16_flash": dict(common, approach="cyclic",
+                                               redundancy="shared",
+                                               attn_impl="flash"),
+        "lm_geomedian_bf16": dict(common, approach="baseline",
+                                  mode="geometric_median"),
+        "lm_krum_bf16": dict(common, approach="baseline", mode="krum"),
+        "lm_mean_no_attack_bf16": dict(common, approach="baseline",
+                                       mode="normal", worker_fail=0),
+    }
 
 
 def main(argv=None) -> int:
@@ -118,37 +176,12 @@ def main(argv=None) -> int:
     dev = jax.devices()[0]
     n_dev = mesh.devices.size
 
-    common = dict(
-        network="TransformerLM", dataset="synthetic-text",
-        batch_size=args.batch_size, lr=0.01, momentum=0.9,
-        num_workers=args.num_workers, worker_fail=1, err_mode="rev_grad",
+    variants = build_lm_variants(
+        batch_size=args.batch_size, num_workers=args.num_workers,
         seq_len=args.seq_len, vocab=args.vocab, model_dim=args.model_dim,
         model_heads=args.model_heads, model_layers=args.model_layers,
-        compute_dtype="bfloat16", remat=args.remat,
-        max_steps=args.steps + 1, eval_freq=0,
-        train_dir="", log_every=10**9,
+        remat=args.remat, max_steps=args.steps + 1,
     )
-    variants = {
-        # redundancy must be EXPLICIT here: the LM paths honour it now
-        # (parallel/tp_step.py simulate lanes); the shared variant would
-        # otherwise silently inherit the config default "simulate"
-        "lm_cyclic_s1_shared_bf16": dict(common, approach="cyclic",
-                                         redundancy="shared"),
-        # reference-parity r=2s+1 redundant compute at LM scale
-        # (cyclic_worker.py:122-146) — the r-cost VERDICT r2 item 6 asks for
-        "lm_cyclic_s1_simulate_bf16": dict(common, approach="cyclic",
-                                           redundancy="simulate"),
-        # the same coded step with the Pallas flash kernel in place of
-        # dense attention — the long-context hot-op on the training path
-        "lm_cyclic_s1_shared_bf16_flash": dict(common, approach="cyclic",
-                                               redundancy="shared",
-                                               attn_impl="flash"),
-        "lm_geomedian_bf16": dict(common, approach="baseline",
-                                  mode="geometric_median"),
-        "lm_krum_bf16": dict(common, approach="baseline", mode="krum"),
-        "lm_mean_no_attack_bf16": dict(common, approach="baseline",
-                                       mode="normal", worker_fail=0),
-    }
 
     if args.variants:
         keep = {v.strip() for v in args.variants.split(",")}
