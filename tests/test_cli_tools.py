@@ -375,3 +375,40 @@ def test_lm_time_to_loss_tool(tmp_path):
     assert cyc["final_eval_loss"] < mean["final_eval_loss"]
     walls = [c["train_wall_s"] for c in cyc["curve"]]
     assert walls == sorted(walls)
+
+
+def test_lm_lowering_audit_matches_r5_rung():
+    """Drift guard (r5 review): the offline lowering audit hardcodes the
+    lm_big rung shapes because the chain script cannot be edited while it
+    runs — so this test is the sync mechanism. If either side changes, it
+    fails and points at the other."""
+    import re
+
+    from tools.tpu_lm_lowering_check import (
+        LM_BIG, LM_BIG_VARIANTS_B1, LM_BIG_VARIANTS_B2,
+    )
+
+    sh = open(os.path.join(os.path.dirname(__file__), "..",
+                           "tools", "chip_jobs_r5.sh")).read()
+    m = re.search(r"rung lm_big .*?'(.*?)'", sh, re.S)
+    assert m, "lm_big rung not found in chip_jobs_r5.sh"
+    rung = m.group(1)
+
+    def flag(name, text):
+        fm = re.search(rf"--{name}\s+(\S+)", text)
+        return fm and fm.group(1)
+
+    legs = rung.split("&&")
+    assert len(legs) == 2, "expected the b=2 leg and the b=1 simulate leg"
+    for leg, bsz, variants in ((legs[0], "2", LM_BIG_VARIANTS_B2),
+                               (legs[1], "1", LM_BIG_VARIANTS_B1)):
+        assert flag("model-dim", leg) == str(LM_BIG["model_dim"])
+        assert flag("model-heads", leg) == str(LM_BIG["model_heads"])
+        assert flag("model-layers", leg) == str(LM_BIG["model_layers"])
+        assert flag("seq-len", leg) == str(LM_BIG["seq_len"])
+        assert flag("batch-size", leg) == bsz
+        assert "--remat" in leg
+        # steps+1 == max_steps (run_lm convention)
+        assert int(flag("steps", leg)) + 1 == LM_BIG["max_steps"]
+        got = set(flag("variants", leg).split(","))
+        assert got >= set(variants), (got, variants)

@@ -1,0 +1,56 @@
+"""Shared scaffolding for the offline TPU-lowering audit tools (round 5).
+
+Three tools prove chip-queued programs clean against the Pallas/StableHLO
+TPU lowering stack without a chip (tpu_attn_lowering_check,
+tpu_lm_lowering_check, tpu_parallel_lowering_check); the env bootstrap and
+the incremental per-row report loop live here so a fix to the pattern is
+made once. Methodology and the negative control proving the lowering
+checks are actually exercised: tools/tpu_attn_lowering_check.py.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+
+def setup_cpu_host(device_count: int) -> None:
+    """Force a CPU host with `device_count` virtual devices. MUST run
+    before the first jax import in the process; jax_platforms is then
+    latched via jax.config (the env var alone is read too late under this
+    image's sitecustomize — .claude/skills/verify/SKILL.md)."""
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={device_count}"
+    )
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def run_rows(out_path: str, method: str, named_rows, extra=None):
+    """Drive (name, thunk) pairs, rewriting the report after EVERY row so an
+    interrupt keeps finished rows (the repo's incremental-artifact
+    discipline). Each thunk returns a dict with at least {"ok": bool}.
+    Returns the report; all_ok covers the rows run so far."""
+    report = {"method": method, "all_ok": None, "rows": []}
+    if extra:
+        report.update(extra)
+    os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+    for name, thunk in named_rows:
+        try:
+            row = thunk()
+        except Exception as e:  # a row crash must not lose earlier rows
+            row = {"ok": False,
+                   "error": f"{type(e).__name__}: {str(e)[:400]}"}
+        row = {"name": name, **row}
+        report["rows"].append(row)
+        report["all_ok"] = all(r["ok"] for r in report["rows"])
+        with open(out_path, "w") as fh:
+            json.dump(report, fh, indent=1)
+        import sys
+
+        print(f"[lowering] {name}: "
+              f"{'ok' if row['ok'] else row.get('error', '?')[:120]}",
+              file=sys.stderr, flush=True)
+    return report

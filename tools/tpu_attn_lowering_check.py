@@ -42,11 +42,10 @@ def main(argv=None) -> int:
     ap.add_argument("--seq-lens", type=str, default="256,1024,2048,4096")
     args = ap.parse_args(argv)
 
-    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
-                               + " --xla_force_host_platform_device_count=1")
-    import jax
+    from tools._lowering_common import setup_cpu_host
 
-    jax.config.update("jax_platforms", "cpu")
+    setup_cpu_host(1)
+    import jax
     import jax.export
     import jax.numpy as jnp
     from jax.experimental import pallas as pl

@@ -72,6 +72,17 @@ def audit_variant(name, cfg_kw, steps=2):
                 "error": f"{type(e).__name__}: {str(e)[:400]}"}
 
 
+# The lm_big rung shapes, asserted in CI against the chip_jobs_r5.sh rung
+# text (tests/test_cli_tools.py::test_lm_lowering_audit_matches_r5_rung) —
+# the chain script cannot be edited while it runs, so drift is caught by
+# the test rather than by sharing code with bash.
+LM_BIG = dict(num_workers=8, seq_len=2048, vocab=8192, model_dim=1024,
+              model_heads=16, model_layers=12, remat=True, max_steps=5)
+LM_BIG_VARIANTS_B2 = ("lm_cyclic_s1_shared_bf16_flash",
+                      "lm_cyclic_s1_shared_bf16", "lm_geomedian_bf16")
+LM_BIG_VARIANTS_B1 = ("lm_cyclic_s1_simulate_bf16",)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", type=str,
@@ -80,50 +91,26 @@ def main(argv=None) -> int:
 
     # ONE virtual device: the chip folds all logical workers onto a single
     # device and the audit must lower that exact layout (docstring)
-    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
-                               + " --xla_force_host_platform_device_count=1")
-    import jax
+    from tools._lowering_common import run_rows, setup_cpu_host
 
-    jax.config.update("jax_platforms", "cpu")
+    setup_cpu_host(1)
 
     from tools.tpu_lm_perf import build_lm_variants
 
-    # EXACT chip_jobs_r5.sh lm_big rung shapes, via the shared constructor
-    big = dict(num_workers=8, seq_len=2048, vocab=8192, model_dim=1024,
-               model_heads=16, model_layers=12, remat=True, max_steps=5)
-    v_b2 = build_lm_variants(batch_size=2, **big)
-    v_b1 = build_lm_variants(batch_size=1, **big)
-    variants = [
-        ("lm_cyclic_s1_shared_bf16_flash", v_b2["lm_cyclic_s1_shared_bf16_flash"]),
-        ("lm_cyclic_s1_shared_bf16", v_b2["lm_cyclic_s1_shared_bf16"]),
-        ("lm_geomedian_bf16", v_b2["lm_geomedian_bf16"]),
-        ("lm_cyclic_s1_simulate_bf16", v_b1["lm_cyclic_s1_simulate_bf16"]),
-    ]
-
-    report = {
-        "method": "jax.export cross-platform lowering, platforms=['tpu'], "
-                  "CPU host with ONE virtual device (the chip's folded "
-                  "layout), full scanned train-step programs at the exact "
-                  "chip_jobs_r5.sh lm_big rung shapes, configs imported "
-                  "from tools/tpu_lm_perf.py",
-        "all_ok": None,
-        "rows": [],
-    }
-    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
-
-    def flush():
-        with open(args.out, "w") as fh:
-            json.dump(report, fh, indent=1)
-
-    for name, kw in variants:
-        row = audit_variant(name, kw)
-        report["rows"].append(row)
-        report["all_ok"] = all(r["ok"] for r in report["rows"])
-        flush()
-        print(f"[lm_lowering] {name}: "
-              f"{'ok' if row['ok'] else row['error'][:120]} "
-              f"({row['seconds']}s)", file=sys.stderr, flush=True)
-
+    v_b2 = build_lm_variants(batch_size=2, **LM_BIG)
+    v_b1 = build_lm_variants(batch_size=1, **LM_BIG)
+    named = ([(n, (lambda n=n: audit_variant(n, v_b2[n])))
+              for n in LM_BIG_VARIANTS_B2]
+             + [(n, (lambda n=n: audit_variant(n, v_b1[n])))
+                for n in LM_BIG_VARIANTS_B1])
+    report = run_rows(
+        args.out,
+        "jax.export cross-platform lowering, platforms=['tpu'], CPU host "
+        "with ONE virtual device (the chip's folded layout), full scanned "
+        "train-step programs at the exact chip_jobs_r5.sh lm_big rung "
+        "shapes, configs imported from tools/tpu_lm_perf.py",
+        named,
+    )
     print(json.dumps({"all_ok": report["all_ok"]}))
     return 0 if report["all_ok"] else 1
 
