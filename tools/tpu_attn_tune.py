@@ -1,0 +1,193 @@
+#!/usr/bin/env python
+"""Flash-attention block-size autotune on real hardware.
+
+Sweeps (block_q, block_k) over a grid at one (T, B, H, Dh) point and times
+fwd and fwd+bwd for each, plus the XLA dense path and jax's bundled TPU
+flash op as yardsticks. The kernel ships with 128x128 defaults chosen for
+lowering safety, not measured speed; this tool finds whether bigger blocks
+(fewer grid steps, more VMEM per step) buy anything on the actual chip.
+
+Parity per config is asserted against the dense streaming-softmax oracle
+when it fits, else against the 128x128 kernel output (all configs compute
+the same math; a mis-tiled config raises at lowering, not silently).
+
+Writes --out (default baselines_out/tpu_attn_tune.json) after every row,
+so a tunnel loss keeps finished rows (decode_study r3 precedent).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", type=str,
+                    default="baselines_out/tpu_attn_tune.json")
+    ap.add_argument("--seq-len", type=int, default=2048)
+    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--heads", type=int, default=12)
+    ap.add_argument("--head-dim", type=int, default=64)
+    ap.add_argument("--dtype", type=str, default="float32",
+                    choices=["float32", "bfloat16"])
+    ap.add_argument("--blocks-q", type=str, default="128,256,512")
+    ap.add_argument("--blocks-k", type=str, default="128,256,512")
+    ap.add_argument("--reps", type=int, default=10)
+    ap.add_argument("--cpu-interpret", action="store_true",
+                    help="smoke: run tiny shapes in interpret mode on CPU")
+    args = ap.parse_args(argv)
+
+    from draco_tpu.cli import maybe_force_cpu_mesh
+
+    maybe_force_cpu_mesh(args)
+
+    import jax
+
+    if args.cpu_interpret:
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from draco_tpu.ops.flash_attention import flash_attention
+    from draco_tpu.parallel.ring_attention import dense_attention
+    from draco_tpu.utils.timing import timeit_chained
+
+    t, b, h, dh = args.seq_len, args.batch, args.heads, args.head_dim
+    r = np.random.RandomState(0)
+    dt = jnp.dtype(args.dtype)
+    q = jnp.asarray(r.normal(size=(b, t, h, dh)).astype(np.float32)).astype(dt)
+    k = jnp.asarray(r.normal(size=(b, t, h, dh)).astype(np.float32)).astype(dt)
+    v = jnp.asarray(r.normal(size=(b, t, h, dh)).astype(np.float32)).astype(dt)
+
+    dev = jax.devices()[0]
+    report = {
+        "platform": dev.platform,
+        "device_kind": getattr(dev, "device_kind", dev.platform),
+        "seq_len": t, "batch": b, "heads": h, "head_dim": dh,
+        "dtype": args.dtype,
+        "rows": [],
+    }
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+
+    def save():
+        with open(args.out, "w") as fh:
+            json.dump(report, fh, indent=1)
+
+    def fwd_step(attn):
+        def step(qc, k, v):
+            o = attn(qc, k, v)
+            return qc + (1e-30 * jnp.sum(o.astype(jnp.float32) ** 2)).astype(
+                qc.dtype)
+        return step
+
+    def fb_step(attn):
+        g = jax.grad(
+            lambda q, k, v: jnp.sum(jnp.sin(attn(q, k, v).astype(jnp.float32))),
+            argnums=0)
+
+        def step(qc, k, v):
+            return qc + (1e-30 * g(qc, k, v).astype(jnp.float32) ** 2).astype(
+                qc.dtype)
+        return step
+
+    # reference output for parity: dense oracle if it fits, else 128x128
+    ref_name, o_ref = "dense", None
+    try:
+        o_ref = jax.jit(
+            lambda q, k, v: dense_attention(q, k, v, causal=True))(q, k, v)
+        o_ref = jax.block_until_ready(o_ref)
+        report["parity_reference"] = "dense"
+    except Exception:
+        ref_name = "flash_128x128"
+        report["parity_reference"] = ref_name
+
+    tol = 5e-2 if args.dtype == "bfloat16" else 5e-3
+
+    for bq in [int(x) for x in args.blocks_q.split(",")]:
+        for bk in [int(x) for x in args.blocks_k.split(",")]:
+            if t % bq or t % bk:
+                continue
+            rec = {"block_q": bq, "block_k": bk}
+            print(f"[tune] bq={bq} bk={bk} ...", file=sys.stderr, flush=True)
+            try:
+                attn = lambda q, k, v: flash_attention(
+                    q, k, v, block_q=bq, block_k=bk, force=True,
+                    interpret=args.cpu_interpret)
+                o = jax.block_until_ready(jax.jit(attn)(q, k, v))
+                if o_ref is None and bq == bk == 128:
+                    o_ref = o
+                if o_ref is not None:
+                    err = float(jnp.max(jnp.abs(
+                        o.astype(jnp.float32) - o_ref.astype(jnp.float32))))
+                    rec["max_abs_err_vs_" + ref_name] = err
+                    rec["parity_ok"] = bool(err < tol)
+                rec["fwd_ms"] = round(
+                    timeit_chained(fwd_step(attn), q, (k, v),
+                                   reps=args.reps) * 1e3, 3)
+                rec["fwdbwd_ms"] = round(
+                    timeit_chained(fb_step(attn), q, (k, v),
+                                   reps=args.reps) * 1e3, 3)
+            except Exception as e:
+                rec["error"] = f"{type(e).__name__}: {e}"[:2500]
+            print(f"[tune] {json.dumps(rec)}", file=sys.stderr, flush=True)
+            report["rows"].append(rec)
+            save()
+
+    # yardsticks
+    try:
+        rec = {"yardstick": "dense"}
+        rec["fwd_ms"] = round(
+            timeit_chained(fwd_step(
+                lambda q, k, v: dense_attention(q, k, v, causal=True)),
+                q, (k, v), reps=args.reps) * 1e3, 3)
+        rec["fwdbwd_ms"] = round(
+            timeit_chained(fb_step(
+                lambda q, k, v: dense_attention(q, k, v, causal=True)),
+                q, (k, v), reps=args.reps) * 1e3, 3)
+        report["rows"].append(rec)
+    except Exception as e:
+        report["rows"].append(
+            {"yardstick": "dense", "error": f"{type(e).__name__}: {e}"[:800]})
+    save()
+    try:
+        if args.cpu_interpret:
+            raise RuntimeError("jaxref yardstick skipped in CPU smoke")
+        from jax.experimental.pallas.ops.tpu.flash_attention import (
+            flash_attention as jax_flash,
+        )
+        scale = 1.0 / (dh ** 0.5)
+        qh, kh, vh = (jnp.moveaxis(x, 2, 1) for x in (q, k, v))
+        ref = lambda q, k, v: jax_flash(q, k, v, causal=True, sm_scale=scale)
+        rec = {"yardstick": "jaxref"}
+        rec["fwd_ms"] = round(
+            timeit_chained(fwd_step(ref), qh, (kh, vh),
+                           reps=args.reps) * 1e3, 3)
+        rec["fwdbwd_ms"] = round(
+            timeit_chained(fb_step(ref), qh, (kh, vh),
+                           reps=args.reps) * 1e3, 3)
+        report["rows"].append(rec)
+    except Exception as e:
+        report["rows"].append(
+            {"yardstick": "jaxref", "error": f"{type(e).__name__}: {e}"[:800]})
+    save()
+
+    flash_rows = [r for r in report["rows"]
+                  if "fwdbwd_ms" in r and "block_q" in r
+                  and r.get("parity_ok", True)]
+    if flash_rows:
+        best = min(flash_rows, key=lambda r: r["fwdbwd_ms"])
+        report["best"] = {"block_q": best["block_q"],
+                          "block_k": best["block_k"],
+                          "fwdbwd_ms": best["fwdbwd_ms"]}
+        save()
+    print(json.dumps(report))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
