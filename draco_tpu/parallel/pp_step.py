@@ -158,7 +158,11 @@ def build_pp_train_setup(cfg: TrainConfig, mesh) -> PPTrainSetup:
     if cfg.batch_size % M:
         raise ValueError(f"microbatches {M} must divide batch_size {cfg.batch_size}")
     mb = cfg.batch_size // M
-    t_in = cfg.seq_len - 1  # next-token objective: inputs are tokens[:-1]
+    # the pipeline carries all T positions and the loss drops the last
+    # logit row (identical next-token math — causal rows < T-1 cannot see
+    # token T-1); a T-1 carry would break the flash kernel's t%8 tiling
+    # (1023 at T=1024) and silently ride the dense fallback
+    t_in = cfg.seq_len
 
     cdtype = jnp.dtype(cfg.compute_dtype)
     from draco_tpu.ops.flash_attention import attn_impl_fn
@@ -221,7 +225,7 @@ def build_pp_train_setup(cfg: TrainConfig, mesh) -> PPTrainSetup:
         return jax.vmap(_lane_loss)(params_n_local, tokens_local)
 
     def _lane_loss(p, toks):
-        inp, tgt = toks[:, :-1], toks[:, 1:]
+        inp, tgt = toks, toks[:, 1:]
         my = lax.axis_index(PP_AXIS)
         positions = jnp.arange(t_in)
 
@@ -269,8 +273,8 @@ def build_pp_train_setup(cfg: TrainConfig, mesh) -> PPTrainSetup:
         h = final_ln.apply({"params": p["final_ln"]},
                            outs.astype(jnp.float32))
         logits = embed.apply({"params": p["embed"]}, h, method="attend")
-        logp = jax.nn.log_softmax(logits.astype(jnp.float32))
-        tgt_mb = tgt.reshape(M, mb, t_in)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32))[:, :, :-1]
+        tgt_mb = tgt.reshape(M, mb, t_in - 1)
         nll = -jnp.take_along_axis(logp, tgt_mb[..., None], axis=-1)[..., 0]
         loss = jnp.where(my == S - 1, jnp.mean(nll), 0.0)
         return lax.psum(loss, PP_AXIS)

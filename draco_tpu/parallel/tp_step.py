@@ -163,8 +163,25 @@ def _build_gspmd_train_setup(cfg: TrainConfig, mesh, *, mp_axis: str,
     )
 
     def lane_loss(params, toks, train: bool):
-        """Whole-sequence next-token CE for one worker's (B, T) batch."""
-        logits = model.apply({"params": params}, toks[:, :-1], train=train)
+        """Whole-sequence next-token CE for one worker's (B, T) batch.
+
+        The model sees all T tokens and the last logit row is discarded
+        (identical math on the dense/flash attention paths: causal row i
+        attends keys <= i, so rows < T-1 cannot see token T-1). Feeding
+        toks[:, :-1] instead would hand the attention a T-1-length
+        sequence (1023 at T=1024), which fails the flash kernel's t%8
+        tiling and silently rode the dense fallback — the kernel never
+        actually ran on the LM path before this.
+
+        Deliberate deviation when moe_experts > 0: Switch capacity
+        routing (models/moe.py) is cross-token over the flattened B*T
+        stream, so the now-included last-position tokens compete for
+        arrival-order capacity slots. cap = int(1.25*n_tok/e) scales with
+        the stream, so capacity pressure is ~unchanged, but individual
+        evictions can differ from the pre-change B*(T-1) stream — a
+        routing-statistics perturbation of order 1/T, not an objective
+        change (and matches inference, where the last token routes too)."""
+        logits = model.apply({"params": params}, toks, train=train)[:, :-1]
         logp = jax.nn.log_softmax(logits.astype(jnp.float32))
         nll = -jnp.take_along_axis(logp, toks[:, 1:, None], axis=-1)[..., 0]
         return jnp.mean(nll)
