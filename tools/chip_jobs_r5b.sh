@@ -1,0 +1,148 @@
+#!/bin/bash
+# Round-5 follow-up chip chain: work discovered AFTER the r5 evidence
+# ladder launched. Waits for the ladder (chip_jobs_r5.sh) to finish before
+# touching the one-client tunnel, then runs, in order:
+#   1 lm_flash_fixed   re-measure LM flash-vs-dense at T=1024 with the
+#                      full-T fix (the ladder's lm_flash rung measured the
+#                      pre-fix code, whose "flash" variant silently rode
+#                      the dense fallback at t=1023 — both its columns are
+#                      the dense path; parallel/tp_step.py fix, commit
+#                      69ae479)
+#   2 vote_exact       rep-resnet18 with --vote-check exact: the same-code
+#                      same-round counterpart to the ladder's fingerprint
+#                      row, settling the O(r·d)-vs-exact chip question
+#                      without reaching across rounds
+#   3 attn_tune_f32    flash block-size grid at T=2048 f32 (the shipped
+#                      128x128 default was chosen for lowering safety;
+#                      the attn_full rung shows jaxref 1.5x faster fwd —
+#                      find whether bigger blocks close it)
+#   4 attn_tune_bf16   same grid in bf16 (the LM training dtype; the MXU
+#                      fast path changes the balance)
+#
+# Launch detached:
+#   setsid nohup bash tools/chip_jobs_r5b.sh > baselines_out/chip_jobs_r5b.log 2>&1 &
+# NEVER edit this file while it runs. Markers: baselines_out/.r5b_<rung>_done
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p baselines_out
+
+stamp() { date -u +"%Y-%m-%dT%H:%M:%SZ"; }
+
+commit_evidence() {
+  local msg="$1"
+  local files
+  shopt -s nullglob
+  files=(baselines_out/*.json baselines_out/*.jsonl baselines_out/*.log)
+  shopt -u nullglob
+  if [ "${#files[@]}" = 0 ]; then
+    echo "[r5b $(stamp)] no artifact files exist yet for: $msg"
+    return 0
+  fi
+  for i in 1 2 3; do
+    if ! git add -- "${files[@]}"; then
+      echo "[r5b $(stamp)] git add failed (attempt $i), retrying"
+      sleep 5
+      continue
+    fi
+    if git diff --cached --quiet -- baselines_out 2>/dev/null; then
+      echo "[r5b $(stamp)] nothing new to commit for: $msg"
+      return 0
+    fi
+    if git commit -q -m "$msg" -- baselines_out; then
+      echo "[r5b $(stamp)] committed: $msg"
+      return 0
+    fi
+    echo "[r5b $(stamp)] git commit failed (attempt $i), retrying"
+    sleep 5
+  done
+  echo "[r5b $(stamp)] WARNING: commit failed for: $msg (evidence still on disk)"
+  return 0
+}
+
+tpu_up() {
+  timeout -k 30 120 python - <<'EOF'
+import sys, jax
+try:
+    d = jax.devices()
+    sys.exit(0 if d and d[0].platform != "cpu" else 3)
+except Exception:
+    sys.exit(3)
+EOF
+}
+
+ladder_running() {
+  pgrep -f "bash tools/chip_jobs_r5.sh" > /dev/null 2>&1
+}
+
+# ---- wait for the main ladder to release the tunnel ----------------------
+echo "[r5b $(stamp)] waiting for chip_jobs_r5.sh to finish"
+while ladder_running; do
+  sleep 60
+done
+echo "[r5b $(stamp)] ladder gone; proceeding"
+
+ABORT_PASS=0
+FAILURES=0
+rung() {
+  local name="$1" msg="$2"; shift 2
+  local marker="baselines_out/.r5b_${name}_done"
+  if [ -f "$marker" ] || [ "$ABORT_PASS" = 1 ]; then
+    return 0
+  fi
+  echo "[r5b $(stamp)] ===== rung $name: $* ====="
+  local rc=0
+  "$@" || rc=$?
+  if [ "$rc" = 0 ]; then
+    touch "$marker"
+    commit_evidence "$msg"
+  else
+    echo "[r5b $(stamp)] rung $name FAILED (rc=$rc); probing tunnel"
+    commit_evidence "$msg (partial: rung exited rc=$rc)"
+    FAILURES=$((FAILURES + 1))
+    if ! tpu_up; then
+      echo "[r5b $(stamp)] tunnel down — aborting this pass, back to wait loop"
+      ABORT_PASS=1
+    fi
+  fi
+}
+
+all_done() {
+  for m in lm_flash_fixed vote_exact attn_tune_f32 attn_tune_bf16; do
+    [ -f "baselines_out/.r5b_${m}_done" ] || return 1
+  done
+  return 0
+}
+
+for outer in 1 2 3 4; do
+  echo "[r5b $(stamp)] ===== outer attempt $outer ====="
+  if all_done; then break; fi
+  tools/wait_tpu.sh 60 150 120 || { echo "[r5b $(stamp)] tunnel never came up this window"; continue; }
+  FAILURES=0
+  ABORT_PASS=0
+
+  rung lm_flash_fixed "chip evidence: LM flash-vs-dense T=1024 remeasured with the full-T fix" \
+    timeout -k 60 3600 python tools/tpu_lm_perf.py --steps 4 \
+      --variants lm_cyclic_s1_shared_bf16_flash,lm_cyclic_s1_shared_bf16 \
+      --seq-len 1024 --batch-size 4 --remat \
+      --out baselines_out/tpu_lm_perf_flash.json
+
+  rung vote_exact "chip evidence: rep-resnet18 with vote_check=exact (same-round fingerprint counterpart)" \
+    timeout -k 60 2400 python tools/run_baselines.py --max-steps 12 --protocol scan \
+      --only rep-resnet18 --vote-check exact
+
+  rung attn_tune_f32 "chip evidence: flash block-size grid T=2048 f32" \
+    timeout -k 60 3600 python tools/tpu_attn_tune.py --seq-len 2048 \
+      --dtype float32 --out baselines_out/tpu_attn_tune_f32.json
+
+  rung attn_tune_bf16 "chip evidence: flash block-size grid T=2048 bf16" \
+    timeout -k 60 3600 python tools/tpu_attn_tune.py --seq-len 2048 \
+      --dtype bfloat16 --out baselines_out/tpu_attn_tune_bf16.json
+
+  if all_done; then
+    echo "[r5b $(stamp)] FOLLOW-UP COMPLETE"
+    break
+  fi
+  echo "[r5b $(stamp)] incomplete ($FAILURES rung failures this pass); retrying"
+  sleep 120
+done
+all_done && exit 0 || exit 1
