@@ -36,7 +36,7 @@ def main(argv=None) -> int:
     ap.add_argument("--dtype", type=str, default="float32",
                     choices=["float32", "bfloat16"])
     ap.add_argument("--blocks-q", type=str, default="128,256,512")
-    ap.add_argument("--blocks-k", type=str, default="128,256,512")
+    ap.add_argument("--blocks-k", type=str, default="128,256,512,1024")
     ap.add_argument("--reps", type=int, default=10)
     ap.add_argument("--cpu-interpret", action="store_true",
                     help="smoke: run tiny shapes in interpret mode on CPU")
