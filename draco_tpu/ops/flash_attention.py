@@ -43,6 +43,32 @@ def _ceil_to(x: int, m: int) -> int:
     return (x + m - 1) // m * m
 
 
+def _kv_residency_map(bq: int, bk: int, causal: bool):
+    """Index map for K/V-row input blocks on a (g, <q-block>, <k-block>)
+    grid. Causal: clamp at the diagonal — the kernels' pl.when already
+    skips compute for j > (i*bq + bq - 1)//bk (the largest k-block with any
+    q_pos >= k_pos entry), but without the clamp Mosaic still DMAs those
+    future blocks from HBM every step (~2x the causal pass's traffic).
+    Repeating the boundary index instead makes consecutive skipped steps
+    fetch nothing (Mosaic elides copies when the block index is unchanged).
+    The clamp is the identity on every computed block, so outputs are
+    untouched; keep this formula in lockstep with the kernels' guards."""
+    if not causal:
+        return lambda g, i, j: (g, j, 0)
+    return lambda g, i, j: (g, jnp.minimum(j, (i * bq + bq - 1) // bk), 0)
+
+
+def _q_residency_map(bq: int, bk: int, causal: bool):
+    """Index map for Q-row input blocks (q, do, per-row stats) on the dk/dv
+    grid (g, <k-block>, <q-block>). Causal: the sweep only computes from the
+    first diagonal-touching q block, i_min = (j*bk)//bq — which equals
+    ceil((j*bk - bq + 1)/bq), the smallest i with i*bq + bq - 1 >= j*bk —
+    so clamp residency there (same elision mechanics as _kv_residency_map)."""
+    if not causal:
+        return lambda g, j, i: (g, i, 0)
+    return lambda g, j, i: (g, jnp.maximum(i, (j * bk) // bq), 0)
+
+
 def _cols(stat, ncols):
     """Widen a lane-broadcast (bq, _LANE) row statistic to ncols columns.
 
@@ -122,13 +148,14 @@ def _flash_fwd(q, k, v, scale, bq, bk, causal, interpret):
     nq, nk = t // bq, t // bk
     grid = (g, nq, nk)
     kern = functools.partial(_fwd_kernel, scale, nk, bq, bk, causal)
+    kv_row = _kv_residency_map(bq, bk, causal)
     o, lse = pl.pallas_call(
         kern,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, bq, dh), lambda g, i, j: (g, i, 0)),
-            pl.BlockSpec((1, bk, dh), lambda g, i, j: (g, j, 0)),
-            pl.BlockSpec((1, bk, dh), lambda g, i, j: (g, j, 0)),
+            pl.BlockSpec((1, bk, dh), kv_row),
+            pl.BlockSpec((1, bk, dh), kv_row),
         ],
         out_specs=[
             pl.BlockSpec((1, bq, dh), lambda g, i, j: (g, i, 0)),
@@ -273,8 +300,7 @@ def _flash_bwd(q, k, v, o, lse, do, dlse, scale, bq, bk, causal, interpret):
     def q_row(g, i, j):
         return (g, i, 0)
 
-    def k_row(g, i, j):
-        return (g, j, 0)
+    k_row = _kv_residency_map(bq, bk, causal)
 
     stat_specs = [pl.BlockSpec((1, bq, _LANE), q_row)] * len(stats)
     dq = pl.pallas_call(
@@ -296,8 +322,7 @@ def _flash_bwd(q, k, v, o, lse, do, dlse, scale, bq, bk, causal, interpret):
         interpret=interpret,
     )(q, k, v, do, *stats)
 
-    def q_row2(g, j, i):
-        return (g, i, 0)
+    q_row2 = _q_residency_map(bq, bk, causal)
 
     def k_row2(g, j, i):
         return (g, j, 0)
