@@ -43,6 +43,24 @@ def _ceil_to(x: int, m: int) -> int:
     return (x + m - 1) // m * m
 
 
+def _fit_block(limit: int, t: int, lane_rule: bool) -> int:
+    """Largest legal block size <= limit for a length-t axis: must divide t,
+    be a multiple of the 8-row sublane tile, and (key blocks only,
+    lane_rule=True) be a whole number of 128-wide lane tiles when wider
+    than one. A plain min(limit, t) would demote every t not divisible by
+    the default (e.g. t=1536 with bk=1024) to the dense fallback — the
+    shrink keeps every t%8==0 length kernel-eligible at the biggest block
+    the shape allows (t=768 -> 256 under a 1024 limit). Returns 0 when no
+    legal block exists (t%8 != 0); _kernel_eligible then rejects."""
+    b = min(limit, t)
+    b -= b % 8
+    while b >= 8:
+        if t % b == 0 and (not lane_rule or b <= _LANE or b % _LANE == 0):
+            return b
+        b -= 8
+    return 0
+
+
 def _kv_residency_map(bq: int, bk: int, causal: bool):
     """Index map for K/V-row input blocks on a (g, <q-block>, <k-block>)
     grid. Causal: clamp at the diagonal — the kernels' pl.when already
@@ -409,7 +427,7 @@ _flash_core_lse.defvjp(_flash_core_lse_fwd, _flash_core_lse_bwd)
 # public entry — AttnFn contract of models/transformer.Block
 # ---------------------------------------------------------------------------
 
-def flash_attention(q, k, v, *, block_q: int = 128, block_k: int = 128,
+def flash_attention(q, k, v, *, block_q: int = 512, block_k: int = 1024,
                     force=None, interpret: bool = False):
     """Causal self-attention. q, k, v: (B, T, H, Dh) — the Block contract
     (attention math upstream is f32; the kernel accumulates f32 regardless).
@@ -422,8 +440,8 @@ def flash_attention(q, k, v, *, block_q: int = 128, block_k: int = 128,
     from draco_tpu.parallel.ring_attention import dense_attention
 
     b, t, h, dh = q.shape
-    bq = min(block_q, t)
-    bk = min(block_k, t)
+    bq = _fit_block(block_q, t, lane_rule=False)
+    bk = _fit_block(block_k, t, lane_rule=True)
     if not _kernel_eligible(t, bq, bk, dh, force, interpret):
         return dense_attention(q, k, v, causal=True)
     return _run_folded(q, k, v, bq, bk, True, interpret, want_lse=False)
@@ -438,8 +456,10 @@ def _kernel_eligible(t, bq, bk, dh, force, interpret) -> bool:
     demanded the O(T·Dh)-memory kernel must not silently get the O(T²)
     dense path (advisor r2); a TPU caller falling back warns once."""
     use = force if force is not None else (use_pallas() or interpret)
-    tiling_fail = bool(t % 8 or bq % 8 or bk % 8 or t % bq or t % bk
-                       or dh > _LANE or (bk > _LANE and bk % _LANE))
+    tiling_fail = bool(
+        bq < 8 or bk < 8  # _fit_block found no legal block (t % 8 != 0)
+        or t % 8 or bq % 8 or bk % 8 or t % bq or t % bk
+        or dh > _LANE or (bk > _LANE and bk % _LANE))
     if use and not tiling_fail:
         return True
     constraints = (
@@ -489,7 +509,7 @@ def _run_folded(q, k, v, bq, bk, causal, interpret, want_lse):
 
 
 def flash_attention_with_lse(q, k, v, *, causal: bool = True,
-                             block_q: int = 128, block_k: int = 128,
+                             block_q: int = 512, block_k: int = 1024,
                              force=None, interpret: bool = False):
     """(o, lse) pair for the ring composition (parallel/ring_attention.
     ring_flash_attention): lse is the per-row log-sum-exp in (B, T, H), and
@@ -499,8 +519,8 @@ def flash_attention_with_lse(q, k, v, *, causal: bool = True,
     from draco_tpu.parallel.ring_attention import dense_attention_lse
 
     b, t, h, dh = q.shape
-    bq = min(block_q, t)
-    bk = min(block_k, t)
+    bq = _fit_block(block_q, t, lane_rule=False)
+    bk = _fit_block(block_k, t, lane_rule=True)
     if not _kernel_eligible(t, bq, bk, dh, force, interpret):
         return dense_attention_lse(q, k, v, causal=causal)
     return _run_folded(q, k, v, bq, bk, causal, interpret, want_lse=True)

@@ -222,6 +222,37 @@ def test_interpret_fallback_warns_once(rng):
         flash_attention(q, k, v, interpret=True)
 
 
+def test_fit_block_keeps_non_default_lengths_eligible():
+    """The tuned defaults (bq=512, bk=1024) must not demote lengths that
+    tiled under the old 128-block defaults: _fit_block shrinks to the
+    largest block that divides t (sublane- and lane-tile legal), so e.g.
+    t=768/1536/2560 stay kernel-eligible instead of silently riding the
+    dense fallback (r5 review finding)."""
+    for t, want_bq, want_bk in [(768, 384, 768), (1536, 512, 768),
+                                (2560, 512, 640), (2048, 512, 1024),
+                                (256, 256, 256)]:
+        bq = fa._fit_block(512, t, lane_rule=False)
+        bk = fa._fit_block(1024, t, lane_rule=True)
+        assert (bq, bk) == (want_bq, want_bk), (t, bq, bk)
+        assert fa._kernel_eligible(t, bq, bk, 64, True, False)
+    # no legal block => 0, and eligibility rejects instead of dividing by 0
+    assert fa._fit_block(512, 12, lane_rule=False) == 0
+    with pytest.raises(ValueError, match="does not tile"):
+        flash_attention(*_qkv(np.random.RandomState(0), t=12, dh=64)[:3],
+                        force=True)
+
+
+def test_default_blocks_parity_t768(rng):
+    """Interpret-mode parity at t=768 with DEFAULT blocks — the length the
+    plain min() clamp would have broken (768 % 1024 != 0): exercises the
+    divisor-aware shrink end-to-end through the public entry."""
+    q, k, v = _qkv(rng, b=1, t=768, h=1, dh=64)
+    want = dense_attention(q, k, v, causal=True)
+    got = flash_attention(q, k, v, force=True, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
 @pytest.mark.slow
 def test_chip_study_shape_parity_interpret(rng):
     """Interpret-mode parity at the exact shape the hardware study runs
