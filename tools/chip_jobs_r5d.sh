@@ -1,0 +1,134 @@
+#!/bin/bash
+# Round-5 chain d: re-measure the attention evidence at the TUNED kernel
+# defaults (commit 822a588: blocks 512x1024 + divisor-aware shrink + causal
+# fetch-clamp). The committed tpu_attn.json rows and the lm_flash rows were
+# measured at the old 128x128 defaults (and, for the LM rows, partly with
+# the pre-fix dense fallback); this chain replaces them with what actually
+# ships:
+#   1 attn_defaults    tpu_attn_check T=256..4096 at shipped defaults —
+#                      parity + timings vs dense + jaxref (supersedes the
+#                      r5-ladder attn_full rows; closes the r5 review's
+#                      "evidence attests old defaults" finding on chip)
+#   2 lm_flash_tuned   LM flash-vs-dense T=1024 remat with the tuned kernel
+# Parks until chip_jobs_r5.sh, r5b.sh AND r5c.sh are gone.
+#
+# Launch detached:
+#   setsid nohup bash tools/chip_jobs_r5d.sh > baselines_out/chip_jobs_r5d.log 2>&1 &
+# NEVER edit this file while it runs. Markers: baselines_out/.r5d_<rung>_done
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p baselines_out
+
+stamp() { date -u +"%Y-%m-%dT%H:%M:%SZ"; }
+
+commit_evidence() {
+  local msg="$1"
+  local files
+  shopt -s nullglob
+  files=(baselines_out/*.json baselines_out/*.jsonl baselines_out/*.log)
+  shopt -u nullglob
+  if [ "${#files[@]}" = 0 ]; then
+    echo "[r5d $(stamp)] no artifact files exist yet for: $msg"
+    return 0
+  fi
+  for i in 1 2 3; do
+    if ! git add -- "${files[@]}"; then
+      echo "[r5d $(stamp)] git add failed (attempt $i), retrying"
+      sleep 5
+      continue
+    fi
+    if git diff --cached --quiet -- baselines_out 2>/dev/null; then
+      echo "[r5d $(stamp)] nothing new to commit for: $msg"
+      return 0
+    fi
+    if git commit -q -m "$msg" -- baselines_out; then
+      echo "[r5d $(stamp)] committed: $msg"
+      return 0
+    fi
+    echo "[r5d $(stamp)] git commit failed (attempt $i), retrying"
+    sleep 5
+  done
+  echo "[r5d $(stamp)] WARNING: commit failed for: $msg (evidence still on disk)"
+  return 0
+}
+
+tpu_up() {
+  timeout -k 30 120 python - <<'EOF'
+import sys, jax
+try:
+    d = jax.devices()
+    sys.exit(0 if d and d[0].platform != "cpu" else 3)
+except Exception:
+    sys.exit(3)
+EOF
+}
+
+others_running() {
+  pgrep -f "bash tools/chip_jobs_r5.sh" > /dev/null 2>&1 && return 0
+  pgrep -f "bash tools/chip_jobs_r5b.sh" > /dev/null 2>&1 && return 0
+  pgrep -f "bash tools/chip_jobs_r5c.sh" > /dev/null 2>&1 && return 0
+  return 1
+}
+
+echo "[r5d $(stamp)] waiting for chip_jobs_r5/r5b/r5c to finish"
+while others_running; do
+  sleep 60
+done
+echo "[r5d $(stamp)] predecessors gone; proceeding"
+
+ABORT_PASS=0
+FAILURES=0
+rung() {
+  local name="$1" msg="$2"; shift 2
+  local marker="baselines_out/.r5d_${name}_done"
+  if [ -f "$marker" ] || [ "$ABORT_PASS" = 1 ]; then
+    return 0
+  fi
+  echo "[r5d $(stamp)] ===== rung $name: $* ====="
+  local rc=0
+  "$@" || rc=$?
+  if [ "$rc" = 0 ]; then
+    touch "$marker"
+    commit_evidence "$msg"
+  else
+    echo "[r5d $(stamp)] rung $name FAILED (rc=$rc); probing tunnel"
+    commit_evidence "$msg (partial: rung exited rc=$rc)"
+    FAILURES=$((FAILURES + 1))
+    if ! tpu_up; then
+      echo "[r5d $(stamp)] tunnel down — aborting this pass, back to wait loop"
+      ABORT_PASS=1
+    fi
+  fi
+}
+
+all_done() {
+  for m in attn_defaults lm_flash_tuned; do
+    [ -f "baselines_out/.r5d_${m}_done" ] || return 1
+  done
+  return 0
+}
+
+for outer in 1 2 3; do
+  echo "[r5d $(stamp)] ===== outer attempt $outer ====="
+  if all_done; then break; fi
+  tools/wait_tpu.sh 60 150 120 || { echo "[r5d $(stamp)] tunnel never came up this window"; continue; }
+  FAILURES=0
+  ABORT_PASS=0
+
+  rung attn_defaults "chip evidence: flash T=256..4096 vs dense/jaxref at tuned shipped defaults" \
+    timeout -k 60 3600 python tools/tpu_attn_check.py --out baselines_out/tpu_attn.json
+
+  rung lm_flash_tuned "chip evidence: LM flash-vs-dense T=1024 with tuned kernel defaults" \
+    timeout -k 60 3600 python tools/tpu_lm_perf.py --steps 4 \
+      --variants lm_cyclic_s1_shared_bf16_flash,lm_cyclic_s1_shared_bf16 \
+      --seq-len 1024 --batch-size 4 --remat \
+      --out baselines_out/tpu_lm_perf_flash_tuned.json
+
+  if all_done; then
+    echo "[r5d $(stamp)] TUNED-DEFAULTS EVIDENCE COMPLETE"
+    break
+  fi
+  echo "[r5d $(stamp)] incomplete ($FAILURES rung failures this pass); retrying"
+  sleep 120
+done
+all_done && exit 0 || exit 1
