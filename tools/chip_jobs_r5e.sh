@@ -1,0 +1,148 @@
+#!/bin/bash
+# Round-5 chain e: last-resort d~159M evidence. Every multi-variant attempt
+# at the d~159M LM died in the tunnel's remote-compile service with
+# "Broken pipe" at ~27 min (T=2048 remat ×2, T=1024 remat ×1 — records in
+# tpu_lm_perf_big*.json / chain logs), and tpu_lm_perf aborts on its first
+# variant, so the lighter variants behind the cyclic one never compiled.
+# This chain tries ONE variant per rung, lightest compile first:
+#   1 lm159_geomed     geomedian only, T=1024 b2, no remat (no coding
+#                      graph, no remat graph — the lightest d~159M step)
+#   2 lm159_shared     cyclic shared only, T=512 b4, no remat (the decode
+#                      claim at d~159M with the smallest activation graph)
+#   3 lm159_shared_1k  cyclic shared only, T=1024 b2, no remat
+# Any rung that lands gives the decode-vs-geomedian comparison at d~159M
+# (ratios compose across rungs at matched token counts).
+# Parks until chains r5/r5b/r5c/r5d are gone.
+#
+# Launch detached:
+#   setsid nohup bash tools/chip_jobs_r5e.sh > baselines_out/chip_jobs_r5e.log 2>&1 &
+# NEVER edit this file while it runs. Markers: baselines_out/.r5e_<rung>_done
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p baselines_out
+
+stamp() { date -u +"%Y-%m-%dT%H:%M:%SZ"; }
+
+commit_evidence() {
+  local msg="$1"
+  local files
+  shopt -s nullglob
+  files=(baselines_out/*.json baselines_out/*.jsonl baselines_out/*.log)
+  shopt -u nullglob
+  if [ "${#files[@]}" = 0 ]; then
+    echo "[r5e $(stamp)] no artifact files exist yet for: $msg"
+    return 0
+  fi
+  for i in 1 2 3; do
+    if ! git add -- "${files[@]}"; then
+      echo "[r5e $(stamp)] git add failed (attempt $i), retrying"
+      sleep 5
+      continue
+    fi
+    if git diff --cached --quiet -- baselines_out 2>/dev/null; then
+      echo "[r5e $(stamp)] nothing new to commit for: $msg"
+      return 0
+    fi
+    if git commit -q -m "$msg" -- baselines_out; then
+      echo "[r5e $(stamp)] committed: $msg"
+      return 0
+    fi
+    echo "[r5e $(stamp)] git commit failed (attempt $i), retrying"
+    sleep 5
+  done
+  echo "[r5e $(stamp)] WARNING: commit failed for: $msg (evidence still on disk)"
+  return 0
+}
+
+tpu_up() {
+  timeout -k 30 120 python - <<'EOF'
+import sys, jax
+try:
+    d = jax.devices()
+    sys.exit(0 if d and d[0].platform != "cpu" else 3)
+except Exception:
+    sys.exit(3)
+EOF
+}
+
+others_running() {
+  for s in chip_jobs_r5.sh chip_jobs_r5b.sh chip_jobs_r5c.sh chip_jobs_r5d.sh; do
+    pgrep -f "bash tools/$s" > /dev/null 2>&1 && return 0
+  done
+  return 1
+}
+
+echo "[r5e $(stamp)] waiting for chains r5/r5b/r5c/r5d to finish"
+while others_running; do
+  sleep 60
+done
+echo "[r5e $(stamp)] predecessors gone; proceeding"
+
+ABORT_PASS=0
+FAILURES=0
+rung() {
+  local name="$1" msg="$2"; shift 2
+  local marker="baselines_out/.r5e_${name}_done"
+  if [ -f "$marker" ] || [ "$ABORT_PASS" = 1 ]; then
+    return 0
+  fi
+  echo "[r5e $(stamp)] ===== rung $name: $* ====="
+  local rc=0
+  "$@" || rc=$?
+  if [ "$rc" = 0 ]; then
+    touch "$marker"
+    commit_evidence "$msg"
+  else
+    echo "[r5e $(stamp)] rung $name FAILED (rc=$rc); probing tunnel"
+    commit_evidence "$msg (partial: rung exited rc=$rc)"
+    FAILURES=$((FAILURES + 1))
+    if ! tpu_up; then
+      echo "[r5e $(stamp)] tunnel down — aborting this pass, back to wait loop"
+      ABORT_PASS=1
+    fi
+  fi
+}
+
+all_done() {
+  for m in lm159_geomed lm159_shared lm159_shared_1k; do
+    [ -f "baselines_out/.r5e_${m}_done" ] || return 1
+  done
+  return 0
+}
+
+for outer in 1 2; do
+  echo "[r5e $(stamp)] ===== outer attempt $outer ====="
+  if all_done; then break; fi
+  tools/wait_tpu.sh 60 150 120 || { echo "[r5e $(stamp)] tunnel never came up this window"; continue; }
+  FAILURES=0
+  ABORT_PASS=0
+
+  rung lm159_geomed "chip evidence: d~159M geomedian-only step (lightest compile)" \
+    timeout -k 60 3600 python tools/tpu_lm_perf.py --steps 4 --reps 2 \
+      --model-dim 1024 --model-heads 16 --model-layers 12 \
+      --seq-len 1024 --batch-size 2 \
+      --variants lm_geomedian_bf16 \
+      --out baselines_out/tpu_lm_perf_159_geomed.json
+
+  rung lm159_shared "chip evidence: d~159M cyclic-shared-only step, T=512" \
+    timeout -k 60 3600 python tools/tpu_lm_perf.py --steps 4 --reps 2 \
+      --model-dim 1024 --model-heads 16 --model-layers 12 \
+      --seq-len 512 --batch-size 4 \
+      --variants lm_cyclic_s1_shared_bf16 \
+      --out baselines_out/tpu_lm_perf_159_shared.json
+
+  rung lm159_shared_1k "chip evidence: d~159M cyclic-shared-only step, T=1024" \
+    timeout -k 60 3600 python tools/tpu_lm_perf.py --steps 4 --reps 2 \
+      --model-dim 1024 --model-heads 16 --model-layers 12 \
+      --seq-len 1024 --batch-size 2 \
+      --variants lm_cyclic_s1_shared_bf16 \
+      --out baselines_out/tpu_lm_perf_159_shared_1k.json
+
+  if all_done; then
+    echo "[r5e $(stamp)] LAST-RESORT d159M COMPLETE"
+    break
+  fi
+  echo "[r5e $(stamp)] incomplete ($FAILURES rung failures this pass); retrying"
+  sleep 120
+done
+all_done && exit 0 || exit 1
