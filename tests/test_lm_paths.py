@@ -19,6 +19,41 @@ def _lm_cfg(**kw):
     return _cfg(pipeline_shards=1, pp_microbatches=0, **kw)
 
 
+def test_lm_attention_sees_full_tile_friendly_t(monkeypatch):
+    """attn_impl='flash' on the tp/pp LM paths must hand the attention the
+    FULL T-token sequence, never T-1: the pre-r5 toks[:, :-1] slice made
+    t=1023 at T=1024, failing the kernel's t%8 tiling so every 'flash' LM
+    run silently measured the dense fallback (commit 69ae479). Recorded
+    via a probe attn_fn; also asserts the shipped default blocks accept
+    the shape (the probe t must be kernel-eligible)."""
+    import draco_tpu.ops.flash_attention as fa
+
+    seen = []
+
+    def probe(q, k, v, **kw):
+        seen.append(q.shape[1])
+        from draco_tpu.parallel.ring_attention import dense_attention
+        return dense_attention(q, k, v, causal=True)
+
+    monkeypatch.setattr(fa, "flash_attention", probe)
+
+    for build, mesh, extra in [
+        (build_tp_train_setup, make_mesh_wtp(2, 1), {}),
+        (build_pp_train_setup, make_mesh_wpp(2, 1),
+         dict(pipeline_shards=1, pp_microbatches=1)),
+    ]:
+        cfg = _cfg(attn_impl="flash", seq_len=16, **extra)
+        setup = build(cfg, mesh)
+        toks = _toks(cfg)
+        seen.clear()  # drop the init pass (t = min(seq_len, 8) by design)
+        setup.train_step(setup.state, toks, np.zeros(2, dtype=bool))
+        assert seen, "probe attention never called"
+        assert all(t == cfg.seq_len for t in seen), seen
+        bq = fa._fit_block(512, seen[0], lane_rule=False)
+        bk = fa._fit_block(1024, seen[0], lane_rule=True)
+        assert fa._kernel_eligible(seen[0], bq, bk, 64, True, False)
+
+
 def test_tp_remat_grads_exact():
     cfg0 = _lm_cfg(num_workers=4, tensor_shards=2)
     cfg1 = _lm_cfg(num_workers=4, tensor_shards=2, remat=True)
