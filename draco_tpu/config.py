@@ -146,6 +146,13 @@ class TrainConfig:
 
     # rematerialise activations in backward (jax.checkpoint) — memory for FLOPs
     remat: bool = False
+    # compile the LM's layer stack as one nn.scan over stacked block weights
+    # instead of `model_layers` unrolled block programs — identical math,
+    # ~layers× smaller XLA program (keeps deep/large configs under
+    # compile-time ceilings). LM paths only; changes the params tree layout
+    # (one stacked "blocks" subtree), so checkpoints don't interchange with
+    # the unrolled form.
+    scan_layers: bool = False
 
     # --- misc ---
     seed: int = SEED

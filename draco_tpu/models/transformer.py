@@ -45,6 +45,9 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(self, x, positions, train: bool):
+        return self._body(x, positions, train)
+
+    def _body(self, x, positions, train: bool):
         b, t, _ = x.shape
         dh = self.dim // self.heads
         h = nn.LayerNorm(use_bias=False, dtype=self.dtype)(x)
@@ -76,6 +79,17 @@ class Block(nn.Module):
         return x
 
 
+class BlockScan(Block):
+    """``Block`` with the ``(carry, per-step-output)`` return convention
+    ``nn.scan`` requires. Same fields, same math, same parameter names —
+    only the return shape differs, so stacking the unrolled blocks' params
+    along a leading layer axis reproduces the scanned model exactly."""
+
+    @nn.compact
+    def __call__(self, x, positions, train: bool):
+        return self._body(x, positions, train), None
+
+
 class TransformerLM(nn.Module):
     """Returns next-token logits (B, T, vocab).
 
@@ -96,6 +110,15 @@ class TransformerLM(nn.Module):
     # / big batches for FLOPs. Collectives inside a block (ring attention's
     # ppermute hops) replay in the recompute, which is SPMD-safe.
     remat: bool = False
+    # compile the layer stack as ONE nn.scan over stacked block weights
+    # instead of `layers` unrolled copies of the block program. Identical
+    # math (test_transformer_scan.py proves output parity against the
+    # unrolled model with restacked params); the XLA program shrinks by
+    # ~`layers`×, which is what keeps very deep/big configs under
+    # compile-time/service ceilings. Parameter tree changes shape (one
+    # "blocks" subtree with a leading layer axis instead of block0..N-1),
+    # so checkpoints are not interchangeable with the unrolled layout.
+    scan_layers: bool = False
 
     @nn.compact
     def __call__(self, tokens, pos_offset=0, train: bool = True):
@@ -104,11 +127,29 @@ class TransformerLM(nn.Module):
         positions = pos_offset + jnp.arange(tokens.shape[1])
         # static_argnums counts self as 0 (flax subtracts 1 internally), so
         # the train flag of __call__(self, x, positions, train) is 3
-        blk_cls = nn.remat(Block, static_argnums=(3,)) if self.remat else Block
-        for i in range(self.layers):
-            x = blk_cls(self.dim, self.heads, attn_fn=self.attn_fn,
-                        experts=self.experts, dtype=self.dtype,
-                        name=f"block{i}")(x, positions, train)
+        if self.scan_layers:
+            # prevent_cse is unnecessary inside nn.scan (flax checkpoint
+            # docs — same discipline as pp_step._PipeBlock) and would put a
+            # barrier in every scanned body
+            cls = (nn.remat(BlockScan, static_argnums=(3,),
+                            prevent_cse=False)
+                   if self.remat else BlockScan)
+            stack = nn.scan(
+                cls,
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                in_axes=(nn.broadcast, nn.broadcast),  # positions, train
+                length=self.layers,
+            )(self.dim, self.heads, attn_fn=self.attn_fn,
+              experts=self.experts, dtype=self.dtype, name="blocks")
+            x, _ = stack(x, positions, train)
+        else:
+            blk_cls = (nn.remat(Block, static_argnums=(3,))
+                       if self.remat else Block)
+            for i in range(self.layers):
+                x = blk_cls(self.dim, self.heads, attn_fn=self.attn_fn,
+                            experts=self.experts, dtype=self.dtype,
+                            name=f"block{i}")(x, positions, train)
         x = nn.LayerNorm(use_bias=False, name="final_ln")(x)
         # logits in float32 (loss numerics)
         return emb.attend(x.astype(jnp.float32))

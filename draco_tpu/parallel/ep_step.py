@@ -37,6 +37,11 @@ def ep_partition_spec(path) -> P:
     router and every non-MoE parameter stay replicated."""
     names = [getattr(k, "key", str(k)) for k in path]
     if len(names) >= 2 and names[-2] == "moe" and names[-1] in EXPERT_PARAMS:
+        # scan_layers stacks block params under "blocks" with a leading
+        # layer axis — the E axis moves to position 1 (same shift as
+        # tp_step.param_partition_spec)
+        if "blocks" in names:
+            return P(None, EP_AXIS)
         return P(EP_AXIS)
     return P()
 

@@ -307,15 +307,15 @@ def build_pp_train_setup(cfg: TrainConfig, mesh) -> PPTrainSetup:
             flat, NamedSharding(mesh, P(WORKER_AXIS))
         ), losses
 
-    if cfg.approach == "cyclic":
-        code = cyclic_mod.build_cyclic_code(n, cfg.worker_fail)
-        rand_factor = jnp.asarray(drng.random_projection_factors(cfg.seed, dim))
-    else:
-        code = None
-        rand_factor = None
+    code = (cyclic_mod.build_cyclic_code(n, cfg.worker_fail)
+            if cfg.approach == "cyclic" else None)
 
     def step_body(state: TrainState, tokens, adv_mask, present=None):
         grads, losses = per_worker_grads(state.params, tokens)
+        # in-graph decode projection — no d-length program constant
+        # (rng.random_projection_factors_in_graph docstring)
+        rand_factor = (drng.random_projection_factors_in_graph(cfg.seed, dim)
+                       if code is not None else None)
         agg = aggregate_flat_grads(grads, adv_mask, cfg, code, rand_factor,
                                    present=present,
                                    leaf_offsets=leaf_offsets)

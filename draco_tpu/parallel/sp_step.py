@@ -111,13 +111,13 @@ def build_sp_train_setup(cfg: TrainConfig, mesh) -> SPTrainSetup:
     model = TransformerLM(
         vocab=cfg.vocab, dim=cfg.model_dim, heads=cfg.model_heads,
         layers=cfg.model_layers, attn_fn=attn, experts=cfg.moe_experts,
-        dtype=cdtype, remat=cfg.remat,
+        dtype=cdtype, remat=cfg.remat, scan_layers=cfg.scan_layers,
     )
     # init single-shard (dense attention) — parameter shapes are identical
     init_model = TransformerLM(
         vocab=cfg.vocab, dim=cfg.model_dim, heads=cfg.model_heads,
         layers=cfg.model_layers, attn_fn=None, experts=cfg.moe_experts,
-        dtype=cdtype,
+        dtype=cdtype, scan_layers=cfg.scan_layers,
     )
     root = jax.random.key(cfg.seed)
     init_toks = jnp.zeros((1, min(cfg.seq_len, 8)), jnp.int32)
@@ -218,12 +218,8 @@ def build_sp_train_setup(cfg: TrainConfig, mesh) -> SPTrainSetup:
     )
 
     # ---- aggregation over w (identical machinery to the CNN path) ---------
-    if cfg.approach == "cyclic":
-        code = cyclic_mod.build_cyclic_code(n, cfg.worker_fail)
-        rand_factor = jnp.asarray(drng.random_projection_factors(cfg.seed, dim))
-    else:
-        code = None
-        rand_factor = None
+    code = (cyclic_mod.build_cyclic_code(n, cfg.worker_fail)
+            if cfg.approach == "cyclic" else None)
     simulate = cfg.approach == "cyclic" and cfg.redundancy == "simulate"
     batch_ids = jnp.asarray(code.batch_ids) if simulate else None
     shard_w3 = NamedSharding(mesh, P(WORKER_AXIS, None, None))
@@ -239,6 +235,10 @@ def build_sp_train_setup(cfg: TrainConfig, mesh) -> SPTrainSetup:
         else:
             grads, losses = grads_fn(state.params, tokens)
             grads = lax.with_sharding_constraint(grads, shard_w)
+        # in-graph decode projection — no d-length program constant
+        # (rng.random_projection_factors_in_graph docstring)
+        rand_factor = (drng.random_projection_factors_in_graph(cfg.seed, dim)
+                       if code is not None else None)
         agg = aggregate_flat_grads(grads, adv_mask, cfg, code, rand_factor,
                                    present=present,
                                    leaf_offsets=leaf_offsets)

@@ -66,12 +66,18 @@ def param_partition_spec(path) -> P:
     leaf = names[-1]
     layer = names[-2] if len(names) >= 2 else ""
     if leaf == "kernel" and layer in ("qkv", "mlp_in"):
-        return P(None, TP_AXIS)
-    if leaf == "kernel" and layer in ("proj", "mlp_out"):
-        return P(TP_AXIS, None)
-    if leaf == "bias" and layer == "mlp_in":
-        return P(TP_AXIS)
-    return P()
+        spec = (None, TP_AXIS)
+    elif leaf == "kernel" and layer in ("proj", "mlp_out"):
+        spec = (TP_AXIS, None)
+    elif leaf == "bias" and layer == "mlp_in":
+        spec = (TP_AXIS,)
+    else:
+        return P()
+    # scan_layers stacks block params under a "blocks" subtree with a
+    # leading layer axis — the Megatron dims shift right by one
+    if "blocks" in names:
+        spec = (None,) + spec
+    return P(*spec)
 
 
 def shard_params(params, mesh, partition_fn=param_partition_spec):
@@ -141,7 +147,7 @@ def _build_gspmd_train_setup(cfg: TrainConfig, mesh, *, mp_axis: str,
     model = TransformerLM(
         vocab=cfg.vocab, dim=cfg.model_dim, heads=cfg.model_heads,
         layers=cfg.model_layers, attn_fn=attn_fn, experts=experts,
-        dtype=cdtype, remat=cfg.remat,
+        dtype=cdtype, remat=cfg.remat, scan_layers=cfg.scan_layers,
     )
     root = jax.random.key(cfg.seed)
     init_toks = jnp.zeros((1, min(cfg.seq_len, 8)), jnp.int32)
@@ -186,12 +192,8 @@ def _build_gspmd_train_setup(cfg: TrainConfig, mesh, *, mp_axis: str,
         nll = -jnp.take_along_axis(logp, toks[:, 1:, None], axis=-1)[..., 0]
         return jnp.mean(nll)
 
-    if cfg.approach == "cyclic":
-        code = cyclic_mod.build_cyclic_code(n, cfg.worker_fail)
-        rand_factor = jnp.asarray(drng.random_projection_factors(cfg.seed, dim))
-    else:
-        code = None
-        rand_factor = None
+    code = (cyclic_mod.build_cyclic_code(n, cfg.worker_fail)
+            if cfg.approach == "cyclic" else None)
     # reference-parity r× redundant compute: each worker really evaluates
     # its hat_s = 2s+1 assigned batch rows (cyclic_worker.py:122-146); the
     # "shared" fast path computes each row once and forms encoded rows
@@ -214,6 +216,11 @@ def _build_gspmd_train_setup(cfg: TrainConfig, mesh, *, mp_axis: str,
         else:
             grads, losses = jax.vmap(lane)(tokens)  # (n, d), (n,)
             grads = jax.lax.with_sharding_constraint(grads, shard_w)
+        # decode projection generated in-graph from the scalar seed — a
+        # closed-over (d,) constant serializes into the program (638 MB at
+        # d~159M: the remote-compile ceiling, rng.py docstring)
+        rand_factor = (drng.random_projection_factors_in_graph(cfg.seed, dim)
+                       if code is not None else None)
         agg = aggregate_flat_grads(grads, adv_mask, cfg, code, rand_factor,
                                    present=present,
                                    leaf_offsets=leaf_offsets)

@@ -84,9 +84,24 @@ def fold(key: jax.Array, *data: int) -> jax.Array:
     return key
 
 
-def random_projection_factors(seed: int, dim: int) -> np.ndarray:
-    """The decode-side random projection vector (reference: cyclic_master.py:58-61,
-    np.random.normal(loc=1.0) per layer). One factor per gradient coordinate;
-    drawn once at setup, shared by all participants."""
-    rng = np.random.RandomState(seed + 7919)
-    return rng.normal(loc=1.0, scale=1.0, size=dim).astype(np.float32)
+def random_projection_factors_in_graph(seed: int, dim: int) -> jnp.ndarray:
+    """The decode-side random projection vector (reference:
+    cyclic_master.py:58-61, np.random.normal(loc=1.0) per layer) — same
+    distribution (normal, loc=1), deterministic in ``seed``, generated
+    from a scalar key INSIDE the jitted step instead of being closed over
+    as a d-length host constant.
+
+    Why it exists: a closed-over (d,) float32 array is serialized into the
+    XLA program — at the d≈159M LM flagship that is a 638 MB module
+    (baselines_out/tpu_lm_scan_lowering.json), which is what the tunnel's
+    remote-compile service choked on for four straight attempts (PERF.md
+    §4). Generated in-graph, the program carries only the scalar seed and
+    regenerates the identical vector each step (~one HBM pass over d —
+    noise vs the step cost). Values differ from the numpy stream (jax
+    PRNG, not MT19937); decode is projection-value-agnostic (exact
+    recovery for ≤s corruptions regardless of the projection draw), and
+    every participant still derives the identical vector, which is the
+    property the reference pins (cyclic_master.py:58-61).
+    """
+    key = jax.random.fold_in(jax.random.key(seed), 7919)
+    return 1.0 + jax.random.normal(key, (dim,), jnp.float32)

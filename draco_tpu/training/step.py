@@ -246,7 +246,6 @@ def build_train_setup(cfg: TrainConfig, mesh, dataset_name: Optional[str] = None
     elif cfg.approach == "cyclic":
         code = cyclic_mod.build_cyclic_code(n, cfg.worker_fail)
         rep_code = None
-        rand_factor = jnp.asarray(drng.random_projection_factors(cfg.seed, dim))
         batch_ids = jnp.asarray(code.batch_ids)  # (n, hat_s)
         hat_s = code.hat_s
 
@@ -326,6 +325,10 @@ def build_train_setup(cfg: TrainConfig, mesh, dataset_name: Optional[str] = None
                 enc_im = enc_im * pw
             enc_re = jax.lax.with_sharding_constraint(enc_re, shard_w)
             enc_im = jax.lax.with_sharding_constraint(enc_im, shard_w)
+            # in-graph decode projection — no d-length program constant
+            # (rng.random_projection_factors_in_graph docstring)
+            rand_factor = drng.random_projection_factors_in_graph(cfg.seed,
+                                                                  dim)
             if cfg.decode_granularity == "layer":
                 # per-parameter-tensor locator + projection, like the
                 # reference's per-layer decode loop (cyclic_master.py:125-129)

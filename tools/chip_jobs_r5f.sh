@@ -1,0 +1,156 @@
+#!/bin/bash
+# Round-5 chain f: the d~159M LM point via scan_layers. Every unrolled
+# attempt died in the tunnel's remote-compile service ("Broken pipe" at
+# ~27 min — PERF.md §4, chains r5/r5c/r5e). scan_layers compiles the
+# 12-layer stack as ONE nn.scan body (identical math —
+# tests/test_transformer_scan.py; offline TPU lowering + program-size
+# evidence — baselines_out/tpu_lm_scan_lowering.json), so the program the
+# service sees is ~12x smaller. One variant per rung, headline first:
+#   1 lm159scan_flash   cyclic shared + flash kernel, T=2048 b2 remat
+#   2 lm159scan_geomed  geomedian, same shapes (the comparison column)
+#   3 lm159scan_shared  cyclic shared dense, same shapes
+#   4 lm159scan_sim     cyclic simulate (r=3 lanes), T=2048 b1 remat
+# Rungs 1+2 give the decode-vs-geomedian claim at d~159M; 3 isolates the
+# kernel's contribution; 4 prices reference-parity redundancy.
+# Parks until chains r5/r5b/r5c/r5d/r5e are gone.
+#
+# Launch detached:
+#   setsid nohup bash tools/chip_jobs_r5f.sh > baselines_out/chip_jobs_r5f.log 2>&1 &
+# NEVER edit this file while it runs. Markers: baselines_out/.r5f_<rung>_done
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p baselines_out
+
+stamp() { date -u +"%Y-%m-%dT%H:%M:%SZ"; }
+
+commit_evidence() {
+  local msg="$1"
+  local files
+  shopt -s nullglob
+  files=(baselines_out/*.json baselines_out/*.jsonl baselines_out/*.log)
+  shopt -u nullglob
+  if [ "${#files[@]}" = 0 ]; then
+    echo "[r5f $(stamp)] no artifact files exist yet for: $msg"
+    return 0
+  fi
+  for i in 1 2 3; do
+    if ! git add -- "${files[@]}"; then
+      echo "[r5f $(stamp)] git add failed (attempt $i), retrying"
+      sleep 5
+      continue
+    fi
+    if git diff --cached --quiet -- baselines_out 2>/dev/null; then
+      echo "[r5f $(stamp)] nothing new to commit for: $msg"
+      return 0
+    fi
+    if git commit -q -m "$msg" -- baselines_out; then
+      echo "[r5f $(stamp)] committed: $msg"
+      return 0
+    fi
+    echo "[r5f $(stamp)] git commit failed (attempt $i), retrying"
+    sleep 5
+  done
+  echo "[r5f $(stamp)] WARNING: commit failed for: $msg (evidence still on disk)"
+  return 0
+}
+
+tpu_up() {
+  timeout -k 30 120 python - <<'EOF'
+import sys, jax
+try:
+    d = jax.devices()
+    sys.exit(0 if d and d[0].platform != "cpu" else 3)
+except Exception:
+    sys.exit(3)
+EOF
+}
+
+others_running() {
+  for s in chip_jobs_r5.sh chip_jobs_r5b.sh chip_jobs_r5c.sh \
+           chip_jobs_r5d.sh chip_jobs_r5e.sh; do
+    pgrep -f "bash tools/$s" > /dev/null 2>&1 && return 0
+  done
+  return 1
+}
+
+echo "[r5f $(stamp)] waiting for chains r5/r5b/r5c/r5d/r5e to finish"
+while others_running; do
+  sleep 60
+done
+echo "[r5f $(stamp)] predecessors gone; proceeding"
+
+ABORT_PASS=0
+FAILURES=0
+rung() {
+  local name="$1" msg="$2"; shift 2
+  local marker="baselines_out/.r5f_${name}_done"
+  if [ -f "$marker" ] || [ "$ABORT_PASS" = 1 ]; then
+    return 0
+  fi
+  echo "[r5f $(stamp)] ===== rung $name: $* ====="
+  local rc=0
+  "$@" || rc=$?
+  if [ "$rc" = 0 ]; then
+    touch "$marker"
+    commit_evidence "$msg"
+  else
+    echo "[r5f $(stamp)] rung $name FAILED (rc=$rc); probing tunnel"
+    commit_evidence "$msg (partial: rung exited rc=$rc)"
+    FAILURES=$((FAILURES + 1))
+    if ! tpu_up; then
+      echo "[r5f $(stamp)] tunnel down — aborting this pass, back to wait loop"
+      ABORT_PASS=1
+    fi
+  fi
+}
+
+all_done() {
+  for m in lm159scan_flash lm159scan_geomed lm159scan_shared lm159scan_sim; do
+    [ -f "baselines_out/.r5f_${m}_done" ] || return 1
+  done
+  return 0
+}
+
+for outer in 1 2 3; do
+  echo "[r5f $(stamp)] ===== outer attempt $outer ====="
+  if all_done; then break; fi
+  tools/wait_tpu.sh 60 150 120 || { echo "[r5f $(stamp)] tunnel never came up this window"; continue; }
+  FAILURES=0
+  ABORT_PASS=0
+
+  rung lm159scan_flash "chip evidence: d~159M LM cyclic+flash T=2048 via scan_layers" \
+    timeout -k 60 3600 python tools/tpu_lm_perf.py --steps 4 --reps 2 \
+      --model-dim 1024 --model-heads 16 --model-layers 12 \
+      --seq-len 2048 --batch-size 2 --remat --scan-layers \
+      --variants lm_cyclic_s1_shared_bf16_flash \
+      --out baselines_out/tpu_lm_perf_scan_flash.json
+
+  rung lm159scan_geomed "chip evidence: d~159M LM geomedian T=2048 via scan_layers" \
+    timeout -k 60 3600 python tools/tpu_lm_perf.py --steps 4 --reps 2 \
+      --model-dim 1024 --model-heads 16 --model-layers 12 \
+      --seq-len 2048 --batch-size 2 --remat --scan-layers \
+      --variants lm_geomedian_bf16 \
+      --out baselines_out/tpu_lm_perf_scan_geomed.json
+
+  rung lm159scan_shared "chip evidence: d~159M LM cyclic dense T=2048 via scan_layers" \
+    timeout -k 60 3600 python tools/tpu_lm_perf.py --steps 4 --reps 2 \
+      --model-dim 1024 --model-heads 16 --model-layers 12 \
+      --seq-len 2048 --batch-size 2 --remat --scan-layers \
+      --variants lm_cyclic_s1_shared_bf16 \
+      --out baselines_out/tpu_lm_perf_scan_shared.json
+
+  rung lm159scan_sim "chip evidence: d~159M LM cyclic simulate (r=3) T=2048 b1 via scan_layers" \
+    timeout -k 60 5400 python tools/tpu_lm_perf.py --steps 4 --reps 2 \
+      --model-dim 1024 --model-heads 16 --model-layers 12 \
+      --seq-len 2048 --batch-size 1 --remat --scan-layers \
+      --variants lm_cyclic_s1_simulate_bf16 \
+      --out baselines_out/tpu_lm_perf_scan_sim.json
+
+  if all_done; then
+    echo "[r5f $(stamp)] D~159M SCAN EVIDENCE COMPLETE"
+    break
+  fi
+  echo "[r5f $(stamp)] incomplete ($FAILURES rung failures this pass); retrying"
+  sleep 120
+done
+all_done && exit 0 || exit 1

@@ -25,6 +25,10 @@ shardings than the chip will.
 What it cannot prove: Mosaic machine-code compilation and HBM fit — the
 chip rung closes those.
 
+The scan_layers variants of these same shapes (chain r5f) are audited by
+the sibling tools/tpu_lm_scan_lowering_check.py, which also records the
+serialized program-size comparison driving that flag.
+
   python tools/tpu_lm_lowering_check.py \
       [--out baselines_out/tpu_lm_big_lowering.json]
 

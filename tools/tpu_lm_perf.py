@@ -105,7 +105,8 @@ def run_lm(cfg, mesh, steps, warmup=1, reps=2):
 
 
 def build_lm_variants(*, batch_size, num_workers, seq_len, vocab, model_dim,
-                      model_heads, model_layers, remat, max_steps):
+                      model_heads, model_layers, remat, max_steps,
+                      scan_layers=False):
     """The canonical LM benchmark variant configs (one source of truth —
     also imported by tools/tpu_lm_lowering_check.py so the offline lowering
     audit can never drift from what this tool measures on chip)."""
@@ -115,7 +116,7 @@ def build_lm_variants(*, batch_size, num_workers, seq_len, vocab, model_dim,
         num_workers=num_workers, worker_fail=1, err_mode="rev_grad",
         seq_len=seq_len, vocab=vocab, model_dim=model_dim,
         model_heads=model_heads, model_layers=model_layers,
-        compute_dtype="bfloat16", remat=remat,
+        compute_dtype="bfloat16", remat=remat, scan_layers=scan_layers,
         max_steps=max_steps, eval_freq=0,
         train_dir="", log_every=10**9,
     )
@@ -157,6 +158,10 @@ def main(argv=None) -> int:
     ap.add_argument("--remat", action="store_true",
                     help="per-block rematerialisation — buys bigger "
                          "batch × seq at ~1/3 extra fwd FLOPs")
+    ap.add_argument("--scan-layers", action="store_true",
+                    help="compile the layer stack as one nn.scan body — "
+                         "~layers× smaller XLA program, for configs that "
+                         "hit compile-time/service ceilings (PERF.md §4)")
     ap.add_argument("--variants", type=str, default="",
                     help="comma-separated subset of variants to run")
     ap.add_argument("--cpu-mesh", type=int, default=0)
@@ -181,6 +186,7 @@ def main(argv=None) -> int:
         seq_len=args.seq_len, vocab=args.vocab, model_dim=args.model_dim,
         model_heads=args.model_heads, model_layers=args.model_layers,
         remat=args.remat, max_steps=args.steps + 1,
+        scan_layers=args.scan_layers,
     )
 
     if args.variants:
@@ -192,6 +198,7 @@ def main(argv=None) -> int:
     report = {
         "platform": dev.platform,
         "remat": args.remat,
+        "scan_layers": args.scan_layers,
         "device_kind": getattr(dev, "device_kind", dev.platform),
         "num_workers": args.num_workers,
         "devices_used": n_dev,

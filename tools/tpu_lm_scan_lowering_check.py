@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""Offline lowering audit + program-size evidence for scan_layers (round 5).
+
+Every multi-variant attempt at the d≈159M LM point died in the tunnel's
+remote-compile service with "Broken pipe" at ~27 min (PERF.md §4) — the
+unrolled 12-layer remat program is ~12× the size it needs to be, and the
+service ceiling is evidently program-size-shaped. ``scan_layers`` compiles
+the layer stack as ONE nn.scan body over stacked weights (identical math:
+tests/test_transformer_scan.py), shrinking the XLA program by ~layers×.
+
+This tool proves, without a chip:
+  1. the scan_layers variants of the exact lm_big rung shapes lower clean
+     for platforms=["tpu"] (methodology: tools/tpu_lm_lowering_check.py,
+     which pins the unrolled counterparts);
+  2. the serialized StableHLO module is a fraction of the unrolled one —
+     the quantity the compile service chokes on. Both sizes are recorded
+     per variant so the chip rung's compile-odds argument is numbers-backed.
+
+Configs are IMPORTED from tools/tpu_lm_perf.py (build_lm_variants with
+scan_layers=True) and the shapes from tools/tpu_lm_lowering_check.py
+(LM_BIG), so the audit lowers the same programs chain r5f times on chip.
+
+  python tools/tpu_lm_scan_lowering_check.py \
+      [--out baselines_out/tpu_lm_scan_lowering.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def lower_variant(name, cfg_kw, steps=2):
+    """Returns (ok-row dict) with serialized-module byte size."""
+    import jax
+    import jax.export
+
+    from draco_tpu.config import TrainConfig
+    from draco_tpu.parallel.mesh import make_folded_wtp_mesh
+    from draco_tpu.parallel.tp_step import build_tp_train_setup
+    from tools.tpu_lm_perf import make_scan_loop, stage_scan_inputs
+
+    cfg = TrainConfig(**cfg_kw)
+    mesh = make_folded_wtp_mesh(cfg.num_workers)
+    t0 = time.time()
+    try:
+        setup = build_tp_train_setup(cfg, mesh)
+        xs, ms = stage_scan_inputs(cfg, steps)
+        loop = make_scan_loop(setup)
+        with mesh:
+            exp = jax.export.export(jax.jit(loop), platforms=["tpu"])(
+                setup.state, xs, ms)
+        n_params = sum(x.size for x in jax.tree.leaves(setup.state.params))
+        return {"variant": name, "ok": True, "params": int(n_params),
+                "scan_layers": bool(cfg.scan_layers),
+                "module_bytes": len(exp.mlir_module_serialized),
+                "seconds": round(time.time() - t0, 1)}
+    except Exception as e:
+        return {"variant": name, "ok": False,
+                "scan_layers": bool(cfg_kw.get("scan_layers", False)),
+                "seconds": round(time.time() - t0, 1),
+                "error": f"{type(e).__name__}: {str(e)[:400]}"}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", type=str,
+                    default="baselines_out/tpu_lm_scan_lowering.json")
+    args = ap.parse_args(argv)
+
+    from tools._lowering_common import run_rows, setup_cpu_host
+
+    setup_cpu_host(1)  # the chip's folded 1-device layout
+
+    from tools.tpu_lm_lowering_check import (
+        LM_BIG, LM_BIG_VARIANTS_B1, LM_BIG_VARIANTS_B2,
+    )
+    from tools.tpu_lm_perf import build_lm_variants
+
+    rows = []
+    for scan in (True, False):
+        v_b2 = build_lm_variants(batch_size=2, scan_layers=scan, **LM_BIG)
+        v_b1 = build_lm_variants(batch_size=1, scan_layers=scan, **LM_BIG)
+        tag = "scan" if scan else "unroll"
+        rows += [(f"{n}_{tag}", (lambda n=n, v=v_b2: lower_variant(n, v[n])))
+                 for n in LM_BIG_VARIANTS_B2]
+        rows += [(f"{n}_{tag}", (lambda n=n, v=v_b1: lower_variant(n, v[n])))
+                 for n in LM_BIG_VARIANTS_B1]
+
+    report = run_rows(
+        args.out,
+        "jax.export platforms=['tpu'] on the 1-virtual-device CPU host: "
+        "d~159M lm_big rung shapes with scan_layers=True vs unrolled; "
+        "module_bytes = serialized StableHLO size (the compile-service "
+        "pressure metric). Configs from tools/tpu_lm_perf.py.",
+        rows,
+    )
+    # headline ratio: shared-flash variant, scan vs unroll
+    by = {r["variant"] + ("_scan" if r.get("scan_layers") else "_unroll"): r
+          for r in report["rows"] if r.get("ok")}
+    k = "lm_cyclic_s1_shared_bf16_flash"
+    if f"{k}_scan" in by and f"{k}_unroll" in by:
+        ratio = by[f"{k}_unroll"]["module_bytes"] / by[f"{k}_scan"]["module_bytes"]
+        report["flash_module_shrink_x"] = round(ratio, 2)
+        with open(args.out, "w") as fh:
+            json.dump(report, fh, indent=1)
+    print(json.dumps({"all_ok": report["all_ok"],
+                      "flash_module_shrink_x": report.get(
+                          "flash_module_shrink_x")}))
+    return 0 if report["all_ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
