@@ -1,0 +1,133 @@
+#!/bin/bash
+# Round-5 chain g: re-warm bench.py's programs after the in-graph
+# projection change (commit 8fdd1f5 touched the cyclic training step, so
+# the persistent compile cache is stale for bench's cyclic legs — the
+# driver's end-of-round budget-280 bench must find warm programs or it
+# eats cold compiles). Also records the warmed bench as evidence.
+# Parks until chains r5/r5b/r5c/r5d/r5e/r5f are gone.
+#
+# Launch detached:
+#   setsid nohup bash tools/chip_jobs_r5g.sh > baselines_out/chip_jobs_r5g.log 2>&1 &
+# NEVER edit this file while it runs. Markers: baselines_out/.r5g_<rung>_done
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p baselines_out
+
+stamp() { date -u +"%Y-%m-%dT%H:%M:%SZ"; }
+
+commit_evidence() {
+  local msg="$1"
+  local files
+  shopt -s nullglob
+  files=(baselines_out/*.json baselines_out/*.jsonl baselines_out/*.log)
+  shopt -u nullglob
+  if [ "${#files[@]}" = 0 ]; then
+    echo "[r5g $(stamp)] no artifact files exist yet for: $msg"
+    return 0
+  fi
+  for i in 1 2 3; do
+    if ! git add -- "${files[@]}"; then
+      echo "[r5g $(stamp)] git add failed (attempt $i), retrying"
+      sleep 5
+      continue
+    fi
+    if git diff --cached --quiet -- baselines_out 2>/dev/null; then
+      echo "[r5g $(stamp)] nothing new to commit for: $msg"
+      return 0
+    fi
+    if git commit -q -m "$msg" -- baselines_out; then
+      echo "[r5g $(stamp)] committed: $msg"
+      return 0
+    fi
+    echo "[r5g $(stamp)] git commit failed (attempt $i), retrying"
+    sleep 5
+  done
+  echo "[r5g $(stamp)] WARNING: commit failed for: $msg (evidence still on disk)"
+  return 0
+}
+
+tpu_up() {
+  timeout -k 30 120 python - <<'EOF'
+import sys, jax
+try:
+    d = jax.devices()
+    sys.exit(0 if d and d[0].platform != "cpu" else 3)
+except Exception:
+    sys.exit(3)
+EOF
+}
+
+others_running() {
+  for s in chip_jobs_r5.sh chip_jobs_r5b.sh chip_jobs_r5c.sh \
+           chip_jobs_r5d.sh chip_jobs_r5e.sh chip_jobs_r5f.sh; do
+    pgrep -f "bash tools/$s" > /dev/null 2>&1 && return 0
+  done
+  return 1
+}
+
+echo "[r5g $(stamp)] waiting for chains r5..r5f to finish"
+while others_running; do
+  sleep 60
+done
+echo "[r5g $(stamp)] predecessors gone; proceeding"
+
+ABORT_PASS=0
+rung() {
+  local name="$1" msg="$2"; shift 2
+  local marker="baselines_out/.r5g_${name}_done"
+  if [ -f "$marker" ] || [ "$ABORT_PASS" = 1 ]; then
+    return 0
+  fi
+  echo "[r5g $(stamp)] ===== rung $name: $* ====="
+  local rc=0
+  "$@" || rc=$?
+  if [ "$rc" = 0 ]; then
+    touch "$marker"
+    commit_evidence "$msg"
+  else
+    echo "[r5g $(stamp)] rung $name FAILED (rc=$rc); probing tunnel"
+    commit_evidence "$msg (partial: rung exited rc=$rc)"
+    if ! tpu_up; then
+      echo "[r5g $(stamp)] tunnel down — aborting this pass, back to wait loop"
+      ABORT_PASS=1
+    fi
+  fi
+}
+
+all_done() {
+  for m in bench_warm bench_280; do
+    [ -f "baselines_out/.r5g_${m}_done" ] || return 1
+  done
+  return 0
+}
+
+bench_warm_rung() {
+  timeout -k 60 1500 python bench.py --budget 1200 \
+    > baselines_out/bench_warm_r5g.json
+}
+
+bench_280_rung() {
+  timeout -k 60 400 python bench.py \
+    > baselines_out/bench_280_r5g.json
+}
+
+for outer in 1 2 3; do
+  echo "[r5g $(stamp)] ===== outer attempt $outer ====="
+  if all_done; then break; fi
+  tools/wait_tpu.sh 60 150 120 || { echo "[r5g $(stamp)] tunnel never came up this window"; continue; }
+  ABORT_PASS=0
+
+  rung bench_warm "chip evidence: warmed driver bench after in-graph projection change" \
+    bench_warm_rung
+
+  rung bench_280 "chip evidence: budget-280 driver-format bench on warm cache (post-fix step)" \
+    bench_280_rung
+
+  if all_done; then
+    echo "[r5g $(stamp)] BENCH RE-WARM COMPLETE"
+    break
+  fi
+  echo "[r5g $(stamp)] incomplete; retrying"
+  sleep 120
+done
+all_done && exit 0 || exit 1
