@@ -59,13 +59,14 @@ EOF
 
 others_running() {
   for s in chip_jobs_r5.sh chip_jobs_r5b.sh chip_jobs_r5c.sh \
-           chip_jobs_r5d.sh chip_jobs_r5e.sh chip_jobs_r5f.sh; do
+           chip_jobs_r5d.sh chip_jobs_r5e.sh chip_jobs_r5f.sh \
+           chip_jobs_r5h.sh; do
     pgrep -f "bash tools/$s" > /dev/null 2>&1 && return 0
   done
   return 1
 }
 
-echo "[r5g $(stamp)] waiting for chains r5..r5f to finish"
+echo "[r5g $(stamp)] waiting for chains r5..r5f and r5h to finish"
 while others_running; do
   sleep 60
 done

@@ -1,0 +1,160 @@
+#!/bin/bash
+# Round-5 chain h: the d~159M LM point, HBM-fitted. Chain r5f proved the
+# compile ceiling is GONE (in-graph projection + scan_layers: compiles
+# finish in ~1 min) and converted the failure into quantified HBM OOMs:
+# flash T=2048 b2 needs 16.04G of 15.75G (over by 304M), geomedian 16.73G,
+# shared dense 16.87G. These rungs shave activations to fit:
+#   1 lm159h_flash_b1   cyclic shared + flash, T=2048 b1 remat scan
+#                       (b2->b1 drops ~1G of remat residuals + f32 logits)
+#   2 lm159h_geomed_b1  geomedian, T=2048 b1 remat scan (needs ~1G back)
+#   3 lm159h_flash_1k   cyclic shared + flash, T=1024 b2 remat scan
+#                       (fallback at halved T; matched tokens with rung 4)
+#   4 lm159h_geomed_1k  geomedian, T=1024 b2 remat scan
+# Any (flash, geomed) pair at matched shapes yields the decode-vs-geomedian
+# ratio at d~159M. The simulate variant is NOT retried at this scale: its
+# (n, 2s+1, d) redundant gradient stack is 8*3*159M*4B ~ 15G alone —
+# physically beyond one 16G chip; priced at d~63M instead (PERF 1b).
+# Parks until chains r5/r5b/r5c/r5d/r5e/r5f are gone.
+#
+# Launch detached (variable indirection so the launch wrapper's cmdline
+# does not match the chains' pgrep predecessor tests — SKILL.md round-5
+# note):
+#   s=tools/chip_jobs_r5h.sh; setsid nohup bash "$s" > baselines_out/chip_jobs_r5h.log 2>&1 &
+# NEVER edit this file while it runs. Markers: baselines_out/.r5h_<rung>_done
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p baselines_out
+
+stamp() { date -u +"%Y-%m-%dT%H:%M:%SZ"; }
+
+commit_evidence() {
+  local msg="$1"
+  local files
+  shopt -s nullglob
+  files=(baselines_out/*.json baselines_out/*.jsonl baselines_out/*.log)
+  shopt -u nullglob
+  if [ "${#files[@]}" = 0 ]; then
+    echo "[r5h $(stamp)] no artifact files exist yet for: $msg"
+    return 0
+  fi
+  for i in 1 2 3; do
+    if ! git add -- "${files[@]}"; then
+      echo "[r5h $(stamp)] git add failed (attempt $i), retrying"
+      sleep 5
+      continue
+    fi
+    if git diff --cached --quiet -- baselines_out 2>/dev/null; then
+      echo "[r5h $(stamp)] nothing new to commit for: $msg"
+      return 0
+    fi
+    if git commit -q -m "$msg" -- baselines_out; then
+      echo "[r5h $(stamp)] committed: $msg"
+      return 0
+    fi
+    echo "[r5h $(stamp)] git commit failed (attempt $i), retrying"
+    sleep 5
+  done
+  echo "[r5h $(stamp)] WARNING: commit failed for: $msg (evidence still on disk)"
+  return 0
+}
+
+tpu_up() {
+  timeout -k 30 120 python - <<'EOF'
+import sys, jax
+try:
+    d = jax.devices()
+    sys.exit(0 if d and d[0].platform != "cpu" else 3)
+except Exception:
+    sys.exit(3)
+EOF
+}
+
+others_running() {
+  for s in chip_jobs_r5.sh chip_jobs_r5b.sh chip_jobs_r5c.sh \
+           chip_jobs_r5d.sh chip_jobs_r5e.sh chip_jobs_r5f.sh; do
+    pgrep -f "bash tools/$s" > /dev/null 2>&1 && return 0
+  done
+  return 1
+}
+
+echo "[r5h $(stamp)] waiting for chains r5..r5f to finish"
+while others_running; do
+  sleep 60
+done
+echo "[r5h $(stamp)] predecessors gone; proceeding"
+
+ABORT_PASS=0
+FAILURES=0
+rung() {
+  local name="$1" msg="$2"; shift 2
+  local marker="baselines_out/.r5h_${name}_done"
+  if [ -f "$marker" ] || [ "$ABORT_PASS" = 1 ]; then
+    return 0
+  fi
+  echo "[r5h $(stamp)] ===== rung $name: $* ====="
+  local rc=0
+  "$@" || rc=$?
+  if [ "$rc" = 0 ]; then
+    touch "$marker"
+    commit_evidence "$msg"
+  else
+    echo "[r5h $(stamp)] rung $name FAILED (rc=$rc); probing tunnel"
+    commit_evidence "$msg (partial: rung exited rc=$rc)"
+    FAILURES=$((FAILURES + 1))
+    if ! tpu_up; then
+      echo "[r5h $(stamp)] tunnel down — aborting this pass, back to wait loop"
+      ABORT_PASS=1
+    fi
+  fi
+}
+
+all_done() {
+  for m in flash_b1 geomed_b1 flash_1k geomed_1k; do
+    [ -f "baselines_out/.r5h_${m}_done" ] || return 1
+  done
+  return 0
+}
+
+for outer in 1 2; do
+  echo "[r5h $(stamp)] ===== outer attempt $outer ====="
+  if all_done; then break; fi
+  tools/wait_tpu.sh 60 150 120 || { echo "[r5h $(stamp)] tunnel never came up this window"; continue; }
+  FAILURES=0
+  ABORT_PASS=0
+
+  rung flash_b1 "chip evidence: d~159M LM cyclic+flash T=2048 b1 (scan, HBM-fitted)" \
+    timeout -k 60 3600 python tools/tpu_lm_perf.py --steps 4 --reps 2 \
+      --model-dim 1024 --model-heads 16 --model-layers 12 \
+      --seq-len 2048 --batch-size 1 --remat --scan-layers \
+      --variants lm_cyclic_s1_shared_bf16_flash \
+      --out baselines_out/tpu_lm_perf_159_flash_b1.json
+
+  rung geomed_b1 "chip evidence: d~159M LM geomedian T=2048 b1 (scan, HBM-fitted)" \
+    timeout -k 60 3600 python tools/tpu_lm_perf.py --steps 4 --reps 2 \
+      --model-dim 1024 --model-heads 16 --model-layers 12 \
+      --seq-len 2048 --batch-size 1 --remat --scan-layers \
+      --variants lm_geomedian_bf16 \
+      --out baselines_out/tpu_lm_perf_159_geomed_b1.json
+
+  rung flash_1k "chip evidence: d~159M LM cyclic+flash T=1024 b2 (scan)" \
+    timeout -k 60 3600 python tools/tpu_lm_perf.py --steps 4 --reps 2 \
+      --model-dim 1024 --model-heads 16 --model-layers 12 \
+      --seq-len 1024 --batch-size 2 --remat --scan-layers \
+      --variants lm_cyclic_s1_shared_bf16_flash \
+      --out baselines_out/tpu_lm_perf_159_flash_1k.json
+
+  rung geomed_1k "chip evidence: d~159M LM geomedian T=1024 b2 (scan)" \
+    timeout -k 60 3600 python tools/tpu_lm_perf.py --steps 4 --reps 2 \
+      --model-dim 1024 --model-heads 16 --model-layers 12 \
+      --seq-len 1024 --batch-size 2 --remat --scan-layers \
+      --variants lm_geomedian_bf16 \
+      --out baselines_out/tpu_lm_perf_159_geomed_1k.json
+
+  if all_done; then
+    echo "[r5h $(stamp)] D~159M HBM-FITTED EVIDENCE COMPLETE"
+    break
+  fi
+  echo "[r5h $(stamp)] incomplete ($FAILURES rung failures this pass); retrying"
+  sleep 120
+done
+all_done && exit 0 || exit 1
