@@ -60,7 +60,7 @@ EOF
 others_running() {
   for s in chip_jobs_r5.sh chip_jobs_r5b.sh chip_jobs_r5c.sh \
            chip_jobs_r5d.sh chip_jobs_r5e.sh chip_jobs_r5f.sh \
-           chip_jobs_r5h.sh; do
+           chip_jobs_r5h.sh chip_jobs_r5i.sh; do
     pgrep -f "bash tools/$s" > /dev/null 2>&1 && return 0
   done
   return 1

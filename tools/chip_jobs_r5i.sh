@@ -1,0 +1,142 @@
+#!/bin/bash
+# Round-5 chain i: the d~159M cyclic point at n=6 logical workers.
+# Chain r5h proved the d~159M cyclic OOM is batch-independent: 16.04G of
+# 15.75G at b2 AND b1 (T=2048) — the peak is the coded-path buffers
+# (grad stack (n,d) 5.1G + encode re/im 10.2G at n=8), not activations.
+# n=6 (still s=1-valid: n > 4s) shrinks those to ~11.5G with ZERO
+# semantic/precision changes; geomedian is re-measured at the same n so
+# the decode-vs-geomedian ratio stays matched. Shapes otherwise the
+# flagship T=2048 remat+flash+scan.
+#   1 flash_n6   cyclic shared + flash, n=6, T=2048 b1 remat scan
+#   2 geomed_n6  geomedian,            n=6, T=2048 b1 remat scan
+# Parks until chains r5..r5h are gone.
+#
+# Launch detached (variable indirection — SKILL.md round-5 note):
+#   s=tools/chip_jobs_r5i.sh; setsid nohup bash "$s" > baselines_out/chip_jobs_r5i.log 2>&1 &
+# NEVER edit this file while it runs. Markers: baselines_out/.r5i_<rung>_done
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p baselines_out
+
+stamp() { date -u +"%Y-%m-%dT%H:%M:%SZ"; }
+
+commit_evidence() {
+  local msg="$1"
+  local files
+  shopt -s nullglob
+  files=(baselines_out/*.json baselines_out/*.jsonl baselines_out/*.log)
+  shopt -u nullglob
+  if [ "${#files[@]}" = 0 ]; then
+    echo "[r5i $(stamp)] no artifact files exist yet for: $msg"
+    return 0
+  fi
+  for i in 1 2 3; do
+    if ! git add -- "${files[@]}"; then
+      echo "[r5i $(stamp)] git add failed (attempt $i), retrying"
+      sleep 5
+      continue
+    fi
+    if git diff --cached --quiet -- baselines_out 2>/dev/null; then
+      echo "[r5i $(stamp)] nothing new to commit for: $msg"
+      return 0
+    fi
+    if git commit -q -m "$msg" -- baselines_out; then
+      echo "[r5i $(stamp)] committed: $msg"
+      return 0
+    fi
+    echo "[r5i $(stamp)] git commit failed (attempt $i), retrying"
+    sleep 5
+  done
+  echo "[r5i $(stamp)] WARNING: commit failed for: $msg (evidence still on disk)"
+  return 0
+}
+
+tpu_up() {
+  timeout -k 30 120 python - <<'EOF'
+import sys, jax
+try:
+    d = jax.devices()
+    sys.exit(0 if d and d[0].platform != "cpu" else 3)
+except Exception:
+    sys.exit(3)
+EOF
+}
+
+others_running() {
+  for s in chip_jobs_r5.sh chip_jobs_r5b.sh chip_jobs_r5c.sh \
+           chip_jobs_r5d.sh chip_jobs_r5e.sh chip_jobs_r5f.sh \
+           chip_jobs_r5h.sh; do
+    pgrep -f "bash tools/$s" > /dev/null 2>&1 && return 0
+  done
+  return 1
+}
+
+echo "[r5i $(stamp)] waiting for chains r5..r5h to finish"
+while others_running; do
+  sleep 60
+done
+echo "[r5i $(stamp)] predecessors gone; proceeding"
+
+ABORT_PASS=0
+FAILURES=0
+rung() {
+  local name="$1" msg="$2"; shift 2
+  local marker="baselines_out/.r5i_${name}_done"
+  if [ -f "$marker" ] || [ "$ABORT_PASS" = 1 ]; then
+    return 0
+  fi
+  echo "[r5i $(stamp)] ===== rung $name: $* ====="
+  local rc=0
+  "$@" || rc=$?
+  if [ "$rc" = 0 ]; then
+    touch "$marker"
+    commit_evidence "$msg"
+  else
+    echo "[r5i $(stamp)] rung $name FAILED (rc=$rc); probing tunnel"
+    commit_evidence "$msg (partial: rung exited rc=$rc)"
+    FAILURES=$((FAILURES + 1))
+    if ! tpu_up; then
+      echo "[r5i $(stamp)] tunnel down — aborting this pass, back to wait loop"
+      ABORT_PASS=1
+    fi
+  fi
+}
+
+all_done() {
+  for m in flash_n6 geomed_n6; do
+    [ -f "baselines_out/.r5i_${m}_done" ] || return 1
+  done
+  return 0
+}
+
+for outer in 1 2; do
+  echo "[r5i $(stamp)] ===== outer attempt $outer ====="
+  if all_done; then break; fi
+  tools/wait_tpu.sh 60 150 120 || { echo "[r5i $(stamp)] tunnel never came up this window"; continue; }
+  FAILURES=0
+  ABORT_PASS=0
+
+  rung flash_n6 "chip evidence: d~159M LM cyclic+flash n=6 T=2048 (scan, coded buffers fit)" \
+    timeout -k 60 3600 python tools/tpu_lm_perf.py --steps 4 --reps 2 \
+      --num-workers 6 \
+      --model-dim 1024 --model-heads 16 --model-layers 12 \
+      --seq-len 2048 --batch-size 1 --remat --scan-layers \
+      --variants lm_cyclic_s1_shared_bf16_flash \
+      --out baselines_out/tpu_lm_perf_159_flash_n6.json
+
+  rung geomed_n6 "chip evidence: d~159M LM geomedian n=6 T=2048 (scan, matched pair)" \
+    timeout -k 60 3600 python tools/tpu_lm_perf.py --steps 4 --reps 2 \
+      --num-workers 6 \
+      --model-dim 1024 --model-heads 16 --model-layers 12 \
+      --seq-len 2048 --batch-size 1 --remat --scan-layers \
+      --variants lm_geomedian_bf16 \
+      --out baselines_out/tpu_lm_perf_159_geomed_n6.json
+
+  if all_done; then
+    echo "[r5i $(stamp)] D~159M N=6 MATCHED PAIR COMPLETE"
+    break
+  fi
+  echo "[r5i $(stamp)] incomplete ($FAILURES rung failures this pass); retrying"
+  sleep 120
+done
+all_done && exit 0 || exit 1
