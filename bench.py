@@ -251,6 +251,7 @@ def run(cfg_kwargs, ds, mesh, steps, warmup=1, reps=2, want_flops=False):
         NamedSharding(mesh, P()),
     )
     step_fn = tr.setup.train_step
+    loss_col = tr.setup.metric_names.index("loss")
 
     if jax.devices()[0].platform == "cpu":
         # CPU mesh (smoke runs): block_until_ready IS a real execution
@@ -275,23 +276,21 @@ def run(cfg_kwargs, ds, mesh, steps, warmup=1, reps=2, want_flops=False):
         tr.close()
         return dt, loss, flops
 
-    def loop(state, xs, ys, ms):
-        def body(st, batch):
-            x, y, mask = batch
-            st, metrics = step_fn(st, x, y, mask)
-            return st, metrics["loss"]
-        return jax.lax.scan(body, state, (xs, ys, ms))
-
-    compiled = jax.jit(loop).lower(state, xs, ys, ms).compile()
+    # The timed program IS the production chunked loop: train_many is the
+    # same jitted scan Trainer._run_chunked dispatches with
+    # cfg.steps_per_call = steps — bench numbers measure the path users run,
+    # not a parallel harness that could drift from it.
+    compiled = tr.setup.train_many.lower(state, xs, ys, ms, None).compile()
     # XLA cost analysis counts a scan body ONCE regardless of trip count
     # (verified on this jax: scan(L=5) and scan(L=10) report identical
     # flops), so the loop's flops figure already IS the per-step figure.
     flops = _compiled_flops(compiled) if want_flops else None
 
-    dt, losses = time_scanned_steps(
-        compiled, state, (xs, ys, ms), steps=steps, warmup=warmup, reps=reps
+    dt, blocks = time_scanned_steps(
+        compiled, state, (xs, ys, ms, None), steps=steps, warmup=warmup,
+        reps=reps
     )
-    loss = float(np.asarray(jax.device_get(losses))[-1])
+    loss = float(np.asarray(jax.device_get(blocks))[-1, loss_col])
     tr.close()
     return dt, loss, flops
 
@@ -353,6 +352,11 @@ def measure(args, metric_name, error=None, detail=None):
         "device_kind": device_kind,
         "compute_dtype": "float32",
         "vs_baseline_basis": "simulate_redundancy",
+        # which loop produced the numbers: accelerators time the production
+        # train_many scan with all steps fused into one device program;
+        # CPU times the eager per-step loop (scanned conv steps crawl on
+        # XLA:CPU — PERF.md §4)
+        "steps_per_call": 1 if platform == "cpu" else args.steps,
     }
 
     def record(value_ms, vs_baseline, extra):

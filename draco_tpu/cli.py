@@ -119,6 +119,12 @@ def add_fit_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     p.add_argument("--model-layers", type=int, default=2)
     p.add_argument("--cpu-mesh", type=int, default=0, metavar="N",
                    help="force an N-device virtual CPU mesh (testing without TPUs)")
+    p.add_argument("--steps-per-call", type=int, default=1,
+                   help="K training steps fused into one device program "
+                        "(lax.scan); hides per-step host dispatch/RTT. "
+                        "Eval/checkpoint snap to chunk boundaries. Keep 1 on "
+                        "CPU (XLA:CPU serializes conv thunks in scan bodies, "
+                        "PERF.md §4); raise on accelerators")
     p.add_argument("--compute-dtype", type=str, default="float32",
                    choices=["float32", "bfloat16"],
                    help="forward/backward dtype; bfloat16 runs the MXU at "
@@ -190,6 +196,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         redundancy=args.redundancy,
         decode_granularity=args.decode_granularity,
         compute_dtype=args.compute_dtype,
+        steps_per_call=args.steps_per_call,
         remat=args.remat,
         eval_freq=args.eval_freq,
         train_dir=args.train_dir,

@@ -144,6 +144,18 @@ class TrainConfig:
     # Single-host only (utils/checkpoint.py).
     compress_ckpt: bool = False
 
+    # --- host-loop fusion (TPU-native addition; PERF.md §0/§4b) ---
+    # K training steps fused into ONE jitted lax.scan per device program
+    # (training/step.py train_many): the host dispatches once per K steps and
+    # fetches one (K, m) metrics block instead of per-step scalars, hiding
+    # the ~70 ms/dispatch RTT of remote backends behind useful work.
+    # Eval/checkpoint cadence snaps to chunk boundaries (trainer emits an
+    # explicit remainder chunk, so max_steps need not divide by K).
+    # K=1 keeps today's eager per-step loop bit-for-bit. CPU caveat: XLA:CPU
+    # runs conv thunks inside scan bodies single-threaded (PERF.md §4), so
+    # the default stays 1 — raise it on accelerators.
+    steps_per_call: int = 1
+
     # rematerialise activations in backward (jax.checkpoint) — memory for FLOPs
     remat: bool = False
     # compile the LM's layer stack as one nn.scan over stacked block weights
@@ -245,6 +257,22 @@ class TrainConfig:
             raise ValueError("worker_fail cannot exceed num_workers")
         if self.compute_dtype not in ("float32", "bfloat16"):
             raise ValueError(f"compute_dtype must be float32|bfloat16, got {self.compute_dtype}")
+        if self.steps_per_call < 1:
+            raise ValueError(
+                f"steps_per_call must be >= 1, got {self.steps_per_call}"
+            )
+        if self.steps_per_call > 1 and self.network == "TransformerLM":
+            # every TransformerLM route — CLI or programmatic — runs a
+            # model-parallel driver with its own eager per-step loop
+            # (parallel/{sp,tp,ep,pp}_step.py; the coded-DP Trainer cannot
+            # build token models, models.build_model), so steps_per_call
+            # would be silently ignored there — reject instead
+            raise ValueError(
+                "steps_per_call > 1 is only implemented for the coded-DP "
+                "Trainer loop; TransformerLM always runs the sp/tp/ep/pp "
+                "drivers' own per-step loops (parallel/*_step.py). Keep "
+                "steps_per_call=1 with TransformerLM."
+            )
         if self.straggle_mode not in ("none", "drop"):
             raise ValueError(f"unknown straggle_mode: {self.straggle_mode}")
         if self.decode_granularity not in ("global", "layer"):

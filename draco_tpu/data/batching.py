@@ -81,6 +81,80 @@ def indices_cyclic(n_samples: int, step: int, num_workers: int, batch_size: int,
     return idx
 
 
+# ---- vectorized step ranges (the scan-chunked trainer's index path) -------
+#
+# The chunked loop (training/trainer.py, cfg.steps_per_call > 1) feeds K
+# steps per device program, so it wants all K steps' indices at once. Each
+# *_range function returns a (k, n·B) block whose row i is bitwise identical
+# to the per-step function at step0 + i — the equivalence the chunked-vs-
+# eager trainer tests pin. One permutation fetch per (stream, epoch) instead
+# of per step; the slice-with-wrap is one fancy-index gather.
+
+
+def _perm_rows(perm_for_epoch, epochs: np.ndarray, cols: np.ndarray) -> np.ndarray:
+    """Gather ``perm_for_epoch(e)[cols[i]]`` for each step row i (epochs[i]=e),
+    fetching each epoch's permutation once."""
+    out = np.empty(cols.shape, dtype=np.int64)
+    for e in np.unique(epochs):
+        rows = epochs == e
+        out[rows] = perm_for_epoch(int(e))[cols[rows]]
+    return out
+
+
+def _range_cols(offs: np.ndarray, width: int, n_samples: int) -> np.ndarray:
+    """(k, width) positions of each step's slice, wrap folded in: identical to
+    ``_perm_slice``'s take-then-wrap for every width <= n_samples."""
+    starts = (offs * width) % n_samples
+    return (starts[:, None] + np.arange(width)[None, :]) % n_samples
+
+
+def indices_baseline_range(n_samples: int, step0: int, k: int, num_workers: int,
+                           batch_size: int, seed: int) -> np.ndarray:
+    """(k, n·B) stacked flat indices; row i == indices_baseline(step0 + i)."""
+    bpe = max(n_samples // batch_size, 1)
+    steps = np.arange(step0, step0 + k)
+    epochs, offs = steps // bpe, steps % bpe
+    cols = _range_cols(offs, batch_size, n_samples)
+    out = np.empty((k, num_workers * batch_size), dtype=np.int64)
+    for w in range(num_workers):
+        out[:, w * batch_size : (w + 1) * batch_size] = _perm_rows(
+            lambda e, w=w: drng.epoch_permutation(seed + 31 * (w + 1), e, n_samples),
+            epochs, cols,
+        )
+    return out
+
+
+def indices_grouped_range(n_samples: int, step0: int, k: int, num_workers: int,
+                          group_size: int, batch_size: int,
+                          seeds: np.ndarray) -> np.ndarray:
+    """(k, n·B) stacked flat indices; row i == indices_grouped(step0 + i)."""
+    bpe = max(n_samples // batch_size, 1)
+    steps = np.arange(step0, step0 + k)
+    epochs, offs = steps // bpe, steps % bpe
+    cols = _range_cols(offs, batch_size, n_samples)
+    out = np.empty((k, num_workers * batch_size), dtype=np.int64)
+    for w in range(num_workers):
+        out[:, w * batch_size : (w + 1) * batch_size] = _perm_rows(
+            lambda e, w=w: drng.epoch_permutation(
+                int(seeds[w // group_size]), e, n_samples),
+            epochs, cols,
+        )
+    return out
+
+
+def indices_cyclic_range(n_samples: int, step0: int, k: int, num_workers: int,
+                         batch_size: int, seed: int) -> np.ndarray:
+    """(k, n·B) stacked flat indices; row i == indices_cyclic(step0 + i)."""
+    global_bs = num_workers * batch_size
+    bpe = max(n_samples // global_bs, 1)
+    steps = np.arange(step0, step0 + k)
+    epochs, offs = steps // bpe, steps % bpe
+    cols = _range_cols(offs, global_bs, n_samples)
+    return _perm_rows(
+        lambda e: drng.epoch_permutation(seed, e, n_samples), epochs, cols
+    )
+
+
 def gather(ds: Dataset, idx: np.ndarray, num_workers: int, batch_size: int):
     """Indices -> (n, B, ...) batches + (n, B) labels."""
     x, y = get_batch(ds, idx)

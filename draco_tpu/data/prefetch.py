@@ -14,7 +14,7 @@ so callers never branch.
 
 from __future__ import annotations
 
-from typing import Callable, Optional
+from typing import Any, Callable, Optional
 
 import numpy as np
 
@@ -22,34 +22,40 @@ from draco_tpu import native
 from draco_tpu.data.datasets import Dataset
 
 
-class BatchPrefetcher:
-    """Pipelined gather: ``get(step)`` returns step's batch, then immediately
-    begins assembling ``step+1``'s in the background.
+class _PipelinedGather:
+    """Submit/wait scaffolding shared by both prefetchers, keyed on an
+    opaque hashable request (a step int, or a (start, k) chunk range).
 
-    indices_fn: step -> flat (n·B,) sample indices (deterministic, cheap).
+    Subclasses provide ``_request_indices(key) -> sample indices`` (any
+    shape; flattened for the gather) and ``_reshape(x, idx, key)``. ``_get``
+    returns ``key``'s batch and immediately submits ``next_key``'s gather to
+    the native pool (the pipeline overlap); synchronous numpy fallback when
+    the native library is absent.
     """
 
-    def __init__(self, ds: Dataset, indices_fn: Callable[[int], np.ndarray],
-                 num_workers: int, batch_size: int, num_threads: int = 4):
+    def __init__(self, ds: Dataset, num_workers: int, batch_size: int,
+                 num_threads: int = 4):
         self.ds = ds
-        self.indices_fn = indices_fn
         self.num_workers = num_workers
         self.batch_size = batch_size
         self._src = np.ascontiguousarray(ds.train_x)  # loader gathers raw rows
         self._loader: Optional[native.BatchLoader] = None
         if native.AVAILABLE:
             self._loader = native.BatchLoader(num_threads)
-        self._inflight: Optional[tuple[int, int, np.ndarray]] = None  # (step, ticket, idx)
+        # (key, ticket, idx) of the request being assembled in the background
+        self._inflight: Optional[tuple[Any, int, np.ndarray]] = None
 
-    def _reshape(self, x: np.ndarray, idx: np.ndarray):
-        y = self.ds.train_y[idx].reshape(self.num_workers, self.batch_size)
-        return x.reshape((self.num_workers, self.batch_size) + x.shape[1:]), y
+    def _request_indices(self, key) -> np.ndarray:
+        raise NotImplementedError
 
-    def get(self, step: int):
+    def _reshape(self, x: np.ndarray, idx: np.ndarray, key):
+        raise NotImplementedError
+
+    def _get(self, key, next_key):
         if self._loader is None:
-            idx = self.indices_fn(step)
-            return self._reshape(self._src[idx], idx)
-        if self._inflight is not None and self._inflight[0] == step:
+            idx = self._request_indices(key)
+            return self._reshape(self._src[idx.reshape(-1)], idx, key)
+        if self._inflight is not None and self._inflight[0] == key:
             _, ticket, idx = self._inflight
             self._inflight = None
             x = self._loader.wait(ticket)
@@ -57,13 +63,16 @@ class BatchPrefetcher:
             if self._inflight is not None:
                 self._loader.wait(self._inflight[1])
                 self._inflight = None
-            idx = self.indices_fn(step)
-            ticket = self._loader.submit(self._src, idx)
-            x = self._loader.wait(ticket)
-        batch = self._reshape(x, idx)
-        nxt = step + 1
-        nidx = self.indices_fn(nxt)
-        self._inflight = (nxt, self._loader.submit(self._src, nidx), nidx)
+            idx = self._request_indices(key)
+            x = self._loader.wait(self._loader.submit(self._src, idx.reshape(-1)))
+        batch = self._reshape(x, idx, key)
+        if next_key is not None:
+            nidx = self._request_indices(next_key)
+            self._inflight = (
+                next_key,
+                self._loader.submit(self._src, nidx.reshape(-1)),
+                nidx,
+            )
         return batch
 
     def close(self):
@@ -73,3 +82,59 @@ class BatchPrefetcher:
                 self._inflight = None
             self._loader.close()
             self._loader = None
+
+
+class BatchPrefetcher(_PipelinedGather):
+    """Pipelined gather: ``get(step)`` returns step's batch, then immediately
+    begins assembling ``step+1``'s in the background.
+
+    indices_fn: step -> flat (n·B,) sample indices (deterministic, cheap).
+    """
+
+    def __init__(self, ds: Dataset, indices_fn: Callable[[int], np.ndarray],
+                 num_workers: int, batch_size: int, num_threads: int = 4):
+        super().__init__(ds, num_workers, batch_size, num_threads)
+        self.indices_fn = indices_fn
+
+    def _request_indices(self, step: int) -> np.ndarray:
+        return self.indices_fn(step)
+
+    def _reshape(self, x: np.ndarray, idx: np.ndarray, step):
+        y = self.ds.train_y[idx].reshape(self.num_workers, self.batch_size)
+        return x.reshape((self.num_workers, self.batch_size) + x.shape[1:]), y
+
+    def get(self, step: int):
+        return self._get(step, step + 1)
+
+
+class ChunkPrefetcher(_PipelinedGather):
+    """Stacked-chunk gather for the scan-fused trainer (cfg.steps_per_call>1).
+
+    ``get((start, k), next_range)`` returns the (k, n, B, ...) batch block for
+    steps [start, start+k) and immediately submits ``next_range``'s gather to
+    the native thread pool, so the host assembles chunk i+1 while the device
+    executes chunk i's fused program. One flat (k·n·B,) gather per chunk —
+    the per-row cost is identical to the per-step path, the submit/wait
+    round-trips are k× rarer.
+
+    range_indices_fn: (start, k) -> (k, n·B) sample indices (the vectorized
+    batching.indices_*_range family).
+    """
+
+    def __init__(self, ds: Dataset, range_indices_fn,
+                 num_workers: int, batch_size: int, num_threads: int = 4):
+        super().__init__(ds, num_workers, batch_size, num_threads)
+        self.range_indices_fn = range_indices_fn
+
+    def _request_indices(self, rng: tuple) -> np.ndarray:
+        return self.range_indices_fn(*rng)
+
+    def _reshape(self, x: np.ndarray, idx: np.ndarray, rng: tuple):
+        k = rng[1]
+        n, b = self.num_workers, self.batch_size
+        y = self.ds.train_y[idx.reshape(-1)].reshape(k, n, b)
+        return x.reshape((k, n, b) + x.shape[1:]), y
+
+    def get(self, rng: tuple, next_range: Optional[tuple] = None):
+        return self._get(tuple(rng),
+                         tuple(next_range) if next_range is not None else None)

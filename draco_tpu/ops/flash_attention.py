@@ -32,6 +32,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax < 0.6 names the Mosaic params class TPUCompilerParams; same kwargs
+_CompilerParams = getattr(pltpu, "CompilerParams",
+                          getattr(pltpu, "TPUCompilerParams", None))
+
 from draco_tpu.ops.coded import use_pallas
 
 NEG_INF = -1e30
@@ -188,7 +192,7 @@ def _flash_fwd(q, k, v, scale, bq, bk, causal, interpret):
             pltpu.VMEM((bq, _LANE), jnp.float32),
             pltpu.VMEM((bq, _LANE), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -334,7 +338,7 @@ def _flash_bwd(q, k, v, o, lse, do, dlse, scale, bq, bk, causal, interpret):
         out_specs=pl.BlockSpec((1, bq, dh), q_row),
         out_shape=jax.ShapeDtypeStruct((g, t, dh), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, dh), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -368,7 +372,7 @@ def _flash_bwd(q, k, v, o, lse, do, dlse, scale, bq, bk, causal, interpret):
             pltpu.VMEM((bk, dh), jnp.float32),
             pltpu.VMEM((bk, dh), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,

@@ -35,11 +35,11 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from draco_tpu import optim, rng as drng
 from draco_tpu.coding import cyclic as cyclic_mod
+from draco_tpu.runtime import shard_map
 from draco_tpu.config import TrainConfig
 from draco_tpu.models.transformer import Block
 from draco_tpu.parallel.common import (

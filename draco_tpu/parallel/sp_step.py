@@ -25,11 +25,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from draco_tpu import optim, rng as drng
 from draco_tpu.coding import cyclic as cyclic_mod
+from draco_tpu.runtime import shard_map
 from draco_tpu.config import TrainConfig
 from draco_tpu.models.transformer import TransformerLM
 from draco_tpu.parallel.a2a_attention import a2a_attention

@@ -23,6 +23,24 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 WORKER_AXIS = "w"
 
+try:  # jax >= 0.6: top-level export, replication check spelled check_vma
+    from jax import shard_map
+except ImportError:  # jax < 0.6: experimental home, check_rep spelling
+    from jax.experimental.shard_map import shard_map as _shard_map_legacy
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+        return _shard_map_legacy(f, mesh, in_specs, out_specs,
+                                 check_rep=check_vma)
+
+
+def axis_size(axis_name) -> int:
+    """``lax.axis_size``, with the jax < 0.6 fallback spelling: psum of a
+    unit constant, which constant-folds to a static Python int at trace
+    time (so loop bounds / permutation lists built from it stay static)."""
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    return jax.lax.psum(1, axis_name)
+
 _CACHE_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)),
                           ".jax_cache")
 

@@ -71,6 +71,11 @@ class TrainSetup(NamedTuple):
     code: Any  # CyclicCode | RepetitionCode | None
     unravel: Any  # flat (d,) -> params pytree
     dim: int
+    # K fused steps in ONE device program:
+    # (state, xs (K,n,B,...), ys (K,n,B), masks (K,n), presents (K,n)|None)
+    #   -> (state, metrics (K, len(metric_names)) float32)
+    train_many: Any = None
+    metric_names: tuple = ()  # column order of train_many's metrics block
 
 
 def _cross_entropy(logits, labels):
@@ -365,8 +370,37 @@ def build_train_setup(cfg: TrainConfig, mesh, dataset_name: Optional[str] = None
         ok5 = jnp.any(jax.lax.top_k(logits, 5)[1] == y[:, None], axis=1) & valid
         return jnp.sum(ok1.astype(jnp.float32)), jnp.sum(ok5.astype(jnp.float32))
 
+    # ---- K fused steps in one device program ------------------------------
+    # The reference pays its PS round trip once per step; the timing harness
+    # (bench.py / utils/timing.py) already had to fold iterations into one
+    # lax.scan to measure honestly behind remote-dispatch backends (~70 ms
+    # RTT per launch, PERF.md §0). train_many makes that fold the PRODUCTION
+    # loop: K full coded steps — fwd/bwd, encode, gather, decode, update —
+    # scan-chained with the state carry donated, schedules sliced on device,
+    # and per-step metrics accumulated into one (K, m) block the host
+    # fetches once per chunk. The chunk length K is the operands' leading
+    # dim, so one program per distinct chunk size (the trainer's main K and
+    # its remainder chunks), not per call.
+    metric_names = ("loss", "prec1") + (
+        ("honest_located",) if cfg.approach == "cyclic" else ()
+    )
+
+    def many_body(state: TrainState, xs, ys, masks, presents):
+        def body(st, operand):
+            x, y, adv_mask, present = operand
+            st, metrics = step_body(st, x, y, adv_mask, present)
+            row = jnp.stack(
+                [jnp.asarray(metrics[k], jnp.float32) for k in metric_names]
+            )
+            return st, row
+
+        # presents=None threads through as an empty pytree: the scan slices
+        # per-step (n,) rows from each (K, n) schedule on device
+        return jax.lax.scan(body, state, (xs, ys, masks, presents))
+
     with mesh:
         train_step = jax.jit(step_body, donate_argnums=(0,))
+        train_many = jax.jit(many_body, donate_argnums=(0,))
         eval_step = jax.jit(eval_body)
 
     return TrainSetup(
@@ -377,4 +411,6 @@ def build_train_setup(cfg: TrainConfig, mesh, dataset_name: Optional[str] = None
         code=code if cfg.approach == "cyclic" else rep_code,
         unravel=unravel,
         dim=dim,
+        train_many=train_many,
+        metric_names=metric_names,
     )

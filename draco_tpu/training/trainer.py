@@ -5,6 +5,21 @@ DistributedWorker.train and their coded variants, SURVEY.md §3) with one loop:
 build batches (deterministic, approach-specific), device_put them sharded over
 the worker axis, call the jitted step, emit metrics with the reference's
 segment names, checkpoint every eval_freq steps.
+
+Two execution regimes, selected by ``cfg.steps_per_call``:
+
+* K=1 (default): the eager per-step loop — one dispatch, one metrics fetch,
+  one ``block_until_ready`` per step. Honest on CPU (PERF.md §4: XLA:CPU
+  serializes conv thunks inside scan bodies) and the bitwise reference for
+  the chunked path.
+* K>1: the scan-chunked loop — ``train_many`` fuses K full coded steps into
+  one device program (training/step.py); the host runs a two-deep pipeline
+  (assemble + device_put chunk i+1 while chunk i executes), metrics are
+  deferred (K, m) device blocks materialized only at log/eval/checkpoint
+  boundaries, and there is NO host sync in steady state. Eval/checkpoint
+  cadence snaps to chunk boundaries via explicit remainder chunks, so
+  ``max_steps`` need not divide by K. This is what hides the ~70 ms/dispatch
+  RTT of remote backends (PERF.md §0) behind useful device work.
 """
 
 from __future__ import annotations
@@ -21,11 +36,11 @@ from draco_tpu import rng as drng
 from draco_tpu.config import TrainConfig
 from draco_tpu.data import batching
 from draco_tpu.data.datasets import Dataset, load_dataset
-from draco_tpu.data.prefetch import BatchPrefetcher
+from draco_tpu.data.prefetch import BatchPrefetcher, ChunkPrefetcher
 from draco_tpu.runtime import WORKER_AXIS, make_mesh, put_global
 from draco_tpu.training.step import build_train_setup
 from draco_tpu.utils import checkpoint as ckpt
-from draco_tpu.utils.metrics import MetricWriter, Segments
+from draco_tpu.utils.metrics import DeferredMetricWriter, MetricWriter, Segments
 
 
 class Trainer:
@@ -51,10 +66,13 @@ class Trainer:
             if cfg.straggle_mode == "drop" and cfg.straggle_count > 0
             else None
         )
+        self._sched_steps = cfg.max_steps  # rows precomputed in the schedules
         self._group_seeds = drng.group_seeds(cfg.seed, max(cfg.num_groups, 1))
-        self._prefetch = BatchPrefetcher(
-            self.ds, self._batch_indices, cfg.num_workers, cfg.batch_size
-        )
+        # both prefetchers are lazy: the chunked path never touches the
+        # per-step one (and vice versa), so neither thread pool should
+        # exist until its loop actually runs
+        self._prefetch: Optional[BatchPrefetcher] = None
+        self._chunk_prefetch: Optional[ChunkPrefetcher] = None
         self._start_step = 1
         if cfg.checkpoint_step:
             self.restore(cfg.checkpoint_step)
@@ -75,6 +93,11 @@ class Trainer:
                                        cfg.batch_size, cfg.seed)
 
     def _host_batch(self, step: int):
+        if self._prefetch is None:
+            self._prefetch = BatchPrefetcher(
+                self.ds, self._batch_indices, self.cfg.num_workers,
+                self.cfg.batch_size
+            )
         return self._prefetch.get(step)
 
     def _device_batch(self, step: int):
@@ -84,6 +107,78 @@ class Trainer:
             put_global(np.asarray(y), self._shard_w),
         )
 
+    # ---- schedules -------------------------------------------------------
+    def _ensure_schedules(self, n_steps: int) -> None:
+        """Keep the adversary/straggler tables live past cfg.max_steps.
+
+        ``run(max_steps=N)`` with N > cfg.max_steps used to replay the last
+        precomputed row forever via ``min(step, cfg.max_steps)`` — block-wise
+        callers like tools/time_to_acc.py silently trained against a frozen
+        adversary set past the table end. Regeneration at the larger length
+        is prefix-stable (each row consumes a fixed amount of the numpy
+        stream), so already-trained steps keep their exact schedule."""
+        if n_steps <= self._sched_steps:
+            return
+        cfg = self.cfg
+        self._adv_schedule = drng.adversary_schedule(
+            cfg.seed, n_steps, cfg.num_workers, cfg.num_adversaries
+        )
+        if self._straggle_schedule is not None:
+            self._straggle_schedule = drng.straggler_schedule(
+                cfg.seed, n_steps, cfg.num_workers, cfg.straggle_count
+            )
+        self._sched_steps = n_steps
+
+    # ---- chunking --------------------------------------------------------
+    def _chunk_ranges(self, start: int, n_steps: int) -> list:
+        """[(start, k), ...] covering steps [start, n_steps]: chunks of up to
+        cfg.steps_per_call steps, snapped so every eval_freq multiple (and
+        the final step) ends a chunk — the explicit remainder chunks that
+        keep eval/checkpoint cadence exact when max_steps % K != 0."""
+        K = max(self.cfg.steps_per_call, 1)
+        ef = self.cfg.eval_freq
+        out = []
+        s = start
+        while s <= n_steps:
+            e = min(s + K - 1, n_steps)
+            if ef:
+                e = min(e, ((s - 1) // ef + 1) * ef)
+            out.append((s, e - s + 1))
+            s = e + 1
+        return out
+
+    def _chunk_indices(self, start: int, k: int) -> np.ndarray:
+        """(k, n·B) flat sample indices for 1-based steps [start, start+k) —
+        row i bitwise equals _batch_indices(start + i)."""
+        cfg = self.cfg
+        n = len(self.ds)
+        if cfg.approach == "baseline":
+            return batching.indices_baseline_range(
+                n, start - 1, k, cfg.num_workers, cfg.batch_size, cfg.seed)
+        if cfg.approach == "maj_vote":
+            return batching.indices_grouped_range(
+                n, start - 1, k, cfg.num_workers, cfg.group_size,
+                cfg.batch_size, self._group_seeds)
+        return batching.indices_cyclic_range(
+            n, start - 1, k, cfg.num_workers, cfg.batch_size, cfg.seed)
+
+    def _device_chunk(self, rng: tuple, next_range: Optional[tuple]):
+        """Assemble + upload one stacked chunk; submits next_range's host
+        gather to the native pool before returning (double buffering)."""
+        start, k = rng
+        x, y = self._chunk_prefetch.get(rng, next_range)
+        shard = NamedSharding(self.mesh, P(None, WORKER_AXIS))
+        xs = put_global(np.asarray(x), shard)
+        ys = put_global(np.asarray(y), shard)
+        # numpy (uncommitted) so multi-host jit treats them as replicated
+        masks = np.asarray(self._adv_schedule[start : start + k])
+        presents = (
+            np.asarray(~self._straggle_schedule[start : start + k])
+            if self._straggle_schedule is not None
+            else None
+        )
+        return xs, ys, masks, presents
+
     # ---- train -----------------------------------------------------------
     def run(self, max_steps: Optional[int] = None,
             profile_dir: Optional[str] = None,
@@ -91,22 +186,40 @@ class Trainer:
         """Train. ``profile_dir`` captures a jax.profiler trace of steps
         [profile_steps) — the structured replacement for the reference's
         printed per-phase timers (SURVEY.md §5.1); the t_fetch/t_comp segment
-        metrics keep the reference's names either way."""
+        metrics keep the reference's names either way. With
+        cfg.steps_per_call > 1 the scan-chunked loop runs instead of the
+        eager per-step loop (module docstring); trace capture then snaps to
+        the chunks containing profile_steps."""
+        n_steps = max_steps if max_steps is not None else self.cfg.max_steps
+        self._ensure_schedules(n_steps)
+        if self.cfg.steps_per_call > 1:
+            last = self._run_chunked(n_steps, profile_dir, profile_steps)
+        else:
+            last = self._run_eager(n_steps, profile_dir, profile_steps)
+        # advance the cursor so a subsequent run(max_steps=...) continues
+        # instead of retraining from step 1 (block-wise callers:
+        # tools/time_to_acc.py)
+        self._start_step = max(self._start_step, n_steps + 1)
+        return last
+
+    def _run_eager(self, n_steps: int, profile_dir, profile_steps) -> dict:
         cfg = self.cfg
         last = {}
-        n_steps = max_steps if max_steps is not None else cfg.max_steps
+        profiling = False
         for step in range(self._start_step, n_steps + 1):
             if profile_dir and step == profile_steps[0] and self._is_main:
                 jax.profiler.start_trace(profile_dir)
-            if profile_dir and step == profile_steps[1] and self._is_main:
+                profiling = True
+            if profiling and step == profile_steps[1]:
                 jax.profiler.stop_trace()
+                profiling = False
             seg = Segments()
             seg.begin("fetch")
             x, y = self._device_batch(step)
             # numpy (uncommitted) so multi-host jit treats it as replicated
-            mask = np.asarray(self._adv_schedule[min(step, cfg.max_steps)])
+            mask = np.asarray(self._adv_schedule[step])
             present = (
-                np.asarray(~self._straggle_schedule[min(step, cfg.max_steps)])
+                np.asarray(~self._straggle_schedule[step])
                 if self._straggle_schedule is not None
                 else None
             )
@@ -133,11 +246,99 @@ class Trainer:
                 if cfg.train_dir:
                     ckpt.save(cfg.train_dir, step, self.state,
                               compress=cfg.compress_ckpt)
-        # advance the cursor so a subsequent run(max_steps=...) continues
-        # instead of retraining from step 1 (block-wise callers:
-        # tools/time_to_acc.py)
-        self._start_step = max(self._start_step, n_steps + 1)
+        if profiling:  # loop ended before profile_steps[1]
+            jax.profiler.stop_trace()
         return last
+
+    def _run_chunked(self, n_steps: int, profile_dir, profile_steps) -> dict:
+        """The scan-fused loop: dispatch train_many per chunk, upload the
+        next chunk while the device runs the current one, defer metrics to
+        flush boundaries. The only host syncs are the metric-block fetches
+        at those boundaries (plus eval/checkpoint, which need the state)."""
+        cfg = self.cfg
+        setup = self.setup
+        ranges = self._chunk_ranges(self._start_step, n_steps)
+        if not ranges:
+            return {}
+        if self._chunk_prefetch is None:
+            self._chunk_prefetch = ChunkPrefetcher(
+                self.ds, self._chunk_indices, cfg.num_workers, cfg.batch_size
+            )
+        deferred = DeferredMetricWriter(self.writer)
+
+        def should_log(step):
+            return step % cfg.log_every == 0 or step == 1
+
+        profiling = profiled = False
+        # t_fetch = this chunk's host assemble + upload wall; t_comp = the
+        # flush window's remaining wall (device execution + drain) amortized
+        # over its steps — same record keys as the eager loop's segments
+        window_t0 = time.perf_counter()
+        window_fetch = 0.0
+        window_steps = 0
+
+        def upload(i):
+            nonlocal window_fetch
+            t0 = time.perf_counter()
+            c = self._device_chunk(
+                ranges[i], ranges[i + 1] if i + 1 < len(ranges) else None
+            )
+            dt = time.perf_counter() - t0
+            window_fetch += dt
+            return c, dt
+
+        chunk, fetch_s = upload(0)
+        for i, (start, k) in enumerate(ranges):
+            end = start + k - 1
+            if (profile_dir and not profiling and not profiled
+                    and self._is_main and end >= profile_steps[0]):
+                jax.profiler.start_trace(profile_dir)
+                profiling = True
+            xs, ys, masks, presents = chunk
+            self.state, block = setup.train_many(self.state, xs, ys, masks,
+                                                 presents)
+            extras = {"t_fetch": round(fetch_s / k, 6)}
+            if presents is not None:
+                extras["present"] = presents.sum(axis=1)
+            deferred.defer(range(start, end + 1), setup.metric_names, block,
+                           extras)
+            window_steps += k
+            if i + 1 < len(ranges):  # overlap: upload i+1 during chunk i
+                chunk, fetch_s = upload(i + 1)
+            boundary = bool(cfg.eval_freq) and end % cfg.eval_freq == 0
+            if boundary or i + 1 == len(ranges) or deferred.depth >= 4:
+                # drain the window's chunks BEFORE reading the clock so the
+                # device-execution wall lands in t_comp, not in no-window
+                # limbo (flush's np.asarray would otherwise absorb it after
+                # window_t0 resets); this is the boundary's one true sync.
+                # A device→host fetch, NOT block_until_ready: the latter is
+                # only a dispatch barrier on remote-dispatch backends
+                # (utils/timing.py, PERF.md §0)
+                deferred.sync()
+                t_comp = max(time.perf_counter() - window_t0 - window_fetch,
+                             0.0)
+                deferred.flush(should_log,
+                               {"t_comp": round(t_comp / window_steps, 6)})
+                window_t0 = time.perf_counter()
+                window_fetch = 0.0
+                window_steps = 0
+            if profiling and end >= profile_steps[1] - 1:
+                jax.block_until_ready(self.state.params)
+                jax.profiler.stop_trace()
+                profiling = False
+                profiled = True
+            if boundary:
+                self.evaluate(end)
+                if cfg.train_dir:
+                    ckpt.save(cfg.train_dir, end, self.state,
+                              compress=cfg.compress_ckpt)
+                # eval/checkpoint wall must not leak into the next window's
+                # t_comp (the eager loop's Segments exclude them too)
+                window_t0 = time.perf_counter()
+        if profiling:
+            jax.block_until_ready(self.state.params)
+            jax.profiler.stop_trace()
+        return deferred.last
 
     # ---- eval ------------------------------------------------------------
     def evaluate(self, step: int, batch_size: Optional[int] = None) -> dict:
@@ -157,7 +358,10 @@ class Trainer:
         return rec
 
     def close(self):
-        self._prefetch.close()
+        if self._prefetch is not None:
+            self._prefetch.close()
+        if self._chunk_prefetch is not None:
+            self._chunk_prefetch.close()
         self.writer.close()
 
     # ---- checkpoint ------------------------------------------------------

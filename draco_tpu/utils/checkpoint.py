@@ -102,11 +102,14 @@ def load(train_dir: str, step: int, abstract_state: Any) -> Any:
         # no clue that pre-unification constant-schedule checkpoints (opt
         # state was the bare rule's, optim.py docstring) legitimately
         # cannot restore into the current chain(rule, scale_by_schedule)
-        # structure. Gate requires structure-AND-match (or treedef) in the
-        # message so IO errors whose *paths* contain words like 'tree'
-        # don't get dressed up as a version problem.
+        # structure. Gate requires structure-AND-match (or treedef, or
+        # Orbax's container-kind complaint "Expected dict, got [...]" —
+        # the error this exact break actually raises) in the message so IO
+        # errors whose *paths* contain words like 'tree' don't get dressed
+        # up as a version problem.
         msg = str(e).lower()
-        if ("structure" in msg and "match" in msg) or "treedef" in msg:
+        if (("structure" in msg and "match" in msg) or "treedef" in msg
+                or re.search(r"expected (dict|list|tuple|pytree)", msg)):
             raise ValueError(
                 f"checkpoint restore of '{path}' failed with a pytree "
                 f"structure mismatch: {e}\n"

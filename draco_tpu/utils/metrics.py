@@ -12,6 +12,8 @@ import sys
 import time
 from typing import Optional
 
+import numpy as np
+
 
 class MetricWriter:
     """JSONL metrics to ``train_dir/metrics.jsonl`` + human lines to stdout."""
@@ -40,6 +42,68 @@ class MetricWriter:
     def close(self):
         if self._fh:
             self._fh.close()
+
+
+class DeferredMetricWriter:
+    """Chunk-boundary materialization for the scan-fused trainer loop.
+
+    The chunked loop (trainer._run_chunked) hands each chunk's (K, m) device
+    metrics block over right after dispatch via :meth:`defer` — no device
+    fetch, no host sync. Only :meth:`flush` (called at log/eval/checkpoint
+    boundaries) converts the pending blocks to host floats and writes the
+    per-step records through the wrapped :class:`MetricWriter`. The JSONL
+    schema and the reference segment names are unchanged; only WHEN the
+    device→host fetch happens moves, which is the whole point: in steady
+    state the host never blocks on the device between chunks.
+    """
+
+    def __init__(self, writer: MetricWriter):
+        self._writer = writer
+        # (steps, names, device block, per-chunk extras)
+        self._pending: list = []
+        self.last: dict = {}  # most recent materialized record (any step)
+
+    @property
+    def depth(self) -> int:
+        return len(self._pending)
+
+    def defer(self, steps, names, block, extras: Optional[dict] = None):
+        """Queue a chunk: ``block[i, j]`` is metric ``names[j]`` at
+        ``steps[i]``. ``extras`` maps key -> scalar (broadcast over the
+        chunk) or per-step sequence; values must already be host data."""
+        self._pending.append((list(steps), tuple(names), block, extras or {}))
+
+    def sync(self) -> None:
+        """Execution barrier: device→host fetch of one element of the
+        NEWEST pending block. ``jax.block_until_ready`` only awaits dispatch
+        on remote-dispatch backends (utils/timing.py, PERF.md §0); an actual
+        transfer is the one portable way to await execution, and chunks run
+        in program order, so the newest block landing means every pending
+        chunk has executed. No-op when nothing is pending."""
+        if self._pending:
+            np.asarray(self._pending[-1][2][-1, 0])
+
+    def flush(self, should_log=None, common: Optional[dict] = None) -> dict:
+        """Materialize every pending chunk (THE device fetch) and write the
+        records for steps where ``should_log(step)`` (default: all).
+        ``common`` merges into every flushed record (e.g. the amortized
+        t_comp known only at the sync point). Returns the last record."""
+        for steps, names, block, extras in self._pending:
+            vals = np.asarray(block)  # blocks until the chunk has executed
+            for i, step in enumerate(steps):
+                rec = {"step": step}
+                rec.update(
+                    {k: float(vals[i, j]) for j, k in enumerate(names)}
+                )
+                for k, v in extras.items():
+                    rec[k] = float(v[i]) if np.ndim(v) else float(v)
+                if common:
+                    rec.update(common)
+                self.last = rec
+                if should_log is None or should_log(step):
+                    self._writer.write(rec)
+        self._pending = []
+        return self.last
 
 
 class Segments:

@@ -1,0 +1,282 @@
+"""Scan-chunked trainer (cfg.steps_per_call > 1): bitwise equivalence with
+the eager loop, chunk-boundary snapping, mid-chunk resume, vectorized range
+batching, live schedules past the precomputed table, and the pre-r4
+checkpoint format-break message.
+
+The equivalence tests are the load-bearing ones: train_many is the SAME
+coded step (fwd/bwd → encode → gather → decode → update) scan-chained K at
+a time, so K ∈ {1, 4} must produce identical final parameters and an
+identical metrics stream — under a live adversary AND a straggler-drop
+schedule, for all three approaches. FC keeps the compiles cheap; nothing
+here depends on the network.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from draco_tpu import rng as drng
+from draco_tpu.config import TrainConfig
+from draco_tpu.data import batching
+from draco_tpu.data.datasets import load_dataset
+from draco_tpu.runtime import make_mesh
+from draco_tpu.training.trainer import Trainer
+
+
+@pytest.fixture(scope="module")
+def ds():
+    return load_dataset("synthetic-mnist", synthetic_train=512, synthetic_test=64)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(8)
+
+
+def make_cfg(**kw):
+    base = dict(
+        network="FC",
+        dataset="synthetic-mnist",
+        batch_size=4,
+        lr=0.01,
+        momentum=0.9,
+        num_workers=8,
+        max_steps=6,
+        eval_freq=0,
+        train_dir="",
+        log_every=1,
+    )
+    base.update(kw)
+    return TrainConfig(**base)
+
+
+def params_vec(tr):
+    return np.concatenate(
+        [np.ravel(x) for x in jax.tree.leaves(jax.device_get(tr.state.params))]
+    )
+
+
+def metric_stream(train_dir):
+    """[(step, {metric: value})] from metrics.jsonl, timing keys dropped —
+    the cross-loop-comparable part of the record stream."""
+    out = []
+    with open(os.path.join(train_dir, "metrics.jsonl")) as fh:
+        for line in fh:
+            rec = json.loads(line)
+            if "loss" not in rec:
+                continue  # eval records
+            vals = {k: v for k, v in rec.items()
+                    if k not in ("time", "t_fetch", "t_comp", "step")}
+            out.append((rec["step"], vals))
+    return out
+
+
+# --------------------------------------------------------------------------
+# chunked vs eager equivalence — all three approaches, adversary + stragglers
+# --------------------------------------------------------------------------
+
+APPROACHES = {
+    # n=9 so the cyclic joint budget t + e <= s holds with a LIVE adversary
+    # and a straggler drop in the same run (s=2, t=1, e=1, n > 4s)
+    "cyclic": dict(approach="cyclic", num_workers=9, worker_fail=2,
+                   adversary_count=1, err_mode="rev_grad",
+                   straggle_mode="drop", straggle_count=1,
+                   redundancy="shared"),
+    "maj_vote": dict(approach="maj_vote", group_size=4, worker_fail=1,
+                     err_mode="rev_grad", straggle_mode="drop",
+                     straggle_count=1),
+    "baseline": dict(approach="baseline", mode="geometric_median",
+                     worker_fail=1, err_mode="rev_grad",
+                     straggle_mode="drop", straggle_count=1),
+}
+
+
+@pytest.mark.parametrize("approach", sorted(APPROACHES))
+def test_chunked_equals_eager_bitwise(ds, approach, tmp_path):
+    """Same final params AND same metrics stream for K=1 (eager loop) vs
+    K=4 (scan-chunked, with a remainder chunk since 6 % 4 != 0)."""
+    kw = APPROACHES[approach]
+    mesh = make_mesh(kw.get("num_workers", 8))
+    out = {}
+    for k in (1, 4):
+        d = str(tmp_path / f"{approach}_k{k}")
+        tr = Trainer(make_cfg(**kw, steps_per_call=k, train_dir=d),
+                     mesh=mesh, dataset=ds, quiet=True)
+        last = tr.run()
+        out[k] = (params_vec(tr), metric_stream(d), last)
+        tr.close()
+    np.testing.assert_array_equal(out[1][0], out[4][0])
+    assert out[1][1] == out[4][1]  # identical per-step metric values
+    assert [s for s, _ in out[4][1]] == list(range(1, 7))
+    # the returned last-record agrees on the training metrics too
+    for key in ("loss", "prec1", "present"):
+        assert out[1][2][key] == out[4][2][key]
+
+
+@pytest.mark.core
+def test_chunked_smoke_fast(ds, mesh):
+    """Tier-1/core smoke: small FC model, K=3 with a remainder chunk,
+    adversary on — the chunked loop trains and the loss moves."""
+    cfg = make_cfg(approach="cyclic", worker_fail=1, err_mode="rev_grad",
+                   redundancy="shared", steps_per_call=3, max_steps=7,
+                   log_every=1000)
+    tr = Trainer(cfg, mesh=mesh, dataset=ds, quiet=True)
+    first = tr.run(max_steps=1)  # remainder-sized chunk (k=1)
+    last = tr.run()
+    tr.close()
+    assert np.isfinite(last["loss"])
+    assert last["loss"] < first["loss"]
+    assert last["step"] == 7
+    assert last["honest_located"] == 6.0
+
+
+def test_chunk_ranges_snap_to_eval_and_remainder(ds, mesh):
+    """Chunk boundaries: eval_freq multiples and max_steps always end a
+    chunk, chunks never exceed K, and the ranges tile [start, n] exactly."""
+    tr = Trainer(make_cfg(steps_per_call=4, eval_freq=6, max_steps=15),
+                 mesh=mesh, dataset=ds, quiet=True)
+    ranges = tr._chunk_ranges(1, 15)
+    assert ranges == [(1, 4), (5, 2), (7, 4), (11, 2), (13, 3)]
+    flat = [s + i for s, k in ranges for i in range(k)]
+    assert flat == list(range(1, 16))
+    # resume mid-grid: first chunk shortens to the next boundary
+    assert tr._chunk_ranges(5, 12) == [(5, 2), (7, 4), (11, 2)]
+    tr.close()
+
+
+def test_resume_from_checkpoint_mid_chunk(ds, mesh, tmp_path):
+    """A K=4 run checkpoints at eval boundaries (3, 6, 9); resuming from
+    step 3 — mid-chunk relative to the K grid — must land on the exact same
+    parameters as the uninterrupted run."""
+    base = dict(approach="cyclic", worker_fail=1, err_mode="rev_grad",
+                redundancy="shared", steps_per_call=4, max_steps=10,
+                eval_freq=3, train_dir=str(tmp_path))
+    t1 = Trainer(make_cfg(**base), mesh=mesh, dataset=ds, quiet=True)
+    t1.run()
+    v1 = params_vec(t1)
+    t1.close()
+    from draco_tpu.utils import checkpoint as ckpt
+
+    assert ckpt.available_steps(str(tmp_path)) == [3, 6, 9]
+    t2 = Trainer(make_cfg(**base, checkpoint_step=3), mesh=mesh, dataset=ds,
+                 quiet=True)
+    assert t2._start_step == 4
+    t2.run()
+    v2 = params_vec(t2)
+    t2.close()
+    np.testing.assert_array_equal(v1, v2)
+
+
+# --------------------------------------------------------------------------
+# vectorized range batching == per-step batching
+# --------------------------------------------------------------------------
+
+def test_range_indices_match_per_step():
+    """Every *_range row must be bitwise identical to the per-step function —
+    including across an epoch boundary (n_samples small vs the range)."""
+    n, workers, bs, seed = 100, 4, 8, 428
+    step0, k = 1, 9  # baseline bpe = 12: crosses no epoch; cyclic bpe = 3: crosses two
+    got = batching.indices_baseline_range(n, step0, k, workers, bs, seed)
+    want = np.stack([batching.indices_baseline(n, step0 + i, workers, bs, seed)
+                     for i in range(k)])
+    np.testing.assert_array_equal(got, want)
+
+    seeds = drng.group_seeds(seed, 2)
+    got = batching.indices_grouped_range(n, step0, k, workers, 2, bs, seeds)
+    want = np.stack([batching.indices_grouped(n, step0 + i, workers, 2, bs, seeds)
+                     for i in range(k)])
+    np.testing.assert_array_equal(got, want)
+
+    got = batching.indices_cyclic_range(n, step0, k, workers, bs, seed)
+    want = np.stack([batching.indices_cyclic(n, step0 + i, workers, bs, seed)
+                     for i in range(k)])
+    np.testing.assert_array_equal(got, want)
+
+
+def test_range_indices_cross_epoch_baseline():
+    """Force the baseline/grouped epoch boundary too (bpe small)."""
+    n, workers, bs, seed = 40, 2, 16, 7  # bpe = 2
+    got = batching.indices_baseline_range(n, 0, 7, workers, bs, seed)
+    want = np.stack([batching.indices_baseline(n, i, workers, bs, seed)
+                     for i in range(7)])
+    np.testing.assert_array_equal(got, want)
+
+
+# --------------------------------------------------------------------------
+# schedules stay live past the precomputed table (regression: the old
+# min(step, cfg.max_steps) clamp replayed the last row forever)
+# --------------------------------------------------------------------------
+
+def test_schedule_extends_past_table(ds, mesh):
+    cfg = make_cfg(approach="baseline", mode="geometric_median",
+                   worker_fail=2, err_mode="rev_grad", max_steps=4,
+                   straggle_mode="drop", straggle_count=1, log_every=1000)
+    tr = Trainer(cfg, mesh=mesh, dataset=ds, quiet=True)
+    old_adv = tr._adv_schedule.copy()
+    old_str = tr._straggle_schedule.copy()
+    assert old_adv.shape[0] == 5
+    tr.run(max_steps=12)  # block-wise callers go past cfg.max_steps
+    # extended, prefix-stable, and equal to a fresh full-length draw
+    assert tr._adv_schedule.shape[0] == 13
+    np.testing.assert_array_equal(tr._adv_schedule[:5], old_adv)
+    np.testing.assert_array_equal(
+        tr._adv_schedule,
+        drng.adversary_schedule(cfg.seed, 12, cfg.num_workers,
+                                cfg.num_adversaries))
+    np.testing.assert_array_equal(tr._straggle_schedule[:5], old_str)
+    np.testing.assert_array_equal(
+        tr._straggle_schedule,
+        drng.straggler_schedule(cfg.seed, 12, cfg.num_workers,
+                                cfg.straggle_count))
+    # the tail is a live draw, not the frozen last row (whp for 2-of-8)
+    tail = tr._adv_schedule[5:]
+    assert not all(np.array_equal(row, old_adv[4]) for row in tail)
+    tr.close()
+
+
+def test_chunked_run_past_table_matches_eager(ds, mesh):
+    """Both loops agree when run(max_steps) overruns cfg.max_steps — the
+    chunked path extends the same schedules the eager path now uses."""
+    kw = dict(approach="cyclic", worker_fail=1, err_mode="rev_grad",
+              redundancy="shared", max_steps=3, log_every=1000)
+    vecs = {}
+    for k in (1, 4):
+        tr = Trainer(make_cfg(**kw, steps_per_call=k), mesh=mesh, dataset=ds,
+                     quiet=True)
+        tr.run(max_steps=9)
+        vecs[k] = params_vec(tr)
+        tr.close()
+    np.testing.assert_array_equal(vecs[1], vecs[4])
+
+
+# --------------------------------------------------------------------------
+# pre-r4 checkpoint format break surfaces a named error (ADVICE r4)
+# --------------------------------------------------------------------------
+
+def test_pre_r4_opt_state_restore_names_format_break(tmp_path):
+    """Restoring a bare-rule (pre-unification) opt state into the current
+    chain(rule, scale_by_schedule) structure must raise the explanatory
+    ValueError naming the opt-state unification, not a raw pytree error."""
+    import optax
+
+    from draco_tpu.training.step import TrainState
+    from draco_tpu.utils import checkpoint as ckpt
+
+    params = {"w": jnp.ones((3,))}
+    old = TrainState(params=params,
+                     opt_state=optax.sgd(0.01, momentum=0.9).init(params),
+                     batch_stats=None, step=jnp.asarray(1, jnp.int32))
+    ckpt.save(str(tmp_path), 5, old)
+
+    new_opt = optax.chain(optax.sgd(1.0, momentum=0.9),
+                          optax.scale_by_schedule(lambda t: 0.01))
+    new = TrainState(params=params, opt_state=new_opt.init(params),
+                     batch_stats=None, step=jnp.asarray(1, jnp.int32))
+    abstract = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(np.shape(x), jnp.asarray(x).dtype), new)
+    with pytest.raises(ValueError, match="opt-state unification"):
+        ckpt.load(str(tmp_path), 5, abstract)

@@ -273,6 +273,19 @@ def test_tpu_lm_perf_simulate_variant(tmp_path):
     reports more FLOPs than shared at identical loss (exact decode)."""
     import json
 
+    try:
+        from jax._src import xla_bridge
+        initialized = xla_bridge.backends_are_initialized()
+    except Exception:  # private API — if it moves, don't fail collection;
+        initialized = True  # assume initialized (skip) rather than flake
+    if initialized:
+        # --cpu-mesh 4 appends to XLA_FLAGS, which is inert once another
+        # test has initialized jax (conftest pins an 8-device mesh); the
+        # >2x flops threshold below is partition-count sensitive (measured:
+        # 2.21x on the intended 4-device mesh, 1.93x on 8), so the assert
+        # is only meaningful when the tool really gets its 4-device mesh
+        pytest.skip("jax already initialized; --cpu-mesh 4 cannot apply")
+
     from tools import tpu_lm_perf
 
     out = tmp_path / "lm_sim.json"

@@ -1,0 +1,140 @@
+#!/usr/bin/env python
+"""Host-loop overhead microbench: eager per-step dispatch vs the scan-chunked
+trainer (cfg.steps_per_call = K), measured on the PRODUCTION ``Trainer.run``
+path — not a synthetic harness.
+
+The eager loop pays, per step: one jitted dispatch, a per-metric device
+fetch, a ``block_until_ready``, and a fresh device_put (PERF.md §0 documents
+~70 ms of host/RTT cost per dispatch on the remote tunnel; on local CPU the
+same costs are tens of microseconds but still per-step). The chunked loop
+pays them once per K steps. This tool times both regimes over the same
+config/seed/steps and emits a JSON artifact so the win (or the CPU caveat)
+is recorded per-platform.
+
+Model default is FC on synthetic MNIST: matmul-only, so XLA:CPU's
+single-threaded scan-body conv execution (PERF.md §4) does not distort the
+host-overhead comparison on the CPU mesh. Conv nets on CPU should keep
+steps_per_call=1 regardless of what this tool reports for FC.
+
+Output: one JSON (default baselines_out/host_loop_overhead.json).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def measure_loop(cfg_kwargs: dict, ds, mesh, warmup_steps: int,
+                 timed_steps: int) -> float:
+    """ms/step of Trainer.run over ``timed_steps`` steps, after a warmup run
+    that settles compilation (main chunk shape) and the prefetch pipeline."""
+    import jax
+
+    from draco_tpu.config import TrainConfig
+    from draco_tpu.training.trainer import Trainer
+
+    cfg = TrainConfig(**cfg_kwargs)
+    tr = Trainer(cfg, mesh=mesh, dataset=ds, quiet=True)
+    try:
+        tr.run(max_steps=warmup_steps)
+        jax.block_until_ready(tr.state.params)
+        t0 = time.perf_counter()
+        tr.run(max_steps=warmup_steps + timed_steps)
+        jax.block_until_ready(tr.state.params)
+        return (time.perf_counter() - t0) / timed_steps * 1000.0
+    finally:
+        tr.close()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", type=str,
+                    default="baselines_out/host_loop_overhead.json")
+    ap.add_argument("--network", type=str, default="FC")
+    ap.add_argument("--dataset", type=str, default="synthetic-mnist")
+    ap.add_argument("--approach", type=str, default="cyclic")
+    ap.add_argument("--worker-fail", type=int, default=1)
+    ap.add_argument("--err-mode", type=str, default="rev_grad")
+    ap.add_argument("--num-workers", type=int, default=8)
+    ap.add_argument("--batch-size", type=int, default=16)
+    ap.add_argument("--steps", type=int, default=64,
+                    help="timed steps per regime (each K must divide it)")
+    ap.add_argument("--ks", type=str, default="1,8,16",
+                    help="comma list of steps_per_call values; 1 = eager")
+    ap.add_argument("--cpu-mesh", type=int, default=0)
+    args = ap.parse_args(argv)
+
+    from draco_tpu.cli import maybe_force_cpu_mesh
+
+    maybe_force_cpu_mesh(args)
+
+    import jax
+
+    from draco_tpu.data.datasets import load_dataset
+    from draco_tpu.runtime import make_mesh
+
+    ks = sorted({max(int(k), 1) for k in args.ks.split(",")})
+    if 1 not in ks:
+        ks = [1] + ks
+    for k in ks:
+        if args.steps % k:
+            raise SystemExit(f"--steps {args.steps} must be divisible by K={k}")
+
+    ds = load_dataset(args.dataset, synthetic_train=4096, synthetic_test=128)
+    mesh = make_mesh(args.num_workers)
+    dev = jax.devices()[0]
+
+    common = dict(
+        network=args.network, dataset=args.dataset,
+        approach=args.approach, worker_fail=args.worker_fail,
+        err_mode=args.err_mode, num_workers=args.num_workers,
+        batch_size=args.batch_size, lr=0.01, momentum=0.9,
+        max_steps=2 * args.steps + max(ks), eval_freq=0, train_dir="",
+        log_every=10**9,
+    )
+
+    rows = {}
+    for k in ks:
+        ms = measure_loop(dict(common, steps_per_call=k), ds, mesh,
+                          warmup_steps=k, timed_steps=args.steps)
+        rows[str(k)] = round(ms, 4)
+        print(f"K={k}: {ms:.3f} ms/step", flush=True)
+
+    eager = rows["1"]
+    big_ks = [k for k in ks if k >= 8]
+    best_big = min((rows[str(k)] for k in big_ks), default=None)
+    report = {
+        "platform": dev.platform,
+        "device_kind": getattr(dev, "device_kind", dev.platform),
+        "config": {
+            "network": args.network, "dataset": args.dataset,
+            "approach": args.approach, "worker_fail": args.worker_fail,
+            "err_mode": args.err_mode, "num_workers": args.num_workers,
+            "batch_size_per_worker": args.batch_size,
+            "timed_steps": args.steps,
+        },
+        "ms_per_step_by_steps_per_call": rows,
+        "eager_ms_per_step": eager,
+        "best_chunked_k8plus_ms_per_step": best_big,
+        "overhead_saved_ms_per_step": (
+            round(eager - best_big, 4) if best_big is not None else None
+        ),
+        "chunked_k8plus_lowers_overhead": (
+            best_big is not None and best_big < eager
+        ),
+    }
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+    with open(args.out, "w") as fh:
+        json.dump(report, fh, indent=1)
+    print(json.dumps(report))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -50,6 +50,9 @@ def main(argv=None) -> int:
     ap.add_argument("--target", type=float, default=0.98)
     ap.add_argument("--eval-every", type=int, default=50)
     ap.add_argument("--max-steps", type=int, default=1500)
+    ap.add_argument("--steps-per-call", type=int, default=1,
+                    help="K steps fused per device program (the production "
+                         "scan-chunked loop); keep 1 on CPU (PERF.md §4)")
     ap.add_argument("--cpu-mesh", type=int, default=0)
     args = ap.parse_args(argv)
 
@@ -73,6 +76,7 @@ def main(argv=None) -> int:
         num_workers=args.num_workers, worker_fail=args.worker_fail,
         err_mode=args.err_mode, adversarial=args.adversarial,
         max_steps=args.max_steps, eval_freq=0,
+        steps_per_call=args.steps_per_call,
         train_dir="", log_every=10**9,
     )
     ds = load_dataset(cfg.dataset, cfg.data_dir)
@@ -119,6 +123,7 @@ def main(argv=None) -> int:
             "err_mode": args.err_mode, "adversarial": args.adversarial,
             "num_workers": args.num_workers,
             "batch_size_per_worker": args.batch_size, "lr": args.lr,
+            "steps_per_call": args.steps_per_call,
         },
         "target_prec1": args.target,
         "reached": reached,
