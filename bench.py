@@ -355,7 +355,9 @@ def measure(args, metric_name, error=None, detail=None):
         # which loop produced the numbers: accelerators time the production
         # train_many scan with all steps fused into one device program;
         # CPU times the eager per-step loop (scanned conv steps crawl on
-        # XLA:CPU — PERF.md §4)
+        # XLA:CPU — PERF.md §4). The LM analogue records the same key in
+        # tools/tpu_lm_perf.py (--production-loop times the chunked
+        # parallel/token_loop.py driver, PERF.md §4b).
         "steps_per_call": 1 if platform == "cpu" else args.steps,
     }
 

@@ -121,10 +121,19 @@ def add_fit_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                    help="force an N-device virtual CPU mesh (testing without TPUs)")
     p.add_argument("--steps-per-call", type=int, default=1,
                    help="K training steps fused into one device program "
-                        "(lax.scan); hides per-step host dispatch/RTT. "
-                        "Eval/checkpoint snap to chunk boundaries. Keep 1 on "
-                        "CPU (XLA:CPU serializes conv thunks in scan bodies, "
-                        "PERF.md §4); raise on accelerators")
+                        "(lax.scan) — the CNN Trainer and every "
+                        "TransformerLM route (sp/tp/ep/pp); hides per-step "
+                        "host dispatch/RTT. Eval/checkpoint snap to chunk "
+                        "boundaries. Keep 1 for conv nets on CPU (XLA:CPU "
+                        "serializes conv thunks in scan bodies, PERF.md §4); "
+                        "raise on accelerators and for matmul-dominated "
+                        "models (TransformerLM/FC) everywhere")
+    p.add_argument("--token-gen", type=str, default="host",
+                   choices=["host", "device"],
+                   help="TransformerLM token stream: host-generated numpy "
+                        "batches, or regenerated in-graph from the scalar "
+                        "(seed, step) so the chunked loop uploads K scalars "
+                        "per dispatch (parallel/token_loop.py)")
     p.add_argument("--compute-dtype", type=str, default="float32",
                    choices=["float32", "bfloat16"],
                    help="forward/backward dtype; bfloat16 runs the MXU at "
@@ -197,6 +206,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         decode_granularity=args.decode_granularity,
         compute_dtype=args.compute_dtype,
         steps_per_call=args.steps_per_call,
+        token_gen=args.token_gen,
         remat=args.remat,
         eval_freq=args.eval_freq,
         train_dir=args.train_dir,

@@ -146,15 +146,28 @@ class TrainConfig:
 
     # --- host-loop fusion (TPU-native addition; PERF.md §0/§4b) ---
     # K training steps fused into ONE jitted lax.scan per device program
-    # (training/step.py train_many): the host dispatches once per K steps and
-    # fetches one (K, m) metrics block instead of per-step scalars, hiding
-    # the ~70 ms/dispatch RTT of remote backends behind useful work.
-    # Eval/checkpoint cadence snaps to chunk boundaries (trainer emits an
-    # explicit remainder chunk, so max_steps need not divide by K).
-    # K=1 keeps today's eager per-step loop bit-for-bit. CPU caveat: XLA:CPU
-    # runs conv thunks inside scan bodies single-threaded (PERF.md §4), so
-    # the default stays 1 — raise it on accelerators.
+    # (training/step.py train_many for the coded-DP CNN Trainer;
+    # parallel/common.py make_token_train_many + parallel/token_loop.py for
+    # every TransformerLM route — single-shard, sp, tp, pp, ep): the host
+    # dispatches once per K steps and fetches one (K, m) metrics block
+    # instead of per-step scalars, hiding the ~70 ms/dispatch RTT of remote
+    # backends behind useful work. Eval/checkpoint cadence snaps to chunk
+    # boundaries (explicit remainder chunks, so max_steps need not divide
+    # by K). K=1 keeps today's eager per-step loop bit-for-bit. CPU caveat:
+    # XLA:CPU runs conv thunks inside scan bodies single-threaded
+    # (PERF.md §4), so the default stays 1 for conv nets — raise it on
+    # accelerators (and freely for the matmul-dominated TransformerLM /
+    # FC, where the caveat does not apply — PERF.md §4b).
     steps_per_call: int = 1
+    # Where the synthetic token stream is generated (TransformerLM routes):
+    # "host" — numpy synthetic_text per step, uploaded per step/chunk (the
+    # historical stream); "device" — the chunked driver regenerates each
+    # step's batch in-graph from the scalar (seed, step)
+    # (sp_step.synthetic_text_in_graph), so a chunk's upload is K int32
+    # scalars and the host token path disappears. The two streams are
+    # distinct deterministic draws (jax PRNG vs numpy MT19937); either is
+    # internally bitwise-reproducible across K.
+    token_gen: str = "host"
 
     # rematerialise activations in backward (jax.checkpoint) — memory for FLOPs
     remat: bool = False
@@ -261,17 +274,16 @@ class TrainConfig:
             raise ValueError(
                 f"steps_per_call must be >= 1, got {self.steps_per_call}"
             )
-        if self.steps_per_call > 1 and self.network == "TransformerLM":
-            # every TransformerLM route — CLI or programmatic — runs a
-            # model-parallel driver with its own eager per-step loop
-            # (parallel/{sp,tp,ep,pp}_step.py; the coded-DP Trainer cannot
-            # build token models, models.build_model), so steps_per_call
-            # would be silently ignored there — reject instead
+        if self.token_gen not in ("host", "device"):
             raise ValueError(
-                "steps_per_call > 1 is only implemented for the coded-DP "
-                "Trainer loop; TransformerLM always runs the sp/tp/ep/pp "
-                "drivers' own per-step loops (parallel/*_step.py). Keep "
-                "steps_per_call=1 with TransformerLM."
+                f"token_gen must be host|device, got {self.token_gen}"
+            )
+        if self.token_gen == "device" and self.network != "TransformerLM":
+            # the CNN Trainer trains on dataset rows, not a generated token
+            # stream — there is nothing for the in-graph generator to replace
+            raise ValueError(
+                "token_gen='device' applies to the TransformerLM token "
+                "routes only (the CNN Trainer reads dataset batches)"
             )
         if self.straggle_mode not in ("none", "drop"):
             raise ValueError(f"unknown straggle_mode: {self.straggle_mode}")

@@ -184,3 +184,26 @@ def cyclic_global_batch(ds: Dataset, step: int, num_workers: int, batch_size: in
     sub-batch k, to be gathered per worker via code.batch_ids."""
     idx = indices_cyclic(len(ds), step, num_workers, batch_size, seed)
     return gather(ds, idx, num_workers, batch_size)
+
+
+def chunk_ranges(start: int, last: int, steps_per_call: int,
+                 eval_freq: int) -> list:
+    """[(start, k), ...] covering 1-based steps [start, last]: chunks of up
+    to ``steps_per_call`` steps, snapped so every ``eval_freq`` multiple (and
+    the final step) ends a chunk — the explicit remainder chunks that keep
+    eval/checkpoint cadence exact when the step count doesn't divide by K.
+
+    The ONE chunk-boundary rule for every scan-fused loop (the CNN
+    ``Trainer._run_chunked`` and the LM ``run_token_loop`` chunked regime) —
+    a snapping fix here can't diverge between them.
+    """
+    K = max(steps_per_call, 1)
+    out = []
+    s = start
+    while s <= last:
+        e = min(s + K - 1, last)
+        if eval_freq:
+            e = min(e, ((s - 1) // eval_freq + 1) * eval_freq)
+        out.append((s, e - s + 1))
+        s = e + 1
+    return out

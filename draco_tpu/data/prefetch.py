@@ -138,3 +138,53 @@ class ChunkPrefetcher(_PipelinedGather):
     def get(self, rng: tuple, next_range: Optional[tuple] = None):
         return self._get(tuple(rng),
                          tuple(next_range) if next_range is not None else None)
+
+
+class TokenChunkPrefetcher:
+    """Stacked-chunk assembly for the chunked LM token loop
+    (parallel/token_loop.py, cfg.steps_per_call > 1).
+
+    Same double-buffer contract as :class:`ChunkPrefetcher`, but the
+    per-step "gather" is synthetic token *generation* (sp_step.synthetic_text
+    — numpy, no dataset rows), so the background engine is a single worker
+    thread instead of the native row-gather pool: ``get((start, k),
+    next_range)`` returns the (k, n, B, T) int32 block for steps
+    [start, start + k) and immediately submits ``next_range``'s generation,
+    so the host builds chunk i+1 while the device executes chunk i.
+
+    gen_fn: step -> (n, B, T) tokens (deterministic, per-step).
+    """
+
+    def __init__(self, gen_fn: Callable[[int], np.ndarray]):
+        import concurrent.futures
+
+        self._gen = gen_fn
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="token-chunk-prefetch"
+        )
+        self._inflight: Optional[tuple] = None  # (range, future)
+
+    def _assemble(self, rng: tuple) -> np.ndarray:
+        start, k = rng
+        return np.stack([self._gen(step) for step in range(start, start + k)])
+
+    def get(self, rng: tuple, next_range: Optional[tuple] = None) -> np.ndarray:
+        rng = tuple(rng)
+        if self._inflight is not None and self._inflight[0] == rng:
+            block = self._inflight[1].result()
+            self._inflight = None
+        else:  # cold start / non-sequential access (e.g. resume)
+            if self._inflight is not None:
+                self._inflight[1].result()
+                self._inflight = None
+            block = self._assemble(rng)
+        if next_range is not None:
+            nxt = tuple(next_range)
+            self._inflight = (nxt, self._pool.submit(self._assemble, nxt))
+        return block
+
+    def close(self):
+        if self._inflight is not None:
+            self._inflight[1].result()
+            self._inflight = None
+        self._pool.shutdown(wait=True)

@@ -88,3 +88,49 @@ def apply_flat_update(state, agg: jnp.ndarray, opt, unravel):
     updates, new_opt = opt.update(grads_tree, state.opt_state, state.params)
     new_params = jax.tree.map(lambda p, u: p + u, state.params, updates)
     return new_params, new_opt
+
+
+# column order of the (K, m) metric block train_token_many returns — the LM
+# step bodies emit exactly one scalar metric today; extend here (and in every
+# step_body) if the routes ever grow more
+TOKEN_METRIC_NAMES = ("loss",)
+
+
+def make_token_train_many(step_body, token_fn=None,
+                          metric_names=TOKEN_METRIC_NAMES):
+    """K fused LM coded steps in ONE ``lax.scan`` — the token-route analogue
+    of the CNN path's ``train_many`` (training/step.py).
+
+    ``step_body(state, tokens, adv_mask, present) -> (state, metrics)`` is
+    any route's single-step body (sp/tp/ep share the flat-gradient tail in
+    this module; pp brings its pipeline schedule). The returned
+    ``many_body(state, tokens, masks, presents)`` scans it over the leading
+    K axis of every operand and stacks the per-step metrics into a (K, m)
+    float32 block the host fetches once per flush window. ``presents=None``
+    threads through as an empty pytree, exactly like ``train_many``.
+
+    ``token_fn`` (optional): in-graph token generator ``step -> (n, B, T)``
+    (cfg.token_gen == "device"). When set, the first scanned operand is the
+    (K,) int32 step-index vector instead of the (K, n, B, T) token block —
+    the host uploads K scalars per chunk and the device synthesizes the
+    tokens itself, the same closed-over-constant-free discipline as
+    rng.random_projection_factors_in_graph.
+
+    Callers jit with ``donate_argnums=(0,)`` inside the route's mesh context
+    so the K-step state carry reuses the input buffers.
+    """
+
+    def many_body(state, tokens, masks, presents):
+        def body(st, operand):
+            toks, adv_mask, present = operand
+            if token_fn is not None:
+                toks = token_fn(toks)
+            st, metrics = step_body(st, toks, adv_mask, present)
+            row = jnp.stack(
+                [jnp.asarray(metrics[k], jnp.float32) for k in metric_names]
+            )
+            return st, row
+
+        return jax.lax.scan(body, state, (tokens, masks, presents))
+
+    return many_body

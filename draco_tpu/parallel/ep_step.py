@@ -23,10 +23,10 @@ from jax.sharding import PartitionSpec as P
 
 from draco_tpu.config import TrainConfig
 from draco_tpu.parallel.mesh import EP_AXIS
+from draco_tpu.parallel.token_loop import run_token_loop
 from draco_tpu.parallel.tp_step import (
     TPTrainSetup,
     _build_gspmd_train_setup,
-    run_token_loop,
 )
 
 EXPERT_PARAMS = ("w1", "w2", "b1", "b2")

@@ -43,8 +43,10 @@ from draco_tpu.runtime import shard_map
 from draco_tpu.config import TrainConfig
 from draco_tpu.models.transformer import Block
 from draco_tpu.parallel.common import (
+    TOKEN_METRIC_NAMES,
     aggregate_flat_grads,
     apply_flat_update,
+    make_token_train_many,
     masked_loss_metric,
 )
 from draco_tpu.parallel.mesh import PP_AXIS
@@ -113,6 +115,11 @@ class PPTrainSetup(NamedTuple):
     code: Optional[cyclic_mod.CyclicCode]
     unravel: any
     dim: int
+    # K fused LM steps in ONE device program (parallel/common.py):
+    # (state, toks (K,n,B,T) | steps (K,), masks (K,n), presents (K,n)|None)
+    #   -> (state, metrics (K, len(metric_names)) float32)
+    train_token_many: any = None
+    metric_names: tuple = TOKEN_METRIC_NAMES
 
 
 def _flatten_rows(tree) -> jnp.ndarray:
@@ -329,23 +336,30 @@ def build_pp_train_setup(cfg: TrainConfig, mesh) -> PPTrainSetup:
     def eval_body(params, tokens):
         return jnp.mean(per_worker_loss(params, tokens))
 
+    from draco_tpu.parallel.sp_step import token_fn_from_cfg
+
     with mesh:
         train_step = jax.jit(step_body, donate_argnums=(0,))
         eval_step = jax.jit(eval_body)
         loss_jit = jax.jit(per_worker_loss)
         grads_jit = jax.jit(per_worker_grads)
+        train_token_many = jax.jit(
+            make_token_train_many(step_body, token_fn_from_cfg(cfg)),
+            donate_argnums=(0,),
+        )
 
     return PPTrainSetup(
         state=state, train_step=train_step, eval_step=eval_step,
         per_worker_loss=loss_jit, per_worker_grads=grads_jit,
         code=code, unravel=unravel, dim=dim,
+        train_token_many=train_token_many,
     )
 
 
 def train_pp(cfg: TrainConfig, mesh, steps: Optional[int] = None,
              quiet: bool = False):
     """PP training loop; returns (state, last metrics)."""
-    from draco_tpu.parallel.tp_step import run_token_loop
+    from draco_tpu.parallel.token_loop import run_token_loop
 
     setup = build_pp_train_setup(cfg, mesh)
     return run_token_loop(setup, cfg, steps, quiet, tag="pp")

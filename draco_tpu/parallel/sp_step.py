@@ -34,8 +34,10 @@ from draco_tpu.config import TrainConfig
 from draco_tpu.models.transformer import TransformerLM
 from draco_tpu.parallel.a2a_attention import a2a_attention
 from draco_tpu.parallel.common import (
+    TOKEN_METRIC_NAMES,
     aggregate_flat_grads,
     apply_flat_update,
+    make_token_train_many,
     masked_loss_metric,
 )
 from draco_tpu.parallel.mesh import SEQ_AXIS
@@ -52,6 +54,11 @@ class SPTrainSetup(NamedTuple):
     code: Optional[cyclic_mod.CyclicCode]
     unravel: any
     dim: int
+    # K fused LM steps in ONE device program (parallel/common.py):
+    # (state, toks (K,n,B,T) | steps (K,), masks (K,n), presents (K,n)|None)
+    #   -> (state, metrics (K, len(metric_names)) float32)
+    train_token_many: any = None
+    metric_names: tuple = TOKEN_METRIC_NAMES
 
 
 def synthetic_text(seed: int, step: int, n: int, batch: int, seq_len: int, vocab: int):
@@ -62,6 +69,38 @@ def synthetic_text(seed: int, step: int, n: int, batch: int, seq_len: int, vocab
     stride = r.randint(1, 3, size=(n, batch, 1))
     idx = np.arange(seq_len)[None, None, :]
     return ((start + stride * idx) % vocab).astype(np.int32)
+
+
+def synthetic_text_in_graph(seed: int, step, n: int, batch: int, seq_len: int,
+                            vocab: int):
+    """In-graph counterpart of :func:`synthetic_text` (cfg.token_gen ==
+    "device"): the same ramp construction (start + stride·i mod vocab,
+    stride ∈ {1, 2}), generated INSIDE the jitted program from the scalar
+    (seed, step) — ``step`` may be traced, so a scanned K-step driver feeds
+    it per-iteration from the (K,) step vector and the host never assembles
+    or uploads a token block at all (the discipline of
+    rng.random_projection_factors_in_graph). Values come from the jax PRNG,
+    not numpy's MT19937, so the two streams differ draw-by-draw while
+    sharing distribution and the property that matters: every participant
+    derives the identical batch from (seed, step)."""
+    key = jax.random.fold_in(jax.random.key(seed), step)
+    k_start, k_stride = jax.random.split(key)
+    start = jax.random.randint(k_start, (n, batch, 1), 0, vocab)
+    stride = jax.random.randint(k_stride, (n, batch, 1), 1, 3)
+    idx = jnp.arange(seq_len)[None, None, :]
+    return ((start + stride * idx) % vocab).astype(jnp.int32)
+
+
+def token_fn_from_cfg(cfg: TrainConfig):
+    """The in-graph per-step token generator for cfg.token_gen == "device"
+    (None for the default host-generated stream) — shared by every LM route
+    builder so the scanned drivers can't disagree on the stream."""
+    if cfg.token_gen != "device":
+        return None
+    return lambda step: synthetic_text_in_graph(
+        cfg.seed, step, cfg.num_workers, cfg.batch_size, cfg.seq_len,
+        cfg.vocab,
+    )
 
 
 def build_sp_train_setup(cfg: TrainConfig, mesh) -> SPTrainSetup:
@@ -260,18 +299,23 @@ def build_sp_train_setup(cfg: TrainConfig, mesh) -> SPTrainSetup:
     with mesh:
         train_step = jax.jit(step_body, donate_argnums=(0,))
         eval_step = jax.jit(eval_body)
+        train_token_many = jax.jit(
+            make_token_train_many(step_body, token_fn_from_cfg(cfg)),
+            donate_argnums=(0,),
+        )
 
     return SPTrainSetup(
         model=model, state=state, train_step=train_step, eval_step=eval_step,
         code=code, unravel=unravel, dim=dim,
+        train_token_many=train_token_many,
     )
 
 
 def train_sp(cfg: TrainConfig, mesh, steps: Optional[int] = None, quiet: bool = False):
     """SP training loop on the synthetic text stream; returns the final state
-    and last-step metrics. Checkpoint/eval/resume semantics live in the
-    shared token loop (tp_step.run_token_loop)."""
-    from draco_tpu.parallel.tp_step import run_token_loop
+    and last-step metrics. Checkpoint/eval/resume/chunking semantics live in
+    the shared token loop (parallel/token_loop.py)."""
+    from draco_tpu.parallel.token_loop import run_token_loop
 
     return run_token_loop(build_sp_train_setup(cfg, mesh), cfg, steps, quiet,
                           tag="sp")

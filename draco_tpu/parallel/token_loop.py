@@ -1,0 +1,221 @@
+"""The shared LM training loop — one host driver for all five token routes
+(single-shard, sp, tp, pp, ep; anything exposing ``.state``, ``.train_step``,
+``.eval_step``, ``.train_token_many``).
+
+Two execution regimes, selected by ``cfg.steps_per_call`` — the same contract
+as the CNN ``Trainer`` (training/trainer.py):
+
+* K=1 (default): the eager per-step loop — one ``synthetic_text`` host
+  generation, one fresh upload, one dispatch per step. The bitwise reference
+  for the chunked path, and honest on local CPU.
+* K>1: the scan-chunked loop — ``train_token_many`` (parallel/common.py)
+  fuses K full LM coded steps (token-batch slice → vmapped lane fwd/bwd →
+  encode → aggregate/decode → update) into ONE jitted ``lax.scan`` with the
+  state carry donated and the adversary/straggler schedules sliced on device
+  from (K, n) blocks. Per-step losses accumulate into a (K, m) device block
+  fetched once per flush window (``DeferredMetricWriter``); the next chunk's
+  (K, n·B, T) token block is assembled on a background thread while the
+  device runs the current one (``TokenChunkPrefetcher``). Per K steps the
+  host pays ONE dispatch instead of K × (host token gen + device_put +
+  dispatch) — this is what hides the ~70 ms/dispatch RTT of remote backends
+  (PERF.md §0/§4b) on the LM routes, where it was ~70 % of the flagship
+  step (PERF.md §1b).
+
+``cfg.token_gen == "device"`` removes the host token path entirely: the
+scanned program regenerates each step's batch in-graph from the scalar
+(seed, step) (``sp_step.synthetic_text_in_graph``, the same discipline as
+``rng.random_projection_factors_in_graph``), so a chunk's upload is K int32
+scalars. The device stream is a distinct PRNG draw from the host stream, so
+the flag selects WHICH deterministic stream trains — both regimes of a given
+stream stay bitwise-equivalent (K=1 runs the scanned driver too in this
+mode).
+
+Eval/checkpoint cadence snaps to chunk boundaries via explicit remainder
+chunks (``batching.chunk_ranges`` — the one snapping rule, shared with
+``Trainer._run_chunked``), so ``max_steps`` need not divide by K and a
+resumed run re-enters the exact chunk grid. Held-out eval needs only
+``eval_freq`` (the metric writer prints when there is no ``train_dir``);
+checkpoints need only ``train_dir`` — a run with ``eval_freq=0`` still saves
+its final state (previously both hid behind one ``eval_freq and train_dir``
+guard and checkpointing without eval was impossible).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from draco_tpu import rng as drng
+from draco_tpu.config import TrainConfig
+from draco_tpu.data.batching import chunk_ranges
+
+
+def run_token_loop(setup, cfg: TrainConfig, steps: Optional[int] = None,
+                   quiet: bool = False, tag: str = "mp"):
+    """Train ``steps or cfg.max_steps`` steps on the synthetic token stream.
+
+    Same operational contract as the CNN Trainer: step-indexed Orbax
+    checkpoints + held-out eval every ``eval_freq`` steps (reference:
+    baseline_master.py:142-144), resume via ``cfg.checkpoint_step``.
+    ``tag`` labels the route in error messages only; metric records carry
+    the step number. Returns (state, last metrics).
+    """
+    from draco_tpu.parallel.sp_step import synthetic_text
+    from draco_tpu.utils import checkpoint as ckpt_mod
+    from draco_tpu.utils.metrics import MetricWriter
+
+    state = setup.state
+    start = 1
+    if cfg.checkpoint_step > 0:
+        state = ckpt_mod.load(cfg.train_dir, cfg.checkpoint_step,
+                              jax.tree.map(lambda x: x, state))
+        start = cfg.checkpoint_step + 1
+    total = steps or cfg.max_steps
+    last_step = start + total - 1
+    # live adversaries may be fewer than the code parameter s when decode
+    # budget is reserved for stragglers (config.adversary_count)
+    adv = drng.adversary_schedule(cfg.seed, start + total + 1,
+                                  cfg.num_workers, cfg.num_adversaries)
+    straggle = (
+        drng.straggler_schedule(cfg.seed, start + total + 1, cfg.num_workers,
+                                cfg.straggle_count)
+        if cfg.straggle_mode == "drop" and cfg.straggle_count > 0
+        else None
+    )
+    writer = MetricWriter(cfg.train_dir or None, quiet=quiet)
+    eval_toks = None
+    if cfg.eval_freq:
+        # held-out stream: step 0 is never trained on
+        eval_toks = jnp.asarray(
+            synthetic_text(cfg.seed + 1, 0, cfg.num_workers, cfg.batch_size,
+                           cfg.seq_len, cfg.vocab)
+        )
+
+    def boundary_eval_ckpt(step, st):
+        if eval_toks is not None:
+            eval_loss = float(setup.eval_step(st.params, eval_toks))
+            writer.write({"step": step, "split": "eval", "loss": eval_loss})
+        if cfg.train_dir:
+            ckpt_mod.save(cfg.train_dir, step, st, compress=cfg.compress_ckpt)
+
+    K = max(cfg.steps_per_call, 1)
+    if K > 1 or cfg.token_gen == "device":
+        # the device-generated stream exists only inside the scanned program,
+        # so that mode runs the chunked driver even at K=1
+        state, metrics = _run_chunked(setup, cfg, state, start, last_step,
+                                      adv, straggle, writer,
+                                      boundary_eval_ckpt, tag)
+    else:
+        state, metrics = _run_eager(setup, cfg, state, start, last_step,
+                                    adv, straggle, writer,
+                                    boundary_eval_ckpt)
+    if cfg.train_dir and not cfg.eval_freq:
+        # checkpointing without eval: no cadence boundaries exist, so save
+        # the final state (with eval_freq set the boundary saves stand alone,
+        # preserving the historical on-boundary-only layout)
+        ckpt_mod.save(cfg.train_dir, last_step, state,
+                      compress=cfg.compress_ckpt)
+    return state, metrics
+
+
+def _run_eager(setup, cfg, state, start, last_step, adv, straggle, writer,
+               boundary_eval_ckpt):
+    """One dispatch per step — the K=1 bitwise reference."""
+    from draco_tpu.parallel.sp_step import synthetic_text
+
+    metrics = {}
+    for step in range(start, last_step + 1):
+        toks = jnp.asarray(
+            synthetic_text(cfg.seed, step, cfg.num_workers, cfg.batch_size,
+                           cfg.seq_len, cfg.vocab)
+        )
+        if straggle is None:
+            state, metrics = setup.train_step(state, toks,
+                                              jnp.asarray(adv[step]))
+        else:
+            state, metrics = setup.train_step(
+                state, toks, jnp.asarray(adv[step]),
+                jnp.asarray(~straggle[step]),
+            )
+        if step % cfg.log_every == 0:
+            writer.write({"step": step, "loss": float(metrics["loss"])})
+        if cfg.eval_freq and step % cfg.eval_freq == 0:
+            boundary_eval_ckpt(step, state)
+    return state, metrics
+
+
+def _run_chunked(setup, cfg, state, start, last_step, adv, straggle, writer,
+                 boundary_eval_ckpt, tag="mp"):
+    """One dispatch per chunk of up to K steps; metrics deferred to flush
+    boundaries; next chunk assembled while the device runs the current one."""
+    from draco_tpu.data.prefetch import TokenChunkPrefetcher
+    from draco_tpu.parallel.sp_step import synthetic_text
+    from draco_tpu.utils.metrics import DeferredMetricWriter
+
+    if setup.train_token_many is None:
+        raise ValueError(
+            f"{tag} route setup lacks train_token_many — rebuild it with "
+            "the current route builders (parallel/{sp,tp,ep,pp}_step.py)"
+        )
+    ranges = chunk_ranges(start, last_step, cfg.steps_per_call, cfg.eval_freq)
+    if not ranges:
+        return state, {}
+    device_gen = cfg.token_gen == "device"
+    prefetch = None
+    if not device_gen:
+        prefetch = TokenChunkPrefetcher(
+            lambda step: synthetic_text(cfg.seed, step, cfg.num_workers,
+                                        cfg.batch_size, cfg.seq_len,
+                                        cfg.vocab)
+        )
+    deferred = DeferredMetricWriter(writer)
+
+    def should_log(step):
+        return step % cfg.log_every == 0
+
+    def assemble(i):
+        s0, k = ranges[i]
+        if device_gen:
+            # the program regenerates the batches in-graph: upload K scalars
+            toks = np.arange(s0, s0 + k, dtype=np.int32)
+        else:
+            toks = prefetch.get(
+                ranges[i], ranges[i + 1] if i + 1 < len(ranges) else None
+            )
+        # numpy (uncommitted) so jit treats the schedules as replicated
+        masks = np.asarray(adv[s0 : s0 + k])
+        presents = (
+            np.asarray(~straggle[s0 : s0 + k])
+            if straggle is not None
+            else None
+        )
+        return toks, masks, presents
+
+    try:
+        chunk = assemble(0)
+        for i, (s0, k) in enumerate(ranges):
+            end = s0 + k - 1
+            toks, masks, presents = chunk
+            state, block = setup.train_token_many(state, toks, masks,
+                                                  presents)
+            deferred.defer(range(s0, end + 1), setup.metric_names, block)
+            if i + 1 < len(ranges):  # overlap: assemble i+1 during chunk i
+                chunk = assemble(i + 1)
+            boundary = bool(cfg.eval_freq) and end % cfg.eval_freq == 0
+            if boundary or i + 1 == len(ranges) or deferred.depth >= 4:
+                # flush materializes every pending block (np.asarray — a
+                # true device→host execution barrier even on remote
+                # backends, PERF.md §0) and writes the window's records.
+                # No separate sync(): unlike trainer._run_chunked there is
+                # no wall-clock read between barrier and flush here.
+                deferred.flush(should_log)
+            if boundary:
+                boundary_eval_ckpt(end, state)
+    finally:
+        if prefetch is not None:
+            prefetch.close()
+    last = deferred.last
+    return state, ({"loss": last["loss"]} if "loss" in last else {})

@@ -34,11 +34,14 @@ from draco_tpu.coding import cyclic as cyclic_mod
 from draco_tpu.config import TrainConfig
 from draco_tpu.models.transformer import TransformerLM
 from draco_tpu.parallel.common import (
+    TOKEN_METRIC_NAMES,
     aggregate_flat_grads,
     apply_flat_update,
+    make_token_train_many,
     masked_loss_metric,
 )
 from draco_tpu.parallel.mesh import TP_AXIS
+from draco_tpu.parallel.token_loop import run_token_loop  # noqa: F401  (re-export: historical home)
 from draco_tpu.runtime import WORKER_AXIS
 from draco_tpu.training.step import TrainState, _flatten_tree, _make_unravel
 
@@ -51,6 +54,11 @@ class TPTrainSetup(NamedTuple):
     code: Optional[cyclic_mod.CyclicCode]
     unravel: any
     dim: int
+    # K fused LM steps in ONE device program (parallel/common.py):
+    # (state, toks (K,n,B,T) | steps (K,), masks (K,n), presents (K,n)|None)
+    #   -> (state, metrics (K, len(metric_names)) float32)
+    train_token_many: any = None
+    metric_names: tuple = TOKEN_METRIC_NAMES
 
 
 def param_partition_spec(path) -> P:
@@ -159,11 +167,23 @@ def _build_gspmd_train_setup(cfg: TrainConfig, mesh, *, mp_axis: str,
     repl = NamedSharding(mesh, P())
     shard_w = NamedSharding(mesh, P(WORKER_AXIS))
     params = shard_params(params, mesh, partition_fn)
+    # opt.init is zeros_like on the sharded params, so the slots inherit
+    # the tp layout with no host round-trip (multi-host safe) — but its
+    # bookkeeping scalars (schedule count, sgd's initialized flag) come out
+    # as fresh single-device arrays. Live they are uncommitted and jit
+    # transfers them freely; an Orbax restore however round-trips them
+    # COMMITTED to device 0, which jit then rejects next to the
+    # mesh-committed params — pin them mesh-replicated up front so the
+    # checkpoint template carries a placement that restores clean.
+    opt_state = jax.tree.map(
+        lambda x: x
+        if isinstance(getattr(x, "sharding", None), NamedSharding)
+        else jax.device_put(x, repl),
+        opt.init(params),
+    )
     state = TrainState(
         params=params,
-        # opt.init is zeros_like on the sharded params, so the slots inherit
-        # the tp layout with no host round-trip (multi-host safe)
-        opt_state=opt.init(params),
+        opt_state=opt_state,
         batch_stats=None,
         step=jax.device_put(jnp.asarray(1, jnp.int32), repl),
     )
@@ -232,76 +252,21 @@ def _build_gspmd_train_setup(cfg: TrainConfig, mesh, *, mp_axis: str,
     def eval_body(params, tokens):
         return jnp.mean(jax.vmap(lambda t: lane_loss(params, t, False))(tokens))
 
+    from draco_tpu.parallel.sp_step import token_fn_from_cfg
+
     with mesh:
         train_step = jax.jit(step_body, donate_argnums=(0,))
         eval_step = jax.jit(eval_body)
+        train_token_many = jax.jit(
+            make_token_train_many(step_body, token_fn_from_cfg(cfg)),
+            donate_argnums=(0,),
+        )
 
     return TPTrainSetup(
         model=model, state=state, train_step=train_step, eval_step=eval_step,
         code=code, unravel=unravel, dim=dim,
+        train_token_many=train_token_many,
     )
-
-
-def run_token_loop(setup, cfg: TrainConfig, steps: Optional[int] = None,
-                   quiet: bool = False, tag: str = "mp"):
-    """Training loop on the synthetic token stream (sp_step.synthetic_text)
-    for any LM setup (sp / tp / ep / pp — anything exposing .state,
-    .train_step, .eval_step). Same operational contract as the CNN Trainer:
-    step-indexed Orbax checkpoints + held-out eval every ``eval_freq`` steps
-    into ``train_dir`` (reference: baseline_master.py:142-144), resume via
-    ``checkpoint_step``. Returns (state, last metrics)."""
-    from draco_tpu.parallel.sp_step import synthetic_text
-    from draco_tpu.utils import checkpoint as ckpt_mod
-    from draco_tpu.utils.metrics import MetricWriter
-
-    state = setup.state
-    start = 1
-    if cfg.checkpoint_step > 0:
-        state = ckpt_mod.load(cfg.train_dir, cfg.checkpoint_step,
-                              jax.tree.map(lambda x: x, state))
-        start = cfg.checkpoint_step + 1
-    total = steps or cfg.max_steps
-    # live adversaries may be fewer than the code parameter s when decode
-    # budget is reserved for stragglers (config.adversary_count)
-    adv = drng.adversary_schedule(cfg.seed, start + total + 1,
-                                  cfg.num_workers, cfg.num_adversaries)
-    straggle = (
-        drng.straggler_schedule(cfg.seed, start + total + 1, cfg.num_workers,
-                                cfg.straggle_count)
-        if cfg.straggle_mode == "drop" and cfg.straggle_count > 0
-        else None
-    )
-    writer = MetricWriter(cfg.train_dir or None, quiet=quiet)
-    eval_toks = None
-    if cfg.eval_freq and cfg.train_dir:
-        # held-out stream: step 0 is never trained on
-        eval_toks = jnp.asarray(
-            synthetic_text(cfg.seed + 1, 0, cfg.num_workers, cfg.batch_size,
-                           cfg.seq_len, cfg.vocab)
-        )
-    metrics = {}
-    for step in range(start, start + total):
-        toks = jnp.asarray(
-            synthetic_text(cfg.seed, step, cfg.num_workers, cfg.batch_size,
-                           cfg.seq_len, cfg.vocab)
-        )
-        if straggle is None:
-            state, metrics = setup.train_step(state, toks,
-                                              jnp.asarray(adv[step]))
-        else:
-            state, metrics = setup.train_step(
-                state, toks, jnp.asarray(adv[step]),
-                jnp.asarray(~straggle[step]),
-            )
-        if not quiet and step % cfg.log_every == 0:
-            print(f"{tag} step {step}: loss {float(metrics['loss']):.4f}",
-                  flush=True)
-        if cfg.eval_freq and cfg.train_dir and step % cfg.eval_freq == 0:
-            eval_loss = float(setup.eval_step(state.params, eval_toks))
-            writer.write({"step": step, "split": "eval", "loss": eval_loss})
-            ckpt_mod.save(cfg.train_dir, step, state,
-                          compress=cfg.compress_ckpt)
-    return state, metrics
 
 
 def train_tp(cfg: TrainConfig, mesh, steps: Optional[int] = None,

@@ -131,21 +131,11 @@ class Trainer:
 
     # ---- chunking --------------------------------------------------------
     def _chunk_ranges(self, start: int, n_steps: int) -> list:
-        """[(start, k), ...] covering steps [start, n_steps]: chunks of up to
-        cfg.steps_per_call steps, snapped so every eval_freq multiple (and
-        the final step) ends a chunk — the explicit remainder chunks that
-        keep eval/checkpoint cadence exact when max_steps % K != 0."""
-        K = max(self.cfg.steps_per_call, 1)
-        ef = self.cfg.eval_freq
-        out = []
-        s = start
-        while s <= n_steps:
-            e = min(s + K - 1, n_steps)
-            if ef:
-                e = min(e, ((s - 1) // ef + 1) * ef)
-            out.append((s, e - s + 1))
-            s = e + 1
-        return out
+        """[(start, k), ...] covering steps [start, n_steps] — the shared
+        boundary-snapping rule (batching.chunk_ranges, one implementation
+        for this loop and the LM token loop)."""
+        return batching.chunk_ranges(start, n_steps, self.cfg.steps_per_call,
+                                     self.cfg.eval_freq)
 
     def _chunk_indices(self, start: int, k: int) -> np.ndarray:
         """(k, n·B) flat sample indices for 1-based steps [start, start+k) —

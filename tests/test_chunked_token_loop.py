@@ -1,0 +1,215 @@
+"""Scan-chunked LM token loop (cfg.steps_per_call > 1 on the TransformerLM
+routes): bitwise equivalence with the eager loop, mid-chunk resume, the
+in-graph token stream, and the eval/checkpoint guard split.
+
+The equivalence tests are the load-bearing ones: ``train_token_many`` is the
+SAME coded LM step (token slice → vmapped lane fwd/bwd → encode →
+aggregate/decode → update) scan-chained K at a time
+(parallel/common.make_token_train_many + parallel/token_loop.py), so
+K ∈ {1, 4} must produce identical final parameters and an identical metrics
+stream — under a live rev-grad adversary AND a straggler-drop schedule, on
+both parallelism styles (sp: shard_map ring attention; tp: GSPMD folded
+mesh). Tiny models keep the compiles cheap; nothing here depends on scale.
+"""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from draco_tpu.config import TrainConfig
+from draco_tpu.parallel import make_mesh_2d
+from draco_tpu.parallel.mesh import make_folded_wtp_mesh
+from draco_tpu.parallel.sp_step import train_sp
+from draco_tpu.parallel.tp_step import build_tp_train_setup, train_tp
+from draco_tpu.parallel.token_loop import run_token_loop
+from draco_tpu.utils import checkpoint as ckpt
+
+
+def make_cfg(**kw):
+    base = dict(
+        network="TransformerLM", dataset="synthetic-text", batch_size=4,
+        lr=0.05, momentum=0.9, num_workers=8, approach="baseline",
+        mode="normal", worker_fail=0, err_mode="rev_grad", seq_len=16,
+        vocab=32, model_dim=32, model_heads=2, model_layers=1, max_steps=7,
+        eval_freq=0, train_dir="", log_every=1000,
+    )
+    base.update(kw)
+    return TrainConfig(**base)
+
+
+def params_vec(state):
+    return np.concatenate(
+        [np.ravel(x) for x in jax.tree.leaves(jax.device_get(state.params))]
+    )
+
+
+def metric_stream(train_dir):
+    """[(step, split, loss)] from metrics.jsonl, timing keys dropped — the
+    cross-regime-comparable part of the record stream."""
+    out = []
+    with open(os.path.join(train_dir, "metrics.jsonl")) as fh:
+        for line in fh:
+            rec = json.loads(line)
+            out.append((rec["step"], rec.get("split", "train"), rec["loss"]))
+    return out
+
+
+# --------------------------------------------------------------------------
+# chunked vs eager equivalence — both parallelism styles, live rev-grad
+# adversary + straggler drops, eval/checkpoint boundaries interleaved
+# --------------------------------------------------------------------------
+
+# sp: shard_map ring attention on a (4 w × 2 sp) mesh, robust aggregation;
+# tp: GSPMD folded mesh, cyclic code in the joint adversary+straggler
+# regime (s=2, t=1, e=1 needs n > 4s ⇒ n=9, folded onto 3 devices)
+ROUTES = {
+    "sp": dict(
+        kw=dict(num_workers=4, seq_shards=2, mode="geometric_median",
+                worker_fail=1, straggle_mode="drop", straggle_count=1),
+        train=lambda cfg: train_sp(cfg, make_mesh_2d(4, 2), quiet=True),
+    ),
+    "tp": dict(
+        kw=dict(num_workers=9, approach="cyclic", worker_fail=2,
+                adversary_count=1, redundancy="shared",
+                straggle_mode="drop", straggle_count=1),
+        train=lambda cfg: train_tp(cfg, make_folded_wtp_mesh(9), quiet=True),
+    ),
+}
+
+
+@pytest.mark.parametrize("route", sorted(ROUTES))
+def test_chunked_equals_eager_bitwise(route, tmp_path):
+    """Same final params AND same metrics stream (train records at
+    log_every=1 + eval records at eval_freq=3) for K=1 (eager loop) vs K=4
+    (scan-chunked with remainder chunks, since the eval boundary snaps
+    chunks to 3 and 7 % 3 != 0)."""
+    r = ROUTES[route]
+    out = {}
+    for k in (1, 4):
+        d = str(tmp_path / f"{route}_k{k}")
+        cfg = make_cfg(**r["kw"], steps_per_call=k, train_dir=d,
+                       eval_freq=3, log_every=1)
+        state, metrics = r["train"](cfg)
+        out[k] = (params_vec(state), metric_stream(d), float(metrics["loss"]))
+    np.testing.assert_array_equal(out[1][0], out[4][0])
+    assert out[1][1] == out[4][1]  # identical per-step metric values
+    assert [s for s, split, _ in out[4][1] if split == "train"] == list(
+        range(1, 8))
+    assert [s for s, split, _ in out[4][1] if split == "eval"] == [3, 6]
+    assert out[1][2] == out[4][2]
+
+
+def test_device_token_gen_bitwise_and_distinct():
+    """cfg.token_gen='device' regenerates the batches in-graph: K=1 and K=4
+    agree bitwise (both run the scanned driver), and the device stream is a
+    different deterministic draw from the host stream."""
+    mesh = make_folded_wtp_mesh(8)
+    vecs = {}
+    for k in (1, 4):
+        cfg = make_cfg(approach="cyclic", worker_fail=1, redundancy="shared",
+                       steps_per_call=k, token_gen="device")
+        setup = build_tp_train_setup(cfg, mesh)
+        state, metrics = run_token_loop(setup, cfg, quiet=True)
+        assert np.isfinite(float(metrics["loss"]))
+        vecs[k] = params_vec(state)
+    np.testing.assert_array_equal(vecs[1], vecs[4])
+
+    # the two streams are distinct deterministic draws with the same shape/
+    # range contract (ramp mod vocab)
+    from draco_tpu.parallel.sp_step import synthetic_text, synthetic_text_in_graph
+
+    host = synthetic_text(428, 1, 8, 4, 16, 32)
+    dev = np.asarray(synthetic_text_in_graph(428, 1, 8, 4, 16, 32))
+    assert host.shape == dev.shape and dev.dtype == np.int32
+    assert dev.min() >= 0 and dev.max() < 32
+    assert not np.array_equal(host, dev)
+
+
+@pytest.mark.core
+def test_chunked_token_loop_smoke_fast():
+    """Tier-1/core smoke: tiny LM, K=3 with a remainder chunk, live
+    adversary — the chunked loop trains and the loss moves."""
+    kw = dict(approach="cyclic", worker_fail=1, redundancy="shared",
+              steps_per_call=3)
+    mesh = make_folded_wtp_mesh(8)
+    cfg = make_cfg(**kw)
+    setup = build_tp_train_setup(cfg, mesh)
+    _, first = run_token_loop(setup, cfg, steps=1, quiet=True)
+    cfg2 = make_cfg(**kw)
+    setup2 = build_tp_train_setup(cfg2, mesh)
+    state, last = run_token_loop(setup2, cfg2, steps=7, quiet=True)
+    assert int(state.step) == 8
+    assert np.isfinite(last["loss"])
+    assert last["loss"] < float(first["loss"])
+
+
+def test_resume_from_checkpoint_mid_chunk(tmp_path):
+    """A K=4 run checkpoints at eval boundaries (3, 6, 9); resuming from
+    step 3 — mid-chunk relative to the K grid — must land on the exact same
+    parameters as the uninterrupted run."""
+    kw = dict(approach="cyclic", worker_fail=1, redundancy="shared",
+              steps_per_call=4, eval_freq=3, train_dir=str(tmp_path),
+              max_steps=10)
+    cfg = make_cfg(**kw)
+    state_full, _ = train_tp(cfg, make_folded_wtp_mesh(8), quiet=True)
+    assert ckpt.available_steps(str(tmp_path)) == [3, 6, 9]
+    cfg_res = make_cfg(**kw, checkpoint_step=3)
+    state_res, _ = train_tp(cfg_res, make_folded_wtp_mesh(8), steps=7,
+                            quiet=True)
+    np.testing.assert_array_equal(params_vec(state_full),
+                                  params_vec(state_res))
+
+
+# --------------------------------------------------------------------------
+# the eval/checkpoint guard split (previously one `eval_freq and train_dir`
+# guard: no checkpoints without eval, no eval without a train_dir)
+# --------------------------------------------------------------------------
+
+def test_checkpoint_without_eval(tmp_path):
+    """eval_freq=0 with a train_dir still saves the final state — in both
+    regimes, at the same step."""
+    for k in (1, 4):
+        d = str(tmp_path / f"k{k}")
+        cfg = make_cfg(steps_per_call=k, eval_freq=0, train_dir=d)
+        train_tp(cfg, make_folded_wtp_mesh(8), steps=5, quiet=True)
+        assert ckpt.available_steps(d) == [5]
+
+
+def test_eval_without_train_dir_runs():
+    """eval_freq without a train_dir evaluates (records print-only) instead
+    of silently skipping; no checkpoint dir appears."""
+    cfg = make_cfg(eval_freq=2, train_dir="", steps_per_call=4)
+    state, metrics = train_tp(cfg, make_folded_wtp_mesh(8), steps=4,
+                              quiet=True)
+    assert int(state.step) == 5
+    assert np.isfinite(float(metrics["loss"]))
+
+
+# --------------------------------------------------------------------------
+# config surface: the TransformerLM steps_per_call ban is lifted
+# --------------------------------------------------------------------------
+
+def test_validate_accepts_steps_per_call_on_all_lm_routes():
+    """config.validate passes steps_per_call > 1 for every LM route config
+    (single-shard, sp, tp, pp, ep) — the pre-PR ban is gone."""
+    routes = [
+        dict(),                                        # single-shard
+        dict(num_workers=4, seq_shards=2),             # sp
+        dict(num_workers=4, tensor_shards=2),          # tp
+        dict(num_workers=2, pipeline_shards=2,
+             model_layers=2),                          # pp
+        dict(num_workers=4, moe_experts=2,
+             expert_shards=2),                         # ep
+    ]
+    for kw in routes:
+        make_cfg(**kw, steps_per_call=8).validate()
+
+
+def test_token_gen_validation():
+    with pytest.raises(ValueError, match="token_gen"):
+        make_cfg(token_gen="banana").validate()
+    with pytest.raises(ValueError, match="TransformerLM"):
+        TrainConfig(network="FC", token_gen="device").validate()

@@ -16,7 +16,15 @@ single-threaded scan-body conv execution (PERF.md §4) does not distort the
 host-overhead comparison on the CPU mesh. Conv nets on CPU should keep
 steps_per_call=1 regardless of what this tool reports for FC.
 
-Output: one JSON (default baselines_out/host_loop_overhead.json).
+``--lm`` switches the measured loop to the production TransformerLM token
+loop (parallel/token_loop.run_token_loop on the folded tp route): eager
+per-step dispatch vs the scan-chunked ``train_token_many`` driver, same
+config/seed/steps. TransformerLM is matmul-dominated like FC, so the
+XLA:CPU scanned-conv caveat does not apply there either — the artifact
+records that directly (chunked vs eager on the same CPU mesh).
+
+Output: one JSON (default baselines_out/host_loop_overhead.json;
+--lm defaults to baselines_out/host_loop_overhead_lm.json).
 """
 
 from __future__ import annotations
@@ -52,10 +60,44 @@ def measure_loop(cfg_kwargs: dict, ds, mesh, warmup_steps: int,
         tr.close()
 
 
+def measure_lm_loop(cfg_kwargs: dict, mesh, warmup_steps: int,
+                    timed_steps: int) -> float:
+    """ms/step of the production run_token_loop over ``timed_steps`` steps.
+
+    A warmup pass on a deep-copied state settles compilation (the jitted
+    programs are cached on the setup's callables, keyed by chunk shape), then
+    the timed pass runs the setup's own state — train_step/train_token_many
+    donate their carry, so each state tree drives at most one loop."""
+    import jax
+    import jax.numpy as jnp
+
+    from draco_tpu.config import TrainConfig
+    from draco_tpu.parallel.token_loop import run_token_loop
+    from draco_tpu.parallel.tp_step import build_tp_train_setup
+
+    cfg = TrainConfig(**cfg_kwargs)
+    setup = build_tp_train_setup(cfg, mesh)
+    warm = setup._replace(state=jax.tree.map(jnp.copy, setup.state))
+    st, _ = run_token_loop(warm, cfg, steps=warmup_steps, quiet=True)
+    jax.block_until_ready(st.params)
+    t0 = time.perf_counter()
+    st, _ = run_token_loop(setup, cfg, steps=timed_steps, quiet=True)
+    jax.block_until_ready(st.params)
+    return (time.perf_counter() - t0) / timed_steps * 1000.0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--out", type=str,
-                    default="baselines_out/host_loop_overhead.json")
+    ap.add_argument("--out", type=str, default="")
+    ap.add_argument("--lm", action="store_true",
+                    help="measure the TransformerLM token loop "
+                         "(parallel/token_loop.py, folded tp route) instead "
+                         "of the CNN Trainer")
+    ap.add_argument("--seq-len", type=int, default=64)
+    ap.add_argument("--model-dim", type=int, default=64)
+    ap.add_argument("--model-heads", type=int, default=2)
+    ap.add_argument("--model-layers", type=int, default=2)
+    ap.add_argument("--vocab", type=int, default=256)
     ap.add_argument("--network", type=str, default="FC")
     ap.add_argument("--dataset", type=str, default="synthetic-mnist")
     ap.add_argument("--approach", type=str, default="cyclic")
@@ -76,9 +118,6 @@ def main(argv=None) -> int:
 
     import jax
 
-    from draco_tpu.data.datasets import load_dataset
-    from draco_tpu.runtime import make_mesh
-
     ks = sorted({max(int(k), 1) for k in args.ks.split(",")})
     if 1 not in ks:
         ks = [1] + ks
@@ -86,23 +125,64 @@ def main(argv=None) -> int:
         if args.steps % k:
             raise SystemExit(f"--steps {args.steps} must be divisible by K={k}")
 
-    ds = load_dataset(args.dataset, synthetic_train=4096, synthetic_test=128)
-    mesh = make_mesh(args.num_workers)
     dev = jax.devices()[0]
+    if args.lm:
+        from draco_tpu.parallel.mesh import make_folded_wtp_mesh
 
-    common = dict(
-        network=args.network, dataset=args.dataset,
-        approach=args.approach, worker_fail=args.worker_fail,
-        err_mode=args.err_mode, num_workers=args.num_workers,
-        batch_size=args.batch_size, lr=0.01, momentum=0.9,
-        max_steps=2 * args.steps + max(ks), eval_freq=0, train_dir="",
-        log_every=10**9,
-    )
+        mesh = make_folded_wtp_mesh(args.num_workers)
+        common = dict(
+            network="TransformerLM", dataset="synthetic-text",
+            approach=args.approach, worker_fail=args.worker_fail,
+            err_mode=args.err_mode, num_workers=args.num_workers,
+            batch_size=args.batch_size, lr=0.01, momentum=0.9,
+            seq_len=args.seq_len, vocab=args.vocab,
+            model_dim=args.model_dim, model_heads=args.model_heads,
+            model_layers=args.model_layers,
+            max_steps=2 * args.steps + max(ks), eval_freq=0, train_dir="",
+            log_every=10**9,
+        )
+        cfg_report = {
+            "network": "TransformerLM", "dataset": "synthetic-text",
+            "loop": "parallel/token_loop.run_token_loop (folded tp route)",
+            "approach": args.approach, "worker_fail": args.worker_fail,
+            "err_mode": args.err_mode, "num_workers": args.num_workers,
+            "batch_size_per_worker": args.batch_size,
+            "seq_len": args.seq_len, "model_dim": args.model_dim,
+            "model_heads": args.model_heads,
+            "model_layers": args.model_layers, "vocab": args.vocab,
+            "timed_steps": args.steps,
+        }
+    else:
+        from draco_tpu.data.datasets import load_dataset
+        from draco_tpu.runtime import make_mesh
+
+        ds = load_dataset(args.dataset, synthetic_train=4096,
+                          synthetic_test=128)
+        mesh = make_mesh(args.num_workers)
+        common = dict(
+            network=args.network, dataset=args.dataset,
+            approach=args.approach, worker_fail=args.worker_fail,
+            err_mode=args.err_mode, num_workers=args.num_workers,
+            batch_size=args.batch_size, lr=0.01, momentum=0.9,
+            max_steps=2 * args.steps + max(ks), eval_freq=0, train_dir="",
+            log_every=10**9,
+        )
+        cfg_report = {
+            "network": args.network, "dataset": args.dataset,
+            "approach": args.approach, "worker_fail": args.worker_fail,
+            "err_mode": args.err_mode, "num_workers": args.num_workers,
+            "batch_size_per_worker": args.batch_size,
+            "timed_steps": args.steps,
+        }
 
     rows = {}
     for k in ks:
-        ms = measure_loop(dict(common, steps_per_call=k), ds, mesh,
-                          warmup_steps=k, timed_steps=args.steps)
+        if args.lm:
+            ms = measure_lm_loop(dict(common, steps_per_call=k), mesh,
+                                 warmup_steps=k, timed_steps=args.steps)
+        else:
+            ms = measure_loop(dict(common, steps_per_call=k), ds, mesh,
+                              warmup_steps=k, timed_steps=args.steps)
         rows[str(k)] = round(ms, 4)
         print(f"K={k}: {ms:.3f} ms/step", flush=True)
 
@@ -112,13 +192,8 @@ def main(argv=None) -> int:
     report = {
         "platform": dev.platform,
         "device_kind": getattr(dev, "device_kind", dev.platform),
-        "config": {
-            "network": args.network, "dataset": args.dataset,
-            "approach": args.approach, "worker_fail": args.worker_fail,
-            "err_mode": args.err_mode, "num_workers": args.num_workers,
-            "batch_size_per_worker": args.batch_size,
-            "timed_steps": args.steps,
-        },
+        "mode": "lm_token_loop" if args.lm else "cnn_trainer",
+        "config": cfg_report,
         "ms_per_step_by_steps_per_call": rows,
         "eager_ms_per_step": eager,
         "best_chunked_k8plus_ms_per_step": best_big,
@@ -129,6 +204,9 @@ def main(argv=None) -> int:
             best_big is not None and best_big < eager
         ),
     }
+    if not args.out:
+        args.out = ("baselines_out/host_loop_overhead_lm.json" if args.lm
+                    else "baselines_out/host_loop_overhead.json")
     os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
     with open(args.out, "w") as fh:
         json.dump(report, fh, indent=1)

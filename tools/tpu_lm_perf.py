@@ -21,6 +21,13 @@ over pre-staged token batches, device→host fetch sync, minus RTT. FLOPs
 from XLA cost analysis of the compiled scan (counts the body once). Run
 with the host otherwise idle (PERF.md §4).
 
+``--production-loop`` re-times the same variants on the PRODUCTION chunked
+token loop (parallel/token_loop.run_token_loop driving train_token_many
+with --steps-per-call, PERF.md §4b) instead of this tool's private scan
+harness — since the production loop became scan-chunked the two measure the
+same fold, and the artifact records ``steps_per_call``/``loop`` so which one
+produced each number is explicit.
+
 Usage: python tools/tpu_lm_perf.py [--cpu-mesh N for smoke]
 """
 
@@ -104,6 +111,74 @@ def run_lm(cfg, mesh, steps, warmup=1, reps=2):
     return dt * 1e3, flops, float(np.asarray(jax.device_get(losses))[-1])
 
 
+def run_lm_production(cfg, mesh, steps):
+    """(ms/step, flops/step, last loss) of the PRODUCTION chunked token loop
+    (parallel/token_loop.run_token_loop with cfg.steps_per_call) — the loop
+    users run, not this tool's private scan harness. A warmup pass on a
+    deep-copied state settles compilation of the chunk-shaped programs
+    (cached on the setup's jitted callables); the timed pass drives the
+    setup's own state (the carries are donated, so each state tree feeds at
+    most one loop). The loop's terminal metric flush is a device→host fetch
+    (DeferredMetricWriter.sync), i.e. a true execution barrier even on
+    remote-dispatch backends; the final fetch_scalar adds the state sync."""
+    import jax
+    import jax.numpy as jnp
+
+    import bench
+    from draco_tpu.parallel.token_loop import run_token_loop
+    from draco_tpu.parallel.tp_step import build_tp_train_setup
+    from draco_tpu.utils.timing import fetch_scalar, measure_rtt
+
+    if steps % max(cfg.steps_per_call, 1):
+        # a remainder chunk would compile its own program INSIDE the timed
+        # region (the warmup only settles the K-sized chunk) — reject like
+        # tools/host_loop_overhead.py rather than record the inflated number
+        raise SystemExit(
+            f"--production-loop: --steps {steps} must be divisible by "
+            f"--steps-per-call {cfg.steps_per_call}"
+        )
+    setup = build_tp_train_setup(cfg, mesh)
+    K = max(cfg.steps_per_call, 1)
+    rtt = 0.0 if jax.devices()[0].platform == "cpu" else measure_rtt()
+    warm = setup._replace(state=jax.tree.map(jnp.copy, setup.state))
+    st, _ = run_token_loop(warm, cfg, steps=K, quiet=True)
+    fetch_scalar(st.step)
+    t0 = time.perf_counter()
+    st, metrics = run_token_loop(setup, cfg, steps=steps, quiet=True)
+    fetch_scalar(st.step)
+    dt = max(time.perf_counter() - t0 - rtt, 0.0) / steps
+    flops = None
+    if K > 1:
+        # flops of the actual chunked program, from an explicit lowering of
+        # the same jitted callable the loop dispatches. AFTER the timed run
+        # on purpose: AOT compile does not share the jit dispatch cache, so
+        # doing it first would pay the flagship multi-minute compile twice
+        # on a cold persistent cache (warm cache absorbs this one).
+        from draco_tpu import rng as drng
+        from draco_tpu.parallel.sp_step import synthetic_text
+        import numpy as np
+
+        adv = drng.adversary_schedule(cfg.seed, K + 1, cfg.num_workers,
+                                      cfg.num_adversaries)
+        if cfg.token_gen == "device":
+            toks = np.arange(1, K + 1, dtype=np.int32)
+        else:
+            toks = np.stack([
+                synthetic_text(cfg.seed, s, cfg.num_workers, cfg.batch_size,
+                               cfg.seq_len, cfg.vocab)
+                for s in range(1, K + 1)
+            ])
+        with mesh:
+            # st is the live final state (setup/warm states were donated)
+            compiled = setup.train_token_many.lower(
+                st, toks, np.asarray(adv[1 : K + 1]), None
+            ).compile()
+        # XLA cost analysis counts a scan body ONCE regardless of trip count
+        # (bench.py), so this already is the per-step figure
+        flops = bench._compiled_flops(compiled)
+    return dt * 1e3, flops, float(metrics["loss"])
+
+
 def build_lm_variants(*, batch_size, num_workers, seq_len, vocab, model_dim,
                       model_heads, model_layers, remat, max_steps,
                       scan_layers=False):
@@ -164,6 +239,19 @@ def main(argv=None) -> int:
                          "hit compile-time/service ceilings (PERF.md §4)")
     ap.add_argument("--variants", type=str, default="",
                     help="comma-separated subset of variants to run")
+    ap.add_argument("--production-loop", action="store_true",
+                    help="time the production chunked token loop "
+                         "(parallel/token_loop.run_token_loop with "
+                         "--steps-per-call) instead of this tool's private "
+                         "scan harness — the §1b variants re-timed on the "
+                         "path users run")
+    ap.add_argument("--steps-per-call", type=int, default=0,
+                    help="K for --production-loop (0 = --steps, i.e. the "
+                         "whole timed run is one chunk, matching the "
+                         "private harness's fold)")
+    ap.add_argument("--token-gen", type=str, default="host",
+                    choices=["host", "device"],
+                    help="--production-loop token stream (config.token_gen)")
     ap.add_argument("--cpu-mesh", type=int, default=0)
     args = ap.parse_args(argv)
 
@@ -195,6 +283,8 @@ def main(argv=None) -> int:
         if not variants:
             raise SystemExit(f"no variants match {sorted(keep)}")
 
+    steps_per_call = ((args.steps_per_call or args.steps)
+                      if args.production_loop else 1)
     report = {
         "platform": dev.platform,
         "remat": args.remat,
@@ -209,13 +299,26 @@ def main(argv=None) -> int:
         "vocab": args.vocab,
         "tokens_per_step": args.num_workers * args.batch_size * args.seq_len,
         "steps_per_scan": args.steps,
+        # which loop produced the numbers (bench.py records the same key):
+        # production = parallel/token_loop.run_token_loop chunked driver;
+        # 1 = this tool's private scan harness folding --steps eagerly
+        "steps_per_call": steps_per_call,
+        "loop": ("production_run_token_loop" if args.production_loop
+                 else "private_scan_harness"),
+        "token_gen": args.token_gen if args.production_loop else "host",
     }
     peak = bench._peak_flops(report["device_kind"])
     for name, kw in variants.items():
         print(f"[tpu_lm_perf] measuring {name} ...", file=sys.stderr, flush=True)
         t0 = time.time()
-        ms, flops, loss = run_lm(TrainConfig(**kw), mesh, args.steps,
-                                 reps=args.reps)
+        if args.production_loop:
+            cfg = TrainConfig(**dict(kw, steps_per_call=steps_per_call,
+                                     token_gen=args.token_gen,
+                                     max_steps=args.steps + steps_per_call))
+            ms, flops, loss = run_lm_production(cfg, mesh, args.steps)
+        else:
+            ms, flops, loss = run_lm(TrainConfig(**kw), mesh, args.steps,
+                                     reps=args.reps)
         print(f"[tpu_lm_perf] {name}: {ms:.2f} ms/step ({time.time()-t0:.0f}s)",
               file=sys.stderr, flush=True)
         report[f"{name}_step_ms"] = round(ms, 3)
