@@ -122,6 +122,41 @@ def _start_watchdog(metric_name):
     threading.Thread(target=run, daemon=True, name="bench-watchdog").start()
 
 
+def _lint_violations():
+    """Chip-window gate against the program-lint artifact
+    (tools/program_lint.py → baselines_out/program_lint.json, path
+    overridable via DRACO_PROGRAM_LINT_PATH for tests).
+
+    Returns a list of "program: rule" strings for any CNN-family program —
+    the family this bench times — whose artifact row reports a
+    constant_bloat or host_traffic violation: the two defect classes that
+    don't just skew a number but wedge the shared chip window itself (the
+    638 MB module that held the tunnel 27 min, PERF.md §4; a host hop that
+    serializes every scanned chunk, PERF.md §0). Negative-control rows
+    (deliberately defective) are skipped. A missing or unreadable artifact
+    gates nothing — the lint runs in CI, not here; this is a last line of
+    defense, not the enforcement point.
+    """
+    path = os.environ.get("DRACO_PROGRAM_LINT_PATH",
+                          "baselines_out/program_lint.json")
+    try:
+        with open(path) as fh:
+            report = json.load(fh)
+    except Exception:
+        return []
+    bad = []
+    for row in report.get("rows", []):
+        if row.get("control"):
+            continue
+        if row.get("route") != "cnn":  # lint_program stamps every row
+            continue
+        hits = set(row.get("failed_rules", [])) & {"constant_bloat",
+                                                   "host_traffic"}
+        for rule in sorted(hits):
+            bad.append(f"{row['name']}: {rule}")
+    return bad
+
+
 def _probe_ok(timeout: float):
     """Probe accelerator availability in a clean subprocess (which exits and
     releases the one-client tunnel lease). Returns (ok, detail) — detail is
@@ -499,6 +534,10 @@ def main():
                         "record is guaranteed on stdout before it expires")
     p.add_argument("--no-cpu-fallback", action="store_true",
                    help="emit only the error record if the accelerator is down")
+    p.add_argument("--ignore-lint", action="store_true",
+                   help="time the chip even when baselines_out/"
+                        "program_lint.json reports a constant-bloat/"
+                        "host-traffic violation for the timed programs")
     args = p.parse_args()
     _BUDGET[0] = max(args.budget, 20.0)
 
@@ -512,6 +551,23 @@ def main():
     _start_watchdog(metric_name)
 
     if not args.cpu_mesh:
+        if not args.ignore_lint:
+            violations = _lint_violations()
+            if violations:
+                # refuse the chip run: these defect classes wedge the shared
+                # window itself, and a wedged window is worth far more than
+                # one data point (--ignore-lint overrides)
+                _emit({
+                    "metric": metric_name,
+                    "value": None,
+                    "unit": "ms/step",
+                    "vs_baseline": None,
+                    "error": "program_lint_violation",
+                    "detail": ("refusing chip run; fix or rerun "
+                               "tools/program_lint.py (or --ignore-lint): "
+                               + "; ".join(violations))[:500],
+                })
+                return dict(_LAST_RECORD)
         devs, err = _try_backend()
         if devs is None:
             # structured failure on stdout IMMEDIATELY — everything after

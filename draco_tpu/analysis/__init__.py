@@ -1,0 +1,27 @@
+"""Static analysis of the chip-bound jitted programs (the program linter).
+
+``registry``  — catalog of every hot-loop program + its Manifest
+``rules``     — the five rules (constant_bloat, donation, dtype,
+                collectives, host_traffic) over jaxpr + exported StableHLO
+``controls``  — seeded-defect programs proving each rule is live
+
+Driver: ``tools/program_lint.py`` (artifact
+``baselines_out/program_lint.json``); CI: ``tests/test_program_lint.py``.
+"""
+
+from draco_tpu.analysis.registry import (  # noqa: F401
+    BF16_DTYPES,
+    COLLECTIVE_KINDS,
+    DEFAULT_DTYPES,
+    BuiltProgram,
+    LintProgram,
+    Manifest,
+    collect,
+    get,
+)
+from draco_tpu.analysis.rules import (  # noqa: F401
+    RULE_NAMES,
+    lint_built,
+    lint_program,
+    trace_and_export,
+)

@@ -1,0 +1,221 @@
+"""Negative controls: one deliberately-defective program per lint rule.
+
+A linter that silently stops seeing defects is worse than no linter — the
+round-5 d-sized-constant bug shipped precisely because nothing was looking.
+Each control here seeds exactly ONE defect of the kind its rule exists to
+catch, into an otherwise-clean miniature of the training-step shape
+(donated state carry, sharded batch, scalar metrics). The test suite
+(tests/test_program_lint.py) and the artifact
+(``baselines_out/program_lint.json`` ``negative_controls`` section) assert
+that each control trips exactly its rule and every other rule stays green —
+the same proving-the-harness-is-live discipline as the mis-tiled
+pallas_call in tools/tpu_attn_lowering_check.py.
+
+The controls are self-contained (no model/route imports) so a route
+refactor cannot accidentally blunt them.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+from draco_tpu.analysis.registry import (
+    BuiltProgram,
+    LintProgram,
+    Manifest,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Control:
+    program: LintProgram
+    expected_fail: str  # the one rule this defect must trip
+
+
+def _mini_mesh():
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    devs = np.asarray(jax.devices())
+    return Mesh(devs.reshape(len(devs)), ("w",))
+
+
+def _mini_state(mesh, d=64):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    repl = NamedSharding(mesh, P())
+    return (
+        jax.device_put(jnp.zeros((d,), jnp.float32), repl),
+        jax.device_put(jnp.asarray(1, jnp.int32), repl),
+    )
+
+
+def _mini_batch(mesh, d=64):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n = mesh.devices.size
+    return jax.device_put(jnp.ones((n, d), jnp.float32),
+                          NamedSharding(mesh, P("w")))
+
+
+def _psum_grads(mesh):
+    """The honest miniature's gradient fold: an explicit per-device psum
+    (ONE all_reduce), the smallest stand-in for a route's collective
+    structure."""
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from draco_tpu.runtime import shard_map
+
+    return shard_map(lambda x: lax.psum(x, "w"), mesh=mesh,
+                     in_specs=P("w", None), out_specs=P(),
+                     check_vma=False)
+
+
+_MINI_COLLECTIVES = {"all_reduce": 1}
+
+
+def _build_baked_constant() -> BuiltProgram:
+    """Defect: a ~2 MB array closed over as a program constant (the round-5
+    bug shape, at CI scale)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    mesh = _mini_mesh()
+    big = jnp.asarray(np.ones(512 * 1024 + 1, np.float32))  # > 1 MB limit
+
+    def f(state, x):
+        w, step = state
+        g = _psum_grads(mesh)(x).sum(0)
+        w = w - 0.01 * (g + big[: w.shape[0]])
+        return (w, step + 1), jnp.sum(w)
+
+    with mesh:
+        fn = jax.jit(f, donate_argnums=(0,))
+    return BuiltProgram("control_baked_constant", fn,
+                        (_mini_state(mesh), _mini_batch(mesh)), mesh,
+                        Manifest(collectives=_MINI_COLLECTIVES))
+
+
+def _build_undonated_carry() -> BuiltProgram:
+    """Defect: the state carry is NOT donated (donate_argnums dropped)."""
+    import jax
+    import jax.numpy as jnp
+
+    mesh = _mini_mesh()
+
+    def f(state, x):
+        w, step = state
+        g = _psum_grads(mesh)(x).sum(0)
+        return (w - 0.01 * g, step + 1), jnp.sum(w)
+
+    with mesh:
+        fn = jax.jit(f)  # <- no donate_argnums
+    return BuiltProgram("control_undonated_carry", fn,
+                        (_mini_state(mesh), _mini_batch(mesh)), mesh,
+                        Manifest(collectives=_MINI_COLLECTIVES))
+
+
+def _build_f64_upcast() -> BuiltProgram:
+    """Defect: an f64 accumulation inside the step (traced under
+    jax.experimental.enable_x64, the only way f64 can sneak in)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import enable_x64
+
+    mesh = _mini_mesh()
+
+    def f(state, x):
+        w, step = state
+        g = _psum_grads(mesh)(x).sum(0)
+        g = g.astype(jnp.float64).cumsum().astype(jnp.float32)  # the upcast
+        return (w - 0.01 * g, step + 1), jnp.sum(w)
+
+    with mesh:
+        fn = jax.jit(f, donate_argnums=(0,))
+    return BuiltProgram("control_f64_upcast", fn,
+                        (_mini_state(mesh), _mini_batch(mesh)), mesh,
+                        Manifest(collectives=_MINI_COLLECTIVES),
+                        trace_ctx=enable_x64)
+
+
+def _build_extra_all_gather() -> BuiltProgram:
+    """Defect: a gratuitous all_gather next to the budgeted psum (the
+    accidental-reshard shape the collective budget exists for)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from draco_tpu.runtime import shard_map
+
+    mesh = _mini_mesh()
+
+    def fold(x):
+        g = lax.psum(x, "w")
+        extra = lax.all_gather(jnp.sum(x, axis=-1), "w")  # <- unbudgeted
+        return g + jnp.sum(extra)
+
+    folded = shard_map(fold, mesh=mesh, in_specs=P("w", None), out_specs=P(),
+                       check_vma=False)
+
+    def f(state, x):
+        w, step = state
+        g = folded(x).sum(0)
+        return (w - 0.01 * g, step + 1), jnp.sum(w)
+
+    with mesh:
+        fn = jax.jit(f, donate_argnums=(0,))
+    return BuiltProgram("control_extra_all_gather", fn,
+                        (_mini_state(mesh), _mini_batch(mesh)), mesh,
+                        Manifest(collectives=_MINI_COLLECTIVES))
+
+
+def _build_host_outfeed_in_scan() -> BuiltProgram:
+    """Defect: an outfeed inside the scanned body — the host round-trip
+    that re-serializes every chunk on the dispatch link."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    mesh = _mini_mesh()
+
+    def f(state, xs):
+        def body(st, x):
+            w, step = st
+            g = _psum_grads(mesh)(x).sum(0)
+            token = lax.create_token()
+            lax.outfeed(token, jnp.sum(g))  # <- host hop per scanned step
+            return (w - 0.01 * g, step + 1), jnp.sum(w)
+
+        return lax.scan(body, state, xs)
+
+    with mesh:
+        fn = jax.jit(f, donate_argnums=(0,))
+    xs = jnp.stack([_mini_batch(mesh)] * 2)
+    return BuiltProgram("control_host_outfeed_in_scan", fn,
+                        (_mini_state(mesh), xs), mesh,
+                        Manifest(collectives=_MINI_COLLECTIVES))
+
+
+def control_programs() -> Tuple[Control, ...]:
+    mk = lambda name, build: LintProgram(  # noqa: E731
+        name=name, build=build, route="controls")
+    return (
+        Control(mk("control_baked_constant", _build_baked_constant),
+                "constant_bloat"),
+        Control(mk("control_undonated_carry", _build_undonated_carry),
+                "donation"),
+        Control(mk("control_f64_upcast", _build_f64_upcast), "dtype"),
+        Control(mk("control_extra_all_gather", _build_extra_all_gather),
+                "collectives"),
+        Control(mk("control_host_outfeed_in_scan",
+                   _build_host_outfeed_in_scan), "host_traffic"),
+    )

@@ -54,6 +54,34 @@ def build_ep_train_setup(cfg: TrainConfig, mesh) -> TPTrainSetup:
     )
 
 
+# ---- program-lint registration (draco_tpu/analysis) -----------------------
+
+
+def lint_programs():
+    """The Switch-MoE expert-parallel route's chip-bound programs. Like the
+    tp route this is pure GSPMD (dispatch/combine resharding is inserted by
+    the SPMD partitioner, post-export), so the manifest pins zero explicit
+    collectives — shard_map leaking into the MoE path would show up here."""
+    from draco_tpu.analysis.registry import (
+        LintProgram, Manifest, built_token_program, ci_lm_config,
+    )
+    from draco_tpu.parallel.mesh import make_mesh_wep
+
+    def _build(name, many):
+        cfg = ci_lm_config(moe_experts=4, expert_shards=2)
+        mesh = make_mesh_wep(4, 2)  # 8 CI devices; n=8 folds 2 lanes/device
+        setup = build_ep_train_setup(cfg, mesh)
+        return built_token_program(name, cfg, mesh, setup,
+                                   Manifest(collectives={}), many=many)
+
+    return [
+        LintProgram("lm_ep_step", route="ep",
+                    build=lambda: _build("lm_ep_step", False)),
+        LintProgram("lm_ep_many_k2", route="ep",
+                    build=lambda: _build("lm_ep_many_k2", True)),
+    ]
+
+
 def train_ep(cfg: TrainConfig, mesh, steps: Optional[int] = None,
              quiet: bool = False):
     """EP training loop; returns (state, last metrics)."""

@@ -356,6 +356,46 @@ def build_pp_train_setup(cfg: TrainConfig, mesh) -> PPTrainSetup:
     )
 
 
+# ---- program-lint registration (draco_tpu/analysis) -----------------------
+
+# The route's explicit-collective budget at the audited shape (2 stages,
+# 2 microbatches, 2 layers): the forward tick loop plus its transposed
+# backward ride 2 collective_permute ops, and the loss/grad psums over pp
+# contribute 4 all_reduce. Static op counts — layout-independent (same on
+# the 16-device chip audit and the folded 8-device CI mesh), shared with
+# tools/tpu_parallel_lowering_check.py; a legitimate schedule change
+# updates it HERE, once (PERF.md §6).
+LINT_COLLECTIVES = {"all_reduce": 4, "collective_permute": 2}
+
+
+def lint_programs():
+    """The GPipe pipeline route's chip-bound programs. The schedule's hop
+    structure is explicit (shard_map + ppermute inside the traced pipeline
+    loop), so the manifest pins it (LINT_COLLECTIVES above). A count drift
+    here means the pipeline schedule itself changed."""
+    from draco_tpu.analysis.registry import (
+        LintProgram, Manifest, built_token_program, ci_lm_config,
+    )
+    from draco_tpu.parallel.mesh import make_mesh_wpp
+
+    manifest = Manifest(collectives=LINT_COLLECTIVES)
+
+    def _build(name, many):
+        cfg = ci_lm_config(pipeline_shards=2, pp_microbatches=2,
+                           model_layers=2)
+        mesh = make_mesh_wpp(4, 2)  # 8 CI devices; n=8 folds 2 lanes/device
+        setup = build_pp_train_setup(cfg, mesh)
+        return built_token_program(name, cfg, mesh, setup, manifest,
+                                   many=many)
+
+    return [
+        LintProgram("lm_pp_step", route="pp",
+                    build=lambda: _build("lm_pp_step", False)),
+        LintProgram("lm_pp_many_k2", route="pp",
+                    build=lambda: _build("lm_pp_many_k2", True)),
+    ]
+
+
 def train_pp(cfg: TrainConfig, mesh, steps: Optional[int] = None,
              quiet: bool = False):
     """PP training loop; returns (state, last metrics)."""

@@ -311,6 +311,45 @@ def build_sp_train_setup(cfg: TrainConfig, mesh) -> SPTrainSetup:
     )
 
 
+# ---- program-lint registration (draco_tpu/analysis) -----------------------
+
+# The route's explicit-collective budget at the audited shape (1 layer,
+# sp=2): each layer's ring attention is sp-1 ppermute hops plus the
+# target-handoff hop, and the per-worker gradient/loss assembly is two
+# psums over sp. Static op counts — layout-independent (the 16-device
+# chip audit and the folded 8-device CI mesh observe the same counts), so
+# tools/tpu_parallel_lowering_check.py imports this same constant. A
+# legitimate schedule change updates it HERE, once (PERF.md §6).
+LINT_COLLECTIVES = {"all_reduce": 2, "collective_permute": 5}
+
+
+def lint_programs():
+    """The SP route's chip-bound programs. This is the explicit-collective
+    route (LINT_COLLECTIVES above). An extra all_gather here means GSPMD
+    started resharding the ring, exactly the drift the budget exists to
+    catch."""
+    from draco_tpu.analysis.registry import (
+        LintProgram, Manifest, built_token_program, ci_lm_config,
+    )
+    from draco_tpu.parallel.mesh import make_mesh_2d
+
+    manifest = Manifest(collectives=LINT_COLLECTIVES)
+
+    def _build(name, many):
+        cfg = ci_lm_config(seq_shards=2)
+        mesh = make_mesh_2d(4, 2)  # 8 CI devices; n=8 folds 2 lanes/device
+        setup = build_sp_train_setup(cfg, mesh)
+        return built_token_program(name, cfg, mesh, setup, manifest,
+                                   many=many)
+
+    return [
+        LintProgram("lm_sp_ring_step", route="sp",
+                    build=lambda: _build("lm_sp_ring_step", False)),
+        LintProgram("lm_sp_ring_many_k2", route="sp",
+                    build=lambda: _build("lm_sp_ring_many_k2", True)),
+    ]
+
+
 def train_sp(cfg: TrainConfig, mesh, steps: Optional[int] = None, quiet: bool = False):
     """SP training loop on the synthetic text stream; returns the final state
     and last-step metrics. Checkpoint/eval/resume/chunking semantics live in

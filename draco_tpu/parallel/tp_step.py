@@ -269,6 +269,89 @@ def _build_gspmd_train_setup(cfg: TrainConfig, mesh, *, mp_axis: str,
     )
 
 
+# ---- program-lint registration (draco_tpu/analysis) -----------------------
+
+
+def lint_programs():
+    """The GSPMD tensor-parallel route's chip-bound programs, plus the
+    folded single-shard regime every perf/convergence tool runs in.
+
+    All-zero explicit-collective manifests are the POINT here: this route
+    is pure sharding propagation (module docstring) — the tp all-reduces
+    exist only after the XLA SPMD partitioner runs, so any explicit
+    collective in the exported module means shard_map leaked in.
+
+    ``lm_fold_big_bf16_many_k2`` is the constant-bloat guard at a d where a
+    closed-over (d,) constant would dominate (d ≈ 3.3 M → +13 MB against a
+    ~0.2 MB honest module): the round-5 wedge generalized from
+    tests/test_program_size.py to the production K-fused program. It builds
+    a real 3.3M-param state, so it is not in the --fast subset, and exports
+    for cpu (its rule is serialized bytes, not TPU lowering).
+    """
+    from draco_tpu.analysis.registry import (
+        BF16_DTYPES, LintProgram, Manifest, built_token_program,
+        ci_lm_config,
+    )
+    from draco_tpu.parallel.mesh import make_folded_wtp_mesh, make_mesh_wtp
+
+    def _tp2(name, many):
+        cfg = ci_lm_config(tensor_shards=2)
+        mesh = make_mesh_wtp(4, 2)  # 8 CI devices; n=8 folds 2 lanes/device
+        setup = build_tp_train_setup(cfg, mesh)
+        return built_token_program(name, cfg, mesh, setup,
+                                   Manifest(collectives={}), many=many)
+
+    def _fold(name, many, **overrides):
+        cfg = ci_lm_config(tensor_shards=1, **overrides)
+        mesh = make_folded_wtp_mesh(cfg.num_workers)
+        setup = build_tp_train_setup(cfg, mesh)
+        allowed = (BF16_DTYPES if cfg.compute_dtype == "bfloat16"
+                   else Manifest.allowed_dtypes)
+        return built_token_program(
+            name, cfg, mesh, setup,
+            Manifest(collectives={}, allowed_dtypes=allowed), many=many)
+
+    def _fold_big(name):
+        cfg = ci_lm_config(
+            tensor_shards=1, compute_dtype="bfloat16", remat=True,
+            seq_len=64, vocab=512, model_dim=256, model_heads=4,
+            model_layers=4, batch_size=1,
+        )
+        mesh = make_folded_wtp_mesh(cfg.num_workers)
+        setup = build_tp_train_setup(cfg, mesh)
+        if setup.dim < 3_000_000:  # guard only meaningful if d is CI-large
+            raise ValueError(
+                f"big-d lint program built d={setup.dim} < 3M — the "
+                f"constant-bloat guard no longer covers a d-dominating "
+                f"constant; grow the config")
+        # a closed-over (d,) f32 would add 4*d bytes; the honest program is
+        # a few hundred KB. 2*d sits far from both (test_program_size
+        # lineage).
+        manifest = Manifest(collectives={}, allowed_dtypes=BF16_DTYPES,
+                            max_module_bytes=2 * setup.dim,
+                            max_constant_bytes=1 << 20)
+        return built_token_program(name, cfg, mesh, setup, manifest,
+                                   many=True)
+
+    mk = lambda name, build, **kw: LintProgram(  # noqa: E731
+        name=name, route="tp", build=build, **kw)
+    return [
+        mk("lm_tp2_step", lambda: _tp2("lm_tp2_step", False)),
+        mk("lm_tp2_many_k2", lambda: _tp2("lm_tp2_many_k2", True)),
+        mk("lm_fold_bf16_step",
+           lambda: _fold("lm_fold_bf16_step", False,
+                         compute_dtype="bfloat16")),
+        # the production chunked driver with the in-graph token stream: the
+        # program whose whole input is K int32 scalars (token_loop.py)
+        mk("lm_fold_devgen_many_k2",
+           lambda: _fold("lm_fold_devgen_many_k2", True, token_gen="device",
+                         steps_per_call=2)),
+        mk("lm_fold_big_bf16_many_k2",
+           lambda: _fold_big("lm_fold_big_bf16_many_k2"),
+           fast=False, export_platforms=("cpu",)),
+    ]
+
+
 def train_tp(cfg: TrainConfig, mesh, steps: Optional[int] = None,
              quiet: bool = False):
     """TP training loop; returns (state, last metrics)."""

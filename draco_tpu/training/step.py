@@ -414,3 +414,64 @@ def build_train_setup(cfg: TrainConfig, mesh, dataset_name: Optional[str] = None
         train_many=train_many,
         metric_names=metric_names,
     )
+
+
+# ---- program-lint registration (draco_tpu/analysis) -----------------------
+
+
+def lint_programs():
+    """The coded-DP CNN chip-bound programs and their manifests.
+
+    Both execution shapes register: the eager ``train_step`` and the K-fused
+    ``train_many`` scan (the production chunked loop's program,
+    trainer._run_chunked). No explicit collectives: the (n, d) gradient
+    gather is GSPMD-deferred (with_sharding_constraint only), so the
+    manifest pins all-zero counts — an explicit collective appearing here
+    would mean a shard_map/ppermute crept into the CNN path.
+    """
+    from draco_tpu.analysis.registry import (
+        BuiltProgram, LintProgram, Manifest,
+    )
+
+    def _cfg(**overrides):
+        kw = dict(
+            network="LeNet", dataset="synthetic-mnist", approach="cyclic",
+            batch_size=2, num_workers=8, worker_fail=1, err_mode="rev_grad",
+            lr=0.01, momentum=0.9, max_steps=3, eval_freq=0, train_dir="",
+            log_every=10 ** 9,
+        )
+        kw.update(overrides)
+        return TrainConfig(**kw)
+
+    def _build(name, cfg, many=False, k=2):
+        from draco_tpu import rng as drng, runtime
+
+        mesh = runtime.make_mesh(cfg.num_workers)
+        setup = build_train_setup(cfg, mesh)
+        n, b = cfg.num_workers, cfg.batch_size
+        shape = input_shape(cfg.dataset)
+        adv = drng.adversary_schedule(cfg.seed, k + 1, n,
+                                     cfg.num_adversaries)
+        manifest = Manifest(collectives={})
+        extra = {"dim": setup.dim, "devices_in_mesh": int(mesh.devices.size)}
+        if many:
+            args = (setup.state,
+                    jnp.zeros((k, n, b) + shape, jnp.float32),
+                    jnp.zeros((k, n, b), jnp.int32),
+                    jnp.asarray(np.asarray(adv[1:k + 1])), None)
+            return BuiltProgram(name, setup.train_many, args, mesh, manifest,
+                                extra=extra)
+        args = (setup.state, jnp.zeros((n, b) + shape, jnp.float32),
+                jnp.zeros((n, b), jnp.int32), jnp.asarray(np.asarray(adv[1])))
+        return BuiltProgram(name, setup.train_step, args, mesh, manifest,
+                            extra=extra)
+
+    mk = lambda name, **kw: LintProgram(  # noqa: E731
+        name=name, route="cnn",
+        build=lambda name=name, kw=kw: _build(name, **kw))
+    return [
+        mk("cnn_cyclic_step", cfg=_cfg()),
+        mk("cnn_cyclic_many_k2", cfg=_cfg(), many=True),
+        # the repetition-vote path (group_size=4 >= 2s+1, n % r == 0)
+        mk("cnn_majvote_step", cfg=_cfg(approach="maj_vote", group_size=4)),
+    ]

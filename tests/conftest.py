@@ -31,15 +31,20 @@ def pytest_configure(config):
     config.addinivalue_line("markers", "slow: long-running integration test")
     config.addinivalue_line(
         "markers",
-        "core: fast semantic lane (`pytest -m core` < 3 min) — coding, vote, "
-        "aggregation, native-oracle, and op-level tests; the subset that "
-        "gates every commit",
+        "core: fast semantic lane (`pytest -m core`, ~6 min wall on the "
+        "1-core CI host as of r7) — coding, vote, aggregation, "
+        "native-oracle, and op-level tests, plus the program linter's "
+        "--fast sweep + negative controls (~70 s of that, "
+        "test_program_lint/test_program_size — PERF.md §6); the subset "
+        "that gates every commit",
     )
 
 
 # Three tiers (r3 verdict weak #5 — the full suite is compile-bound and >9.5
 # min wall, too slow for a CI feedback loop or a judge budget):
-#   pytest -m core         — < 3 min, the algorithmic heart (these modules)
+#   pytest -m core         — ~6 min (r7), the algorithmic heart (these
+#                            modules + explicit core marks incl. the
+#                            program-lint fast sweep)
 #   pytest -m "not slow"   — adds the jitted train-step / parallel-topology
 #                            integration layer (~minutes of XLA compiles)
 #   pytest                 — everything, incl. subprocess multihost drivers

@@ -1,0 +1,154 @@
+"""Program-linter tests: the registry covers every route, the fast CLI
+subset is green, and — the part that keeps the linter honest — every
+seeded-defect negative control trips exactly its rule.
+
+Reference stake: none of these invariants is visible to an output-level
+test. The round-5 d-sized-constant regression trained bit-identically and
+wedged a 27-minute chip window anyway (PERF.md §4); donation loss doubles
+carry HBM silently; an extra all-gather changes the communication
+structure the gradient-coding line treats as the algorithm (PAPERS.md).
+"""
+
+import json
+import os
+
+import pytest
+
+from draco_tpu.analysis import RULE_NAMES, collect, lint_program
+from draco_tpu.analysis.controls import control_programs
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.core
+class TestNegativeControls:
+    """One deliberately-defective program per rule (analysis/controls.py):
+    each must trip exactly its rule, with every other rule staying green —
+    the proving-the-harness-is-live discipline of the mis-tiled pallas_call
+    in tools/tpu_attn_lowering_check.py."""
+
+    @pytest.mark.parametrize(
+        "control", control_programs(), ids=lambda c: c.program.name)
+    def test_control_trips_exactly_its_rule(self, control):
+        row = lint_program(control.program)
+        assert row["failed_rules"] == [control.expected_fail], (
+            f"{control.program.name} must trip exactly "
+            f"[{control.expected_fail}], tripped {row['failed_rules']}: "
+            f"{ {n: r for n, r in row['rules'].items() if not r['ok']} }"
+        )
+        for name, res in row["rules"].items():
+            if name != control.expected_fail:
+                assert res["ok"], (name, res)
+
+    def test_controls_cover_every_rule(self):
+        covered = {c.expected_fail for c in control_programs()}
+        assert covered == set(RULE_NAMES)
+
+
+@pytest.mark.core
+def test_registry_covers_every_route():
+    """Each route module registers at least its train_step and its K-fused
+    scan driver; names are unique (collect() raises on dupes)."""
+    programs = collect()
+    routes = {p.route for p in programs}
+    assert routes >= {"cnn", "sp", "tp", "pp", "ep"}
+    names = {p.name for p in programs}
+    for route_pair in (("cnn_cyclic_step", "cnn_cyclic_many_k2"),
+                       ("lm_sp_ring_step", "lm_sp_ring_many_k2"),
+                       ("lm_tp2_step", "lm_tp2_many_k2"),
+                       ("lm_pp_step", "lm_pp_many_k2"),
+                       ("lm_ep_step", "lm_ep_many_k2")):
+        assert names >= set(route_pair), (route_pair, names)
+    # the production chunked drivers with device token-gen and the big-d
+    # constant-bloat guard are registered too
+    assert "lm_fold_devgen_many_k2" in names
+    big = [p for p in programs if not p.fast]
+    assert [p.name for p in big] == ["lm_fold_big_bf16_many_k2"]
+
+
+@pytest.mark.core
+def test_fast_subset_all_green(tmp_path):
+    """The core-tier wiring of ``tools/program_lint.py --fast``: every fast
+    registered program passes all five rules, through the CLI's own main()
+    (controls skipped here — they have their own test above). Runtime is
+    the bulk of this module's core budget: ~60 s on the 1-core CI host
+    (PERF.md §6)."""
+    from tools.program_lint import main
+
+    out = tmp_path / "program_lint.json"
+    rc = main(["--fast", "--skip-controls", "--out", str(out)])
+    report = json.loads(out.read_text())
+    failed = {r["name"]: r.get("failed_rules") or r.get("error")
+              for r in report["rows"] if not r["ok"]}
+    assert rc == 0 and report["all_ok"], failed
+    fast_names = {p.name for p in collect() if p.fast}
+    assert {r["name"] for r in report["rows"]} == fast_names
+    for row in report["rows"]:
+        assert set(RULE_NAMES) <= set(row["rules"]), row["name"]
+
+
+@pytest.mark.core
+def test_committed_artifact_is_consistent_with_registry():
+    """baselines_out/program_lint.json (the committed artifact) must cover
+    every registered program, be green, and carry live controls — catches
+    adding a program without re-running the tool."""
+    path = os.path.join(REPO, "baselines_out", "program_lint.json")
+    report = json.load(open(path))
+    assert report["all_ok"], [r["name"] for r in report["rows"]
+                              if not r["ok"]]
+    rows = {r["name"]: r for r in report["rows"]}
+    missing = {p.name for p in collect()} - set(rows)
+    assert not missing, (
+        f"programs registered but absent from the committed artifact "
+        f"{sorted(missing)} — rerun tools/program_lint.py")
+    controls = [r for r in report["rows"] if r.get("control")]
+    assert {c["expected_fail"] for c in controls} == set(RULE_NAMES)
+
+
+def test_bench_refuses_chip_run_on_lint_violation(tmp_path):
+    """bench.py must refuse to touch the chip window while the lint
+    artifact reports a constant-bloat or host-traffic violation for the
+    CNN program family it times (ISSUE: a wedged window costs more than
+    any data point). Uses the fake-probe hook so no test touches the real
+    tunnel, and DRACO_PROGRAM_LINT_PATH to point at a violating artifact."""
+    import subprocess
+    import sys
+
+    bad = {"all_ok": False, "rows": [
+        {"name": "cnn_cyclic_many_k2", "route": "cnn", "ok": False,
+         "failed_rules": ["constant_bloat"]},
+        # control rows and non-CNN routes must NOT gate
+        {"name": "control_baked_constant", "route": "controls", "ok": True,
+         "control": True, "failed_rules": ["constant_bloat"]},
+        {"name": "lm_fold_bf16_step", "route": "tp", "ok": False,
+         "failed_rules": ["host_traffic"]},
+    ]}
+    art = tmp_path / "program_lint.json"
+    art.write_text(json.dumps(bad))
+    env = dict(os.environ, DRACO_BENCH_FAKE_PROBE="ok",
+               DRACO_PROGRAM_LINT_PATH=str(art))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--budget", "60"],
+        capture_output=True, text=True, cwd=REPO, timeout=120, env=env)
+    records = [json.loads(ln) for ln in proc.stdout.splitlines()
+               if ln.strip().startswith("{")]
+    assert records, proc.stdout + proc.stderr[-400:]
+    rec = records[-1]
+    assert rec["error"] == "program_lint_violation", rec
+    assert "cnn_cyclic_many_k2: constant_bloat" in rec["detail"]
+    # the non-CNN violation is not in this bench's family -> not named
+    assert "lm_fold_bf16_step" not in rec["detail"]
+    assert rec["value"] is None
+
+    # green artifact -> the gate stays open (the run proceeds to the probe
+    # and fails fast on the fake-ok-but-cpu-only backend, NOT on lint)
+    art.write_text(json.dumps({"all_ok": True, "rows": [
+        {"name": "cnn_cyclic_many_k2", "route": "cnn", "ok": True,
+         "failed_rules": []}]}))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--budget", "60",
+         "--no-cpu-fallback"],
+        capture_output=True, text=True, cwd=REPO, timeout=120, env=env)
+    records = [json.loads(ln) for ln in proc.stdout.splitlines()
+               if ln.strip().startswith("{")]
+    assert records and records[-1]["error"] == "tpu_unavailable", records

@@ -1,85 +1,50 @@
-"""Serialized-program-size guard for chip-facing jits.
+"""Serialized-program-size guard for chip-facing jits — now a thin call to
+the program linter's constant-bloat rule on the registered programs.
 
 The tunnel's remote-compile service rejects/chokes on large programs
 (HTTP 413 above ~100 MB; "Broken pipe at ~27 min" at 638 MB — PERF.md §4).
 Round 5 found the cyclic step closing over the d-length decode projection,
-embedding d×4 bytes of CONSTANT into every serialized module. This test
-lowers the full scanned LM train step at a CI-sized config where such a
-constant would dominate (d ≈ 6.5 M → +26 MB) and asserts the module stays
-small — so any future closure over a d-sized array fails CI instead of
-wedging a chip window.
+embedding d×4 bytes of CONSTANT into every serialized module. The bespoke
+lowering scaffold that used to live here moved into
+draco_tpu/analysis (registry + rules); these tests pin the two historical
+guard points — the big-d LM program (d ≈ 3.3 M, where a closed-over (d,)
+constant would dominate the module) and the CNN cyclic step — against the
+same rule every other registered program now passes in
+tests/test_program_lint.py / tools/program_lint.py.
 """
 
-import jax
 import pytest
 
 pytestmark = pytest.mark.core
 
 
-def test_lm_train_step_module_has_no_d_sized_constants():
-    import jax.export
+def _constant_bloat(name):
+    from draco_tpu.analysis import get
+    from draco_tpu.analysis.rules import rule_constant_bloat, trace_and_export
 
-    from draco_tpu.config import TrainConfig
-    from draco_tpu.parallel.mesh import make_folded_wtp_mesh
-    from draco_tpu.parallel.tp_step import build_tp_train_setup
-    from tools.tpu_lm_perf import (
-        build_lm_variants, make_scan_loop, stage_scan_inputs,
-    )
+    prog = get(name)
+    art = trace_and_export(prog.build(), platforms=prog.export_platforms)
+    res = rule_constant_bloat(art)
+    assert not res.get("skipped"), res
+    return res
 
-    kw = build_lm_variants(
-        batch_size=1, num_workers=8, seq_len=64, vocab=512, model_dim=256,
-        model_heads=4, model_layers=4, remat=True, max_steps=3,
-    )["lm_cyclic_s1_shared_bf16"]
-    cfg = TrainConfig(**kw)
-    mesh = make_folded_wtp_mesh(cfg.num_workers)
-    setup = build_tp_train_setup(cfg, mesh)
-    dim = setup.dim
-    assert dim > 3_000_000  # the guard is only meaningful if d is CI-large
-    xs, ms = stage_scan_inputs(cfg, 2)
-    loop = make_scan_loop(setup)
-    with mesh:
-        exp = jax.export.export(jax.jit(loop), platforms=["cpu"])(
-            setup.state, xs, ms)
-    module_bytes = len(exp.mlir_module_serialized)
-    # a closed-over (d,) f32 would add 4*dim bytes; the honest program is
-    # a few hundred KB. Threshold sits far from both.
-    assert module_bytes < 2 * dim, (
-        f"serialized LM step module is {module_bytes} bytes for d={dim} — "
-        f"a d-sized array is being embedded as a program constant "
+
+def test_lm_train_program_has_no_d_sized_constants():
+    """The registered big-d LM program (the production K-fused chunked
+    driver at a config where d > 3M — tp_step.lint_programs asserts the
+    guard stays meaningful). A closed-over (d,) f32 would add 4d bytes;
+    the honest module is a few hundred KB; the manifest threshold (2d)
+    sits far from both."""
+    res = _constant_bloat("lm_fold_big_bf16_many_k2")
+    assert res["ok"], (
+        f"{res} — a d-sized array is being embedded as a program constant "
         f"(rng.random_projection_factors_in_graph docstring / PERF.md §4)"
     )
 
 
 def test_cnn_train_step_module_has_no_d_sized_constants():
-    """Same guard for the CNN cyclic path (training/step.py) — its d≈11M
-    flagship would embed a 44 MB constant."""
-    import jax.export
-    import jax.numpy as jnp
-    import numpy as np
-
-    from draco_tpu import runtime
-    from draco_tpu.config import TrainConfig
-    from draco_tpu.training.step import build_train_setup
-
-    cfg = TrainConfig(
-        network="LeNet", dataset="synthetic-mnist", approach="cyclic",
-        batch_size=2, num_workers=8, worker_fail=1, err_mode="rev_grad",
-        lr=0.01, momentum=0.9, max_steps=3, eval_freq=0, train_dir="",
-        log_every=10**9,
-    )
-    mesh = runtime.make_mesh(cfg.num_workers)
-    setup = build_train_setup(cfg, mesh)
-    dim = setup.dim
-    x = jnp.zeros((cfg.num_workers, cfg.batch_size, 28, 28, 1), jnp.float32)
-    y = jnp.zeros((cfg.num_workers, cfg.batch_size), jnp.int32)
-    adv = jnp.asarray(np.arange(cfg.num_workers) == 0)
-    with mesh:
-        exp = jax.export.export(
-            jax.jit(lambda s, x, y, m: setup.train_step(s, x, y, m)),
-            platforms=["cpu"],
-        )(setup.state, x, y, adv)
-    module_bytes = len(exp.mlir_module_serialized)
-    assert module_bytes < max(2 * dim, 2_000_000), (
-        f"serialized CNN step module is {module_bytes} bytes for d={dim} — "
-        f"a d-sized array is being embedded as a program constant"
+    """Same guard for the CNN cyclic path (training/step.py)."""
+    res = _constant_bloat("cnn_cyclic_step")
+    assert res["ok"], (
+        f"{res} — a d-sized array is being embedded as a program constant"
     )

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 
 
 def setup_cpu_host(device_count: int) -> None:
@@ -48,9 +49,31 @@ def run_rows(out_path: str, method: str, named_rows, extra=None):
         report["all_ok"] = all(r["ok"] for r in report["rows"])
         with open(out_path, "w") as fh:
             json.dump(report, fh, indent=1)
-        import sys
-
         print(f"[lowering] {name}: "
               f"{'ok' if row['ok'] else row.get('error', '?')[:120]}",
               file=sys.stderr, flush=True)
     return report
+
+
+def lint_row(program, extra_row=None):
+    """Run the five program-lint rules on a registered
+    :class:`draco_tpu.analysis.LintProgram` and shape the result as a
+    run_rows row: ``ok`` is the lint verdict, ``failed_rules``/``rules``
+    carry the per-rule detail. The three lowering-check tools build their
+    rows through this helper so a chip-scale audit row always carries the
+    same verdict fields as the CI artifact (baselines_out/program_lint.json)."""
+    import time
+
+    from draco_tpu.analysis import lint_program
+
+    t0 = time.time()
+    try:
+        row = lint_program(program)
+    except Exception as e:  # build/trace crash: report as a failed row
+        return {"ok": False, "seconds": round(time.time() - t0, 1),
+                "error": f"{type(e).__name__}: {str(e)[:400]}",
+                **(extra_row or {})}
+    row["seconds"] = round(time.time() - t0, 1)
+    if extra_row:
+        row.update(extra_row)
+    return row

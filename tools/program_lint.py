@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""Repo-wide static audit of every registered chip-bound program.
+
+Runs the five lint rules (draco_tpu/analysis/rules.py: constant_bloat,
+donation, dtype, collectives, host_traffic) against every program in the
+registry (draco_tpu/analysis/registry.py — the coded-DP CNN
+train_step/train_many and all five LM token routes including the K-fused
+scan drivers), on the CPU-host mesh via the cross-platform-export
+methodology of the lowering-check tools. Then runs the five seeded-defect
+NEGATIVE CONTROLS (analysis/controls.py); a control row is ``ok`` iff it
+trips exactly its rule — a linter that stops seeing defects fails its own
+artifact.
+
+  python tools/program_lint.py [--out baselines_out/program_lint.json]
+      [--fast] [--programs name,name] [--skip-controls]
+
+``--fast`` skips the non-fast programs (currently only the big-d
+constant-bloat guard, which builds ~3.3M params); the fast subset runs in
+roughly a minute on the CI host and is what the ``core``-tier test
+exercises (tests/test_program_lint.py, PERF.md §6).
+
+The report is rewritten after every row (incremental-artifact discipline);
+bench.py refuses to record a chip run while this artifact reports a
+constant_bloat or host_traffic violation for the program family being
+timed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", type=str,
+                    default="baselines_out/program_lint.json")
+    ap.add_argument("--fast", action="store_true",
+                    help="skip programs registered fast=False (the big-d "
+                         "constant-bloat guard, ~3.3M params)")
+    ap.add_argument("--programs", type=str, default="",
+                    help="comma-separated subset of registered programs")
+    ap.add_argument("--skip-controls", action="store_true",
+                    help="skip the seeded-defect negative controls")
+    ap.add_argument("--devices", type=int, default=8,
+                    help="virtual CPU devices (the CI mesh size)")
+    args = ap.parse_args(argv)
+
+    from tools._lowering_common import lint_row, run_rows, setup_cpu_host
+
+    setup_cpu_host(args.devices)
+
+    from draco_tpu.analysis import RULE_NAMES, collect
+    from draco_tpu.analysis.controls import control_programs
+
+    programs = collect()
+    if args.fast:
+        programs = [p for p in programs if p.fast]
+    if args.programs:
+        keep = {v.strip() for v in args.programs.split(",")}
+        unknown = keep - {p.name for p in programs}
+        if unknown:
+            raise SystemExit(f"unknown programs {sorted(unknown)}; "
+                             f"registered: {[p.name for p in programs]}")
+        programs = [p for p in programs if p.name in keep]
+
+    named = [(p.name, (lambda p=p: lint_row(p))) for p in programs]
+    if not args.skip_controls:
+        def control_thunk(c):
+            row = lint_row(c.program)
+            tripped = row.get("failed_rules", [])
+            live = tripped == [c.expected_fail]
+            return {**row, "ok": live, "expected_fail": c.expected_fail,
+                    "control": True,
+                    **({} if live else
+                       {"error": f"control must trip exactly "
+                                 f"[{c.expected_fail}], tripped {tripped}"})}
+
+        named += [(c.program.name, (lambda c=c: control_thunk(c)))
+                  for c in control_programs()]
+
+    report = run_rows(
+        args.out,
+        "five static rules (constant_bloat, donation, dtype, collectives, "
+        "host_traffic) over jit.trace jaxprs + jax.export StableHLO on the "
+        "CPU-host mesh; rows named control_* are seeded-defect negative "
+        "controls whose ok means 'tripped exactly its rule'",
+        named,
+        extra={"fast": args.fast, "devices": args.devices,
+               "rules": list(RULE_NAMES)},
+    )
+    print(json.dumps({"all_ok": report["all_ok"],
+                      "rows": len(report["rows"])}))
+    return 0 if report["all_ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

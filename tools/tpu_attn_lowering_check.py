@@ -42,7 +42,7 @@ def main(argv=None) -> int:
     ap.add_argument("--seq-lens", type=str, default="256,1024,2048,4096")
     args = ap.parse_args(argv)
 
-    from tools._lowering_common import setup_cpu_host
+    from tools._lowering_common import lint_row, run_rows, setup_cpu_host
 
     setup_cpu_host(1)
     import jax
@@ -50,28 +50,44 @@ def main(argv=None) -> int:
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
 
+    from draco_tpu.analysis import (
+        BF16_DTYPES, BuiltProgram, LintProgram, Manifest,
+    )
     from draco_tpu.ops import flash_attention as fa
 
-    def try_lower(fn, T, B=4, H=12, Dh=64, dtype=jnp.float32, grad=False):
-        q = jnp.zeros((B, T, H, Dh), dtype)
-        if grad:
-            f = jax.jit(lambda q, k, v: jax.grad(
-                lambda q, k, v: jnp.sum(fn(q, k, v).astype(jnp.float32))
-            )(q, k, v))
-        else:
-            f = jax.jit(fn)
-        try:
-            jax.export.export(f, platforms=["tpu"])(q, q, q)
-            return {"ok": True}
-        except Exception as e:
-            return {"ok": False,
-                    "error": f"{type(e).__name__}: {str(e)[:400]}"}
+    # kernel-level rows: no state carry to donate and no cross-device
+    # collectives, so those rules are manifest-skipped; constant-bloat,
+    # dtype, and host-traffic still apply (a kernel baking a T-sized table
+    # or upcasting to f64 should fail here, not on chip). The kernel's MXU
+    # matmuls accumulate f32 in-op (dot_general preferred_element_type —
+    # "the kernel accumulates f32 regardless", ops/flash_attention.py), so
+    # dot_general joins the promotion whitelist here; the LM route
+    # manifests stay convert-only.
+    kernel_manifest = Manifest(require_donated=None, collectives=None,
+                               allowed_dtypes=BF16_DTYPES,
+                               bf16_promotion_whitelist=(
+                                   "convert_element_type", "dot_general"))
+
+    def kernel_program(name, fn, T, B=4, H=12, Dh=64, dtype=jnp.float32,
+                       grad=False):
+        def build():
+            q = jnp.zeros((B, T, H, Dh), dtype)
+            if grad:
+                f = jax.jit(lambda q, k, v: jax.grad(
+                    lambda q, k, v: jnp.sum(fn(q, k, v).astype(jnp.float32))
+                )(q, k, v))
+            else:
+                f = jax.jit(fn)
+            return BuiltProgram(name, f, (q, q, q), None, kernel_manifest)
+
+        return LintProgram(name=name, build=build, route="attn_kernel",
+                           fast=False)
 
     fwd = lambda q, k, v: fa.flash_attention(q, k, v, force=True)  # noqa: E731
     ring = lambda q, k, v: fa.flash_attention_with_lse(  # noqa: E731
         q, k, v, causal=False, force=True)[0]
 
-    rows = []
+    named = []
     for t in [int(x) for x in args.seq_lens.split(",")]:
         for label, fn, kw in [
             ("causal_fwd_f32", fwd, {}),
@@ -79,11 +95,10 @@ def main(argv=None) -> int:
             ("causal_fwd_bf16", fwd, {"dtype": jnp.bfloat16}),
             ("ring_noncausal_fwdbwd_f32", ring, {"grad": True}),
         ]:
-            res = try_lower(fn, t, **kw)
-            rows.append({"seq_len": t, "variant": label, **res})
-            print(f"[attn_lowering] T={t} {label}: "
-                  f"{'ok' if res['ok'] else res['error'][:120]}",
-                  file=sys.stderr, flush=True)
+            p = kernel_program(f"T{t}_{label}", fn, t, **kw)
+            named.append((p.name, (
+                lambda p=p, t=t, label=label:
+                    lint_row(p, extra_row={"seq_len": t, "variant": label}))))
 
     # negative control: this MUST fail with the historical ValueError
     def kern(x_ref, o_ref):
@@ -110,17 +125,16 @@ def main(argv=None) -> int:
                    "error_head": str(e)[:160],
                    "matches_historical": "Pallas TPU lowering" in str(e)}
 
-    report = {
-        "method": "jax.export cross-platform lowering, platforms=['tpu'], "
-                  "CPU host — exercises the Pallas TPU lowering stage that "
-                  "produced every pre-fix hardware failure",
-        "all_ok": all(r["ok"] for r in rows),
-        "rows": rows,
-        "negative_control_bad_tiling": control,
-    }
-    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
-    with open(args.out, "w") as fh:
-        json.dump(report, fh, indent=1)
+    report = run_rows(
+        args.out,
+        "jax.export cross-platform lowering, platforms=['tpu'], CPU host — "
+        "exercises the Pallas TPU lowering stage that produced every "
+        "pre-fix hardware failure; each row carries the program-lint "
+        "verdict (draco_tpu/analysis; donation/collectives manifest-skipped "
+        "for kernel-level programs)",
+        named,
+        extra={"negative_control_bad_tiling": control},
+    )
     print(json.dumps({"all_ok": report["all_ok"],
                       "negative_control_ok":
                           control.get("matches_historical", False)}))

@@ -42,38 +42,54 @@ import argparse
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def audit_variant(name, cfg_kw, steps=2):
+def lm_big_program(name, cfg_kw, steps=2):
+    """Register one lm_big rung variant as a chip-tier LintProgram: the row
+    now carries the full five-rule lint verdict on top of the lowering
+    check, through the same machinery as the CI artifact
+    (tools/_lowering_common.lint_row / draco_tpu/analysis).
+
+    The audited program is unchanged: the exact scan loop the chip rung
+    times (make_scan_loop over stage_scan_inputs — which deliberately does
+    NOT donate its state, because the timing protocol re-runs the compiled
+    loop on the same state; manifest.require_donated=None records that).
+    Explicit-collective counts are also None: this is the GSPMD folded
+    route, whose collectives exist only post-partitioner.
+    """
     import jax
-    import jax.export
 
-    from draco_tpu.config import TrainConfig
-    from draco_tpu.parallel.mesh import make_folded_wtp_mesh
-    from draco_tpu.parallel.tp_step import build_tp_train_setup
-    from tools.tpu_lm_perf import make_scan_loop, stage_scan_inputs
+    from draco_tpu.analysis import BF16_DTYPES, BuiltProgram, LintProgram, Manifest
 
-    cfg = TrainConfig(**cfg_kw)
-    mesh = make_folded_wtp_mesh(cfg.num_workers)
-    t0 = time.time()
-    try:
+    def build():
+        from draco_tpu.config import TrainConfig
+        from draco_tpu.parallel.mesh import make_folded_wtp_mesh
+        from draco_tpu.parallel.tp_step import build_tp_train_setup
+        from tools.tpu_lm_perf import make_scan_loop, stage_scan_inputs
+
+        cfg = TrainConfig(**cfg_kw)
+        mesh = make_folded_wtp_mesh(cfg.num_workers)
         setup = build_tp_train_setup(cfg, mesh)
         xs, ms = stage_scan_inputs(cfg, steps)
-        loop = make_scan_loop(setup)
         with mesh:
-            jax.export.export(jax.jit(loop), platforms=["tpu"])(
-                setup.state, xs, ms)
+            loop = jax.jit(make_scan_loop(setup))
         n_params = sum(x.size for x in jax.tree.leaves(setup.state.params))
-        return {"variant": name, "ok": True, "params": int(n_params),
-                "devices_in_mesh": int(mesh.devices.size),
-                "seconds": round(time.time() - t0, 1)}
-    except Exception as e:
-        return {"variant": name, "ok": False,
-                "seconds": round(time.time() - t0, 1),
-                "error": f"{type(e).__name__}: {str(e)[:400]}"}
+        manifest = Manifest(
+            require_donated=None, collectives=None,
+            allowed_dtypes=BF16_DTYPES,
+            # a closed-over (d,) f32 adds 4d bytes (638 MB at this d — the
+            # remote-compile ceiling, PERF.md §4); honest modules are ~1 MB
+            max_module_bytes=2 * setup.dim, max_constant_bytes=1 << 20,
+        )
+        return BuiltProgram(name, loop, (setup.state, xs, ms), mesh,
+                            manifest,
+                            extra={"variant": name, "params": int(n_params),
+                                   "devices_in_mesh":
+                                       int(mesh.devices.size)})
+
+    return LintProgram(name=name, build=build, route="lm_big", fast=False)
 
 
 # The lm_big rung shapes, asserted in CI against the chip_jobs_r5.sh rung
@@ -95,7 +111,7 @@ def main(argv=None) -> int:
 
     # ONE virtual device: the chip folds all logical workers onto a single
     # device and the audit must lower that exact layout (docstring)
-    from tools._lowering_common import run_rows, setup_cpu_host
+    from tools._lowering_common import lint_row, run_rows, setup_cpu_host
 
     setup_cpu_host(1)
 
@@ -103,16 +119,16 @@ def main(argv=None) -> int:
 
     v_b2 = build_lm_variants(batch_size=2, **LM_BIG)
     v_b1 = build_lm_variants(batch_size=1, **LM_BIG)
-    named = ([(n, (lambda n=n: audit_variant(n, v_b2[n])))
-              for n in LM_BIG_VARIANTS_B2]
-             + [(n, (lambda n=n: audit_variant(n, v_b1[n])))
-                for n in LM_BIG_VARIANTS_B1])
+    programs = ([lm_big_program(n, v_b2[n]) for n in LM_BIG_VARIANTS_B2]
+                + [lm_big_program(n, v_b1[n]) for n in LM_BIG_VARIANTS_B1])
+    named = [(p.name, (lambda p=p: lint_row(p))) for p in programs]
     report = run_rows(
         args.out,
         "jax.export cross-platform lowering, platforms=['tpu'], CPU host "
         "with ONE virtual device (the chip's folded layout), full scanned "
         "train-step programs at the exact chip_jobs_r5.sh lm_big rung "
-        "shapes, configs imported from tools/tpu_lm_perf.py",
+        "shapes, configs imported from tools/tpu_lm_perf.py; each row "
+        "carries the five-rule program-lint verdict (draco_tpu/analysis)",
         named,
     )
     print(json.dumps({"all_ok": report["all_ok"]}))

@@ -34,58 +34,55 @@ import argparse
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def audit_axis(tag, overrides, w=8):
-    import jax
-    import jax.export
-    import jax.numpy as jnp
-    import numpy as np
+def axis_program(name, tag, overrides, collectives, w=8):
+    """Register one parallel-axis row as a chip-tier LintProgram. The
+    audited program is the route builder's own donated ``train_step`` (the
+    old bespoke thunk re-wrapped it in a fresh jit, which dropped the
+    donation attrs — the lint donation rule needs the real program), and
+    the row carries the five-rule verdict including the axis's explicit
+    collective budget: the ring/pipeline hop structure IS the row's claim,
+    so count drift fails the audit even when lowering succeeds."""
+    from draco_tpu.analysis import BuiltProgram, LintProgram, Manifest
+    from draco_tpu.analysis.registry import lm_example_tokens
 
-    from draco_tpu import rng as drng
-    from draco_tpu.config import TrainConfig
-    from draco_tpu.parallel import (
-        make_mesh_2d, make_mesh_wep, make_mesh_wpp, make_mesh_wtp,
-    )
-    from draco_tpu.parallel.ep_step import build_ep_train_setup
-    from draco_tpu.parallel.pp_step import build_pp_train_setup
-    from draco_tpu.parallel.sp_step import build_sp_train_setup, synthetic_text
-    from draco_tpu.parallel.tp_step import build_tp_train_setup
+    def build():
+        from draco_tpu.config import TrainConfig
+        from draco_tpu.parallel import (
+            make_mesh_2d, make_mesh_wep, make_mesh_wpp, make_mesh_wtp,
+        )
+        from draco_tpu.parallel.ep_step import build_ep_train_setup
+        from draco_tpu.parallel.pp_step import build_pp_train_setup
+        from draco_tpu.parallel.sp_step import build_sp_train_setup
+        from draco_tpu.parallel.tp_step import build_tp_train_setup
 
-    builders = {
-        "sp": (build_sp_train_setup, make_mesh_2d),
-        "tp": (build_tp_train_setup, make_mesh_wtp),
-        "pp": (build_pp_train_setup, make_mesh_wpp),
-        "ep": (build_ep_train_setup, make_mesh_wep),
-    }
-    build, make_mesh_fn = builders[tag]
-    cfg = TrainConfig(
-        network="TransformerLM", dataset="synthetic-text", batch_size=2,
-        num_workers=w, approach="cyclic", mode="normal", worker_fail=1,
-        err_mode="rev_grad", seq_len=64, vocab=64, model_dim=64,
-        model_heads=2, max_steps=2, eval_freq=0, train_dir="",
-        log_every=1000, **overrides)
-    t0 = time.time()
-    try:
+        builders = {
+            "sp": (build_sp_train_setup, make_mesh_2d),
+            "tp": (build_tp_train_setup, make_mesh_wtp),
+            "pp": (build_pp_train_setup, make_mesh_wpp),
+            "ep": (build_ep_train_setup, make_mesh_wep),
+        }
+        builder, make_mesh_fn = builders[tag]
+        cfg = TrainConfig(
+            network="TransformerLM", dataset="synthetic-text", batch_size=2,
+            num_workers=w, approach="cyclic", mode="normal", worker_fail=1,
+            err_mode="rev_grad", seq_len=64, vocab=64, model_dim=64,
+            model_heads=2, max_steps=2, eval_freq=0, train_dir="",
+            log_every=1000, **overrides)
         mesh = make_mesh_fn(w, 2)
-        setup = build(cfg, mesh)
-        toks = jnp.asarray(synthetic_text(
-            cfg.seed, 1, cfg.num_workers, cfg.batch_size, cfg.seq_len,
-            cfg.vocab))
-        adv = drng.adversary_schedule(cfg.seed, 2, cfg.num_workers,
-                                      cfg.num_adversaries)
-        mask = jnp.asarray(np.asarray(adv[1]))
-        f = jax.jit(lambda st, t, m: setup.train_step(st, t, m))
-        with mesh:
-            jax.export.export(f, platforms=["tpu"])(setup.state, toks, mask)
-        return {"ok": True, "devices_in_mesh": int(mesh.devices.size),
-                "seconds": round(time.time() - t0, 1)}
-    except Exception as e:
-        return {"ok": False, "seconds": round(time.time() - t0, 1),
-                "error": f"{type(e).__name__}: {str(e)[:400]}"}
+        setup = builder(cfg, mesh)
+        toks, mask = lm_example_tokens(cfg)
+        manifest = Manifest(collectives=collectives)
+        return BuiltProgram(name, setup.train_step,
+                            (setup.state, toks, mask), mesh, manifest,
+                            extra={"devices_in_mesh":
+                                       int(mesh.devices.size)})
+
+    return LintProgram(name=name, build=build, route=f"parallel_{tag}",
+                       fast=False)
 
 
 def main(argv=None) -> int:
@@ -94,26 +91,42 @@ def main(argv=None) -> int:
                     default="baselines_out/tpu_parallel_lowering.json")
     args = ap.parse_args(argv)
 
-    from tools._lowering_common import run_rows, setup_cpu_host
+    from tools._lowering_common import lint_row, run_rows, setup_cpu_host
 
     setup_cpu_host(16)
 
+    # explicit-collective budgets per axis (the hop structure is the row's
+    # claim), imported from the owning route modules so a legitimate
+    # schedule change is a ONE-file manifest edit (PERF.md §6): the sp ring
+    # budget covers both attention inners (dense and flash — the hop
+    # structure is inner-independent), the pipeline brings its tick
+    # schedule + loss/grad psums, and tp/ep are pure GSPMD (collectives
+    # post-partitioner = none explicit).
+    from draco_tpu.parallel import pp_step, sp_step
+
     axes = [
-        ("sp_ring_dense", "sp", dict(seq_shards=2, model_layers=1)),
+        ("sp_ring_dense", "sp", dict(seq_shards=2, model_layers=1),
+         sp_step.LINT_COLLECTIVES),
         ("sp_ring_flash", "sp", dict(seq_shards=2, model_layers=1,
-                                     attn_impl="flash")),
-        ("tp", "tp", dict(tensor_shards=2, model_layers=1)),
+                                     attn_impl="flash"),
+         sp_step.LINT_COLLECTIVES),
+        ("tp", "tp", dict(tensor_shards=2, model_layers=1), {}),
         ("pp", "pp", dict(pipeline_shards=2, pp_microbatches=2,
-                          model_layers=2)),
-        ("ep", "ep", dict(moe_experts=4, expert_shards=2, model_layers=1)),
+                          model_layers=2),
+         pp_step.LINT_COLLECTIVES),
+        ("ep", "ep", dict(moe_experts=4, expert_shards=2, model_layers=1),
+         {}),
     ]
-    named = [(name, (lambda tag=tag, ov=overrides: audit_axis(tag, ov)))
-             for name, tag, overrides in axes]
+    programs = [axis_program(name, tag, ov, colls)
+                for name, tag, ov, colls in axes]
+    named = [(p.name, (lambda p=p: lint_row(p))) for p in programs]
     report = run_rows(
         args.out,
         "jax.export cross-platform lowering, platforms=['tpu'], 16 virtual "
-        "CPU devices, w=8 cyclic s=1 coded DP x axis2=2 full jitted train "
-        "steps",
+        "CPU devices, w=8 cyclic s=1 coded DP x axis2=2, the route "
+        "builders' own donated train_step programs; each row carries the "
+        "five-rule program-lint verdict incl. the axis's explicit "
+        "collective budget (draco_tpu/analysis)",
         named,
     )
     print(json.dumps({"all_ok": report["all_ok"]}))
