@@ -141,8 +141,19 @@ def add_fit_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     p.add_argument("--remat", action="store_true",
                    help="rematerialise activations in backward (jax.checkpoint)")
     p.add_argument("--profile-dir", type=str, default="",
-                   help="capture a jax.profiler trace of a few steps into "
-                        "this directory (SURVEY.md §5.1)")
+                   help="capture a jax.profiler device trace of a few steps "
+                        "into this directory (SURVEY.md §5.1) — every "
+                        "route: the coded-DP trainer and all five "
+                        "TransformerLM token routes. With --steps-per-call "
+                        "K > 1 the capture window snaps to whole chunks "
+                        "(the chunks containing the profiled steps), since "
+                        "a chunk is one indivisible device program")
+    p.add_argument("--trace-dir", type=str, default="",
+                   help="write a Chrome-trace-event trace.json of the HOST "
+                        "phases (gather/upload/dispatch/sync/flush/eval/"
+                        "ckpt + prefetcher lanes) into this directory — "
+                        "open in Perfetto; complements --profile-dir's "
+                        "device trace (draco_tpu/obs)")
     return p
 
 
@@ -207,6 +218,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         compute_dtype=args.compute_dtype,
         steps_per_call=args.steps_per_call,
         token_gen=args.token_gen,
+        trace_dir=args.trace_dir,
         remat=args.remat,
         eval_freq=args.eval_freq,
         train_dir=args.train_dir,
@@ -250,25 +262,30 @@ def main(argv=None):
             args.preset, max_steps=args.max_steps, eval_freq=args.eval_freq,
             train_dir=args.train_dir, checkpoint_step=args.checkpoint_step,
             log_every=args.log_every, compute_dtype=args.compute_dtype,
-            data_dir=args.data_dir,
+            data_dir=args.data_dir, trace_dir=args.trace_dir,
         )
     else:
         cfg = config_from_args(args)
+    profile_dir = args.profile_dir or None
     if cfg.network == "TransformerLM":
         # model-parallel paths compose with coded DP on 2-D (w × axis)
-        # meshes; config.validate() guarantees at most one axis is active
+        # meshes; config.validate() guarantees at most one axis is active.
+        # --profile-dir routes to every one of them (run_token_loop;
+        # chunk-snapped under steps_per_call > 1)
         if cfg.tensor_shards > 1:
             from draco_tpu.parallel import make_mesh_wtp
             from draco_tpu.parallel.tp_step import train_tp
 
             _, last = train_tp(cfg, make_mesh_wtp(cfg.num_workers,
-                                                  cfg.tensor_shards))
+                                                  cfg.tensor_shards),
+                               profile_dir=profile_dir)
         elif cfg.expert_shards > 1:
             from draco_tpu.parallel import make_mesh_wep
             from draco_tpu.parallel.ep_step import train_ep
 
             _, last = train_ep(cfg, make_mesh_wep(cfg.num_workers,
-                                                  cfg.expert_shards))
+                                                  cfg.expert_shards),
+                               profile_dir=profile_dir)
         elif cfg.pipeline_shards > 1 or cfg.pp_microbatches > 0:
             # pp_microbatches alone still selects the pipeline path: the
             # GPipe schedule runs at S=1 with M microbatches (validated
@@ -277,17 +294,24 @@ def main(argv=None):
             from draco_tpu.parallel.pp_step import train_pp
 
             _, last = train_pp(cfg, make_mesh_wpp(cfg.num_workers,
-                                                  cfg.pipeline_shards))
+                                                  cfg.pipeline_shards),
+                               profile_dir=profile_dir)
         else:
             # long-context default: (w × sp) mesh, ring/a2a attention
             from draco_tpu.parallel import make_mesh_2d
             from draco_tpu.parallel.sp_step import train_sp
 
             _, last = train_sp(cfg, make_mesh_2d(cfg.num_workers,
-                                                 cfg.seq_shards))
+                                                 cfg.seq_shards),
+                               profile_dir=profile_dir)
         return last
     trainer = Trainer(cfg)
-    last = trainer.run(profile_dir=args.profile_dir or None)
+    try:
+        last = trainer.run(profile_dir=profile_dir)
+    finally:
+        # drains the buffered MetricWriter (tail safety) and writes the
+        # final trace.json window
+        trainer.close()
     return last
 
 

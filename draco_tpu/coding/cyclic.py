@@ -53,6 +53,14 @@ PREC = jax.lax.Precision.HIGHEST
 # seen at n≤32).
 LOCATOR_RCOND = 1e-5
 
+# Decode-health row-flagging threshold (relative amplitude): a received row
+# whose deviation from the fitted codeword exceeds HEALTH_REL_TOL × the
+# RMS row magnitude counts as a located error. Honest-row deviations are
+# pure f32 solve noise (~1e-6 relative, even through the m×m fit); the
+# in-scope attack payloads sit at O(100×) the honest magnitude (attacks.py
+# ADVERSARY=-100) — five orders of margin either side.
+HEALTH_REL_TOL = 1e-3
+
 
 # --------------------------------------------------------------------------
 # Construction (host-side numpy, run identically by every participant at
@@ -228,7 +236,28 @@ def _locate_v(code: CyclicCode, e_re: jnp.ndarray, e_im: jnp.ndarray,
     Steps 2–5 of the decode: syndrome → error-locator solve → honest-row
     top-k → recombination vector v with vᵀC1 = e1ᵀ supported on those rows.
     Shape-static and vmap-able (layer-granularity decode maps this over the
-    per-layer projected columns). Returns (v_re, v_im, honest), all (n,).
+    per-layer projected columns). Returns (v_re, v_im, honest, health) —
+    the first three (n,), ``health`` the decode-health dict (below).
+
+    Decode health (in-graph, no host traffic): the paper's exactness
+    guarantee — the decoder *exactly* removes ≤ s corruptions — made
+    observable. After choosing the honest set, fit the codeword those rows
+    imply (the m×m solve ``C1[idx] q̂ = e[idx]``) and measure every row's
+    deviation ``|e − C1 q̂|``:
+
+      * honest rows deviate by f32 solve noise only (≈1e-6 relative);
+      * a corrupt row deviates by its injected error magnitude;
+      * rows above HEALTH_REL_TOL × RMS(e) are ``flagged`` (present rows
+        only — a zero-filled straggler erasure is known-missing, not a
+        detected adversary);
+      * ``residual`` is the *unflagged* present rows' deviation energy as
+        a fraction of total received energy — ≈ 0 whenever the decode is
+        self-consistent (the located-honest codeword explains every row it
+        claims is honest), and the fault signal when it is not: with more
+        corruption than the locator budget the honest set is mislocated,
+        the fitted codeword is poisoned, and genuinely honest rows deviate
+        loudly (they then also over-flag, so ``located > s`` is the
+        companion budget-exceeded signal).
     """
     n, s = code.n, code.s
     c2h_re = jnp.asarray(code.c2h_re)
@@ -305,11 +334,29 @@ def _locate_v(code: CyclicCode, e_re: jnp.ndarray, e_im: jnp.ndarray,
 
     v_full_re = jnp.zeros((n,), rec_re.dtype).at[idx].set(v_re)
     v_full_im = jnp.zeros((n,), rec_re.dtype).at[idx].set(v_im)
-    return v_full_re, v_full_im, honest
+
+    # ---- decode health (docstring above): codeword fit + per-row deviation
+    pres_f = (jnp.ones((n,), jnp.float32) if present is None
+              else present.astype(jnp.float32))
+    q_re, q_im = _complex_solve(rec_re, rec_im, e_re[idx], e_im[idx])
+    c1_re = jnp.asarray(code.c1_re)
+    c1_im = jnp.asarray(code.c1_im)
+    fit_re = jnp.matmul(c1_re, q_re, precision=PREC) - jnp.matmul(
+        c1_im, q_im, precision=PREC)
+    fit_im = jnp.matmul(c1_re, q_im, precision=PREC) + jnp.matmul(
+        c1_im, q_re, precision=PREC)
+    dev = (e_re - fit_re) ** 2 + (e_im - fit_im) ** 2  # (n,) |e - C1 q̂|²
+    energy = e_re**2 + e_im**2
+    msq = jnp.sum(energy * pres_f) / jnp.maximum(jnp.sum(pres_f), 1.0)
+    flagged = (dev > (HEALTH_REL_TOL**2) * msq) & (pres_f > 0)
+    resid_sq = jnp.sum(jnp.where(flagged, 0.0, dev) * pres_f) / jnp.maximum(
+        jnp.sum(energy * pres_f), 1e-30)
+    health = {"residual": jnp.sqrt(resid_sq), "flagged": flagged}
+    return v_full_re, v_full_im, honest, health
 
 
 def decode(code: CyclicCode, r_re: jnp.ndarray, r_im: jnp.ndarray, rand_factor: jnp.ndarray,
-           present: Optional[jnp.ndarray] = None):
+           present: Optional[jnp.ndarray] = None, with_health: bool = False):
     """Recover the exact sum of the n batch gradients from corrupt rows.
 
     r_re, r_im: (n, d) received encoded rows (≤ s rows arbitrarily corrupt).
@@ -326,22 +373,29 @@ def decode(code: CyclicCode, r_re: jnp.ndarray, r_im: jnp.ndarray, rand_factor: 
     Returns (n·mean-gradient, honest_mask): the (d,) real decoded sum / n and
     the (n,) mask of rows the recombination actually used (True = treated as
     honest; exactly n-2s rows are True, every located adversary and every
-    absent row is False).
+    absent row is False). ``with_health=True`` appends the decode-health
+    dict (``_locate_v`` docstring: scalar ``residual`` ≈ 0 iff the decode is
+    self-consistent, (n,) bool ``flagged`` marking present rows whose
+    received value deviates from the fitted codeword) — in-graph values for
+    the telemetry metric columns, backward-compatible 2-tuple otherwise.
     """
     n = code.n
     # 1. project to one column: e = R @ f  (the only O(n·d) work besides the
     #    final recombination — one fused pass over (R_re, R_im))
     e_re, e_im = ops_coded.complex_project(r_re, r_im, rand_factor)
-    v_full_re, v_full_im, honest = _locate_v(code, e_re, e_im, present)
+    v_full_re, v_full_im, honest, health = _locate_v(code, e_re, e_im, present)
 
     # 6. recombine: Re(v^T R) / n — the second O(n·d) pass, fused
     decoded = ops_coded.complex_recombine(v_full_re, v_full_im, r_re, r_im) / n
+    if with_health:
+        return decoded, honest, health
     return decoded, honest
 
 
 def decode_layers(code: CyclicCode, r_re: jnp.ndarray, r_im: jnp.ndarray,
                   rand_factor: jnp.ndarray, offsets,
-                  present: Optional[jnp.ndarray] = None):
+                  present: Optional[jnp.ndarray] = None,
+                  with_health: bool = False):
     """Layer-granularity decode — one locator per parameter tensor.
 
     The reference decodes each layer independently with its own random
@@ -357,7 +411,10 @@ def decode_layers(code: CyclicCode, r_re: jnp.ndarray, r_im: jnp.ndarray,
     corruption confined to a single layer's coordinates, which a single
     global projection could only see through that layer's contribution.
 
-    Returns (decoded (d,), honest (L, n)).
+    Returns (decoded (d,), honest (L, n)); ``with_health=True`` appends the
+    combined decode-health dict — residual is the worst layer's (a single
+    inconsistent layer is a fault), flagged is the union over layers (a row
+    corrupted in any layer's coordinates is a located error).
     """
     n = code.n
     bounds = [int(o) for o in offsets]
@@ -370,11 +427,16 @@ def decode_layers(code: CyclicCode, r_re: jnp.ndarray, r_im: jnp.ndarray,
         e_ims.append(e_im)
     e_re_l = jnp.stack(e_res)  # (L, n)
     e_im_l = jnp.stack(e_ims)
-    v_re_l, v_im_l, honest_l = jax.vmap(
+    v_re_l, v_im_l, honest_l, health_l = jax.vmap(
         lambda er, ei: _locate_v(code, er, ei, present)
     )(e_re_l, e_im_l)
     parts = [
         ops_coded.complex_recombine(v_re_l[i], v_im_l[i], r_re[:, a:b], r_im[:, a:b])
         for i, (a, b) in enumerate(zip(bounds[:-1], bounds[1:]))
     ]
-    return jnp.concatenate(parts) / n, honest_l
+    decoded = jnp.concatenate(parts) / n
+    if with_health:
+        health = {"residual": jnp.max(health_l["residual"]),
+                  "flagged": jnp.any(health_l["flagged"], axis=0)}
+        return decoded, honest_l, health
+    return decoded, honest_l

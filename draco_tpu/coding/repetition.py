@@ -147,7 +147,8 @@ def build_repetition_code(n: int, r: int) -> RepetitionCode:
 
 def majority_vote(code: RepetitionCode, grads: jnp.ndarray,
                   present=None, key=None,
-                  method: str = "fingerprint") -> jnp.ndarray:
+                  method: str = "fingerprint",
+                  with_health: bool = False):
     """grads: (n, d) -> (d,) mean over groups of each group's majority row.
 
     ``present``: optional (n,) bool — absent members (stragglers) neither
@@ -164,6 +165,20 @@ def majority_vote(code: RepetitionCode, grads: jnp.ndarray,
     surface at all; the right choice when adversaries may know the
     experiment seed (module docstring tier 3; reference exact-recovery
     semantics, rep_master.py:162).
+
+    ``with_health=True`` returns ``(voted, health)`` — the vote's own
+    detection record, computed from the agreement matrix the vote already
+    built (telemetry metric columns; no extra O(d) pass):
+
+      * ``vote_agree``: fraction of present members whose row bitwise
+        matches their group's winner — 1.0 is the all-honest state, each
+        live corrupted row subtracts 1/|present|;
+      * ``flagged_groups``: number of groups containing ≥ 1 dissenting
+        present member (the reference PS would have rejected exactly these
+        groups' minority rows, rep_master.py:154-168);
+      * ``flagged``: (n,) bool — present members out-voted by their group
+        (the per-row located-adversary set; absent stragglers are
+        known-missing, never "detected").
     """
     g, r = code.num_groups, code.r
     rows = grads.reshape(g, r, -1)
@@ -181,14 +196,31 @@ def majority_vote(code: RepetitionCode, grads: jnp.ndarray,
             f"method must be 'fingerprint' or 'exact', got {method!r}"
         )
     if present is None:
+        pres = jnp.ones((g, r), bool)
         agree = jnp.sum(eq, axis=-1)
         winner = jnp.argmax(agree, axis=-1)  # (G,)
         picked = jnp.take_along_axis(rows, winner[:, None, None], axis=1)[:, 0, :]
-        return jnp.mean(picked, axis=0)
-    pres = present.reshape(g, r)
-    agree = jnp.sum(eq & pres[:, None, :], axis=-1)  # only present members vote
-    agree = jnp.where(pres, agree, -1)  # absent members cannot win
-    winner = jnp.argmax(agree, axis=-1)
-    picked = jnp.take_along_axis(rows, winner[:, None, None], axis=1)[:, 0, :]
-    group_alive = jnp.any(pres, axis=1).astype(grads.dtype)  # (G,)
-    return (group_alive @ picked) / jnp.maximum(jnp.sum(group_alive), 1.0)
+        voted = jnp.mean(picked, axis=0)
+    else:
+        pres = present.reshape(g, r)
+        agree = jnp.sum(eq & pres[:, None, :], axis=-1)  # only present members vote
+        agree = jnp.where(pres, agree, -1)  # absent members cannot win
+        winner = jnp.argmax(agree, axis=-1)
+        picked = jnp.take_along_axis(rows, winner[:, None, None], axis=1)[:, 0, :]
+        group_alive = jnp.any(pres, axis=1).astype(grads.dtype)  # (G,)
+        voted = (group_alive @ picked) / jnp.maximum(jnp.sum(group_alive), 1.0)
+    if not with_health:
+        return voted
+    # member i agrees with its group's winner iff eq[g, i, winner_g]
+    winner_agree = jnp.take_along_axis(
+        eq, winner[:, None, None], axis=2)[:, :, 0]  # (G, r) bool
+    flagged = pres & ~winner_agree
+    n_pres = jnp.maximum(jnp.sum(pres.astype(jnp.float32)), 1.0)
+    health = {
+        "vote_agree": jnp.sum((winner_agree & pres).astype(jnp.float32))
+        / n_pres,
+        "flagged_groups": jnp.sum(jnp.any(flagged, axis=1)
+                                  .astype(jnp.int32)),
+        "flagged": flagged.reshape(code.n),
+    }
+    return voted, health

@@ -179,6 +179,18 @@ class TrainConfig:
     # the unrolled form.
     scan_layers: bool = False
 
+    # --- telemetry (draco_tpu/obs; ISSUE 4) ---
+    # When set, the production loops write a Chrome-trace-event
+    # ``trace_dir/trace.json`` of the HOST phases the chunked regime
+    # otherwise hides (gather/upload/dispatch/sync/flush/eval/ckpt +
+    # prefetcher worker-thread lanes + queue-depth counters) — open it in
+    # chrome://tracing or https://ui.perfetto.dev. Disabled (the default)
+    # the tracer is a shared no-op object: no allocation, no clock reads,
+    # and never any device fetch either way. Device-side phase attribution
+    # is the separate jax.profiler capture (--profile-dir), aligned via the
+    # jax.named_scope phase names inside the step programs.
+    trace_dir: str = ""
+
     # --- misc ---
     seed: int = SEED
     geomedian_iters: int = 80  # Weiszfeld iterations (replaces hdmedians dep)

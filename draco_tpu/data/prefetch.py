@@ -20,6 +20,7 @@ import numpy as np
 
 from draco_tpu import native
 from draco_tpu.data.datasets import Dataset
+from draco_tpu.obs.tracer import NULL_TRACER
 
 
 class _PipelinedGather:
@@ -34,16 +35,23 @@ class _PipelinedGather:
     """
 
     def __init__(self, ds: Dataset, num_workers: int, batch_size: int,
-                 num_threads: int = 4):
+                 num_threads: int = 4, tracer=NULL_TRACER):
         self.ds = ds
         self.num_workers = num_workers
         self.batch_size = batch_size
         self._src = np.ascontiguousarray(ds.train_x)  # loader gathers raw rows
         self._loader: Optional[native.BatchLoader] = None
+        self._tracer = tracer
         if native.AVAILABLE:
             self._loader = native.BatchLoader(num_threads)
         # (key, ticket, idx) of the request being assembled in the background
         self._inflight: Optional[tuple[Any, int, np.ndarray]] = None
+
+    @property
+    def depth(self) -> int:
+        """In-flight background requests (0 or 1 — the pipeline is two-deep),
+        the heartbeat's prefetch-queue-depth signal."""
+        return int(self._inflight is not None)
 
     def _request_indices(self, key) -> np.ndarray:
         raise NotImplementedError
@@ -52,19 +60,26 @@ class _PipelinedGather:
         raise NotImplementedError
 
     def _get(self, key, next_key):
+        tracer = self._tracer
         if self._loader is None:
-            idx = self._request_indices(key)
-            return self._reshape(self._src[idx.reshape(-1)], idx, key)
+            with tracer.span("prefetch.gather"):
+                idx = self._request_indices(key)
+                return self._reshape(self._src[idx.reshape(-1)], idx, key)
         if self._inflight is not None and self._inflight[0] == key:
             _, ticket, idx = self._inflight
             self._inflight = None
-            x = self._loader.wait(ticket)
+            # wait-time on the native pool: ~0 when the gather kept ahead of
+            # the device, the host-side stall when it did not
+            with tracer.span("prefetch.wait"):
+                x = self._loader.wait(ticket)
         else:  # cold start / non-sequential access (e.g. resume)
             if self._inflight is not None:
                 self._loader.wait(self._inflight[1])
                 self._inflight = None
-            idx = self._request_indices(key)
-            x = self._loader.wait(self._loader.submit(self._src, idx.reshape(-1)))
+            with tracer.span("prefetch.gather"):
+                idx = self._request_indices(key)
+                x = self._loader.wait(
+                    self._loader.submit(self._src, idx.reshape(-1)))
         batch = self._reshape(x, idx, key)
         if next_key is not None:
             nidx = self._request_indices(next_key)
@@ -73,6 +88,7 @@ class _PipelinedGather:
                 self._loader.submit(self._src, nidx.reshape(-1)),
                 nidx,
             )
+        tracer.counter("prefetch_depth", self.depth)
         return batch
 
     def close(self):
@@ -92,8 +108,9 @@ class BatchPrefetcher(_PipelinedGather):
     """
 
     def __init__(self, ds: Dataset, indices_fn: Callable[[int], np.ndarray],
-                 num_workers: int, batch_size: int, num_threads: int = 4):
-        super().__init__(ds, num_workers, batch_size, num_threads)
+                 num_workers: int, batch_size: int, num_threads: int = 4,
+                 tracer=NULL_TRACER):
+        super().__init__(ds, num_workers, batch_size, num_threads, tracer)
         self.indices_fn = indices_fn
 
     def _request_indices(self, step: int) -> np.ndarray:
@@ -122,8 +139,9 @@ class ChunkPrefetcher(_PipelinedGather):
     """
 
     def __init__(self, ds: Dataset, range_indices_fn,
-                 num_workers: int, batch_size: int, num_threads: int = 4):
-        super().__init__(ds, num_workers, batch_size, num_threads)
+                 num_workers: int, batch_size: int, num_threads: int = 4,
+                 tracer=NULL_TRACER):
+        super().__init__(ds, num_workers, batch_size, num_threads, tracer)
         self.range_indices_fn = range_indices_fn
 
     def _request_indices(self, rng: tuple) -> np.ndarray:
@@ -153,25 +171,46 @@ class TokenChunkPrefetcher:
     so the host builds chunk i+1 while the device executes chunk i.
 
     gen_fn: step -> (n, B, T) tokens (deterministic, per-step).
+
+    ``tracer``: optional SpanTracer — the worker thread labels its own
+    trace lane and emits one ``prefetch.assemble`` span per chunk, so the
+    trace shows the assembly racing the device's chunk execution;
+    ``prefetch_depth`` counter events track the in-flight request (one
+    counter name for this signal everywhere: both prefetcher families and
+    the status.json heartbeat key).
     """
 
-    def __init__(self, gen_fn: Callable[[int], np.ndarray]):
+    def __init__(self, gen_fn: Callable[[int], np.ndarray],
+                 tracer=NULL_TRACER):
         import concurrent.futures
 
         self._gen = gen_fn
+        self._tracer = tracer
         self._pool = concurrent.futures.ThreadPoolExecutor(
-            max_workers=1, thread_name_prefix="token-chunk-prefetch"
+            max_workers=1, thread_name_prefix="token-chunk-prefetch",
+            # labels the worker's trace lane (runs once, on the worker
+            # thread itself, when it spins up; no-op on the null tracer)
+            initializer=lambda: tracer.name_thread("token-chunk-prefetch"),
         )
         self._inflight: Optional[tuple] = None  # (range, future)
 
+    @property
+    def depth(self) -> int:
+        """In-flight background assemblies (0 or 1), the heartbeat's
+        prefetch-queue-depth signal."""
+        return int(self._inflight is not None)
+
     def _assemble(self, rng: tuple) -> np.ndarray:
         start, k = rng
-        return np.stack([self._gen(step) for step in range(start, start + k)])
+        with self._tracer.span("prefetch.assemble", chunk_start=start, k=k):
+            return np.stack([self._gen(step)
+                             for step in range(start, start + k)])
 
     def get(self, rng: tuple, next_range: Optional[tuple] = None) -> np.ndarray:
         rng = tuple(rng)
         if self._inflight is not None and self._inflight[0] == rng:
-            block = self._inflight[1].result()
+            with self._tracer.span("prefetch.wait"):
+                block = self._inflight[1].result()
             self._inflight = None
         else:  # cold start / non-sequential access (e.g. resume)
             if self._inflight is not None:
@@ -181,6 +220,7 @@ class TokenChunkPrefetcher:
         if next_range is not None:
             nxt = tuple(next_range)
             self._inflight = (nxt, self._pool.submit(self._assemble, nxt))
+        self._tracer.counter("prefetch_depth", self.depth)
         return block
 
     def close(self):

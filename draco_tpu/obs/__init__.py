@@ -1,0 +1,33 @@
+"""Telemetry spine: host span tracing + run heartbeats.
+
+The scan-chunked loops (PR 1–2) are fast precisely because the host goes
+dark between flushes — which also means nothing shows where a chunk's
+wall-clock went, and no artifact of a run shows whether the decode caught
+the seeded adversaries. This package is the observability layer ROADMAP's
+production north star needs, built under the PR 1–2 invariant: **no new
+device fetches in steady state** and zero overhead when disabled.
+
+  tracer.py     SpanTracer — Chrome-trace-event host spans
+                (gather/upload/dispatch/sync/flush/eval/ckpt + prefetcher
+                worker-thread lanes + queue-depth counters) written to
+                ``trace_dir/trace.json``, loadable in Perfetto / chrome://
+                tracing; ``NULL_TRACER`` is the allocation-free disabled
+                path every loop runs by default.
+  heartbeat.py  RunHeartbeat — ``train_dir/status.json`` rewritten
+                atomically at every flush boundary (step, steps/s, ETA,
+                last loss, decode health, prefetch queue depth) so external
+                monitors can watch a long chip job without touching the
+                process.
+
+The in-graph half of the telemetry (decode-health metric columns) lives
+where the math lives: coding/cyclic.py + coding/repetition.py produce the
+per-step health values inside the jitted programs, and they ride the
+existing (K, m) metric block through DeferredMetricWriter — named scopes
+and metric columns, never host callbacks, so every registered program
+stays green under the PR 3 linter's host_traffic rule.
+"""
+
+from draco_tpu.obs.heartbeat import RunHeartbeat
+from draco_tpu.obs.tracer import NULL_TRACER, SpanTracer, make_tracer
+
+__all__ = ["NULL_TRACER", "RunHeartbeat", "SpanTracer", "make_tracer"]

@@ -1,0 +1,136 @@
+"""Run heartbeat: ``train_dir/status.json`` rewritten at flush boundaries.
+
+Long chip jobs run for hours with the host dark between flushes; the only
+way to watch one today is to tail stdout or poll metrics.jsonl (which the
+buffered MetricWriter now also only touches at flush boundaries). The
+heartbeat is the external-monitoring contract instead: a single small JSON
+file, atomically replaced (tmp + rename) at every flush boundary, holding
+everything a dashboard or a watchdog needs —
+
+  step / total_steps / steps_per_s / eta_s   progress and rate
+  loss (+ prec1 when the route emits it)     last materialized train record
+  decode_health                              cumulative detection
+                                             precision/recall vs the seeded
+                                             adversary schedule, last decode
+                                             residual / vote agreement
+  prefetch_depth                             in-flight prefetch requests
+  updated_at                                 wall-clock of the last beat
+
+The decode-health precision/recall is computed HERE, on the host, from the
+per-step in-graph columns (det_tp / det_adv / located_errors /
+det_flagged) that ride the (K, m) metric block — the device never runs a
+callback and the host never does an extra fetch: :meth:`observe` is wired
+as the DeferredMetricWriter observer, so it sees exactly the records the
+flush materializes anyway.
+
+A stale ``updated_at`` is itself the signal: a watchdog that sees no beat
+for a few flush periods knows the run is wedged without attaching to it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional
+
+# per-step detection-count columns (in-graph, coding/cyclic.py +
+# coding/repetition.py): tp = flagged ∧ adversarial ∧ present,
+# adv = adversarial ∧ present, flagged = located_errors | det_flagged
+_TP_KEY = "det_tp"
+_ADV_KEY = "det_adv"
+_FLAGGED_KEYS = ("located_errors", "det_flagged")
+# last-value health fields copied verbatim from the newest record
+_LAST_KEYS = ("decode_residual", "vote_agree", "flagged_groups",
+              "honest_located")
+
+
+class RunHeartbeat:
+    """Accumulates per-step records (:meth:`observe`) and rewrites
+    ``status.json`` on :meth:`beat`. Disabled (``train_dir`` falsy or not
+    the metrics-emitting process) it is a cheap no-op — both methods
+    return immediately."""
+
+    def __init__(self, train_dir: Optional[str], enabled: bool = True):
+        self.path = (os.path.join(train_dir, "status.json")
+                     if (train_dir and enabled) else None)
+        if self.path:
+            os.makedirs(train_dir, exist_ok=True)
+        self._t0 = time.perf_counter()
+        self._first_step: Optional[int] = None
+        self._tp = 0.0
+        self._adv = 0.0
+        self._flagged = 0.0
+        self._last: dict = {}
+        self.beats = 0
+
+    # ---- accumulation ----------------------------------------------------
+    def observe(self, record: dict) -> None:
+        """One materialized train record (every step, logged or not) —
+        wired as the DeferredMetricWriter observer in the chunked loops,
+        called inline per step by the eager loops."""
+        if self.path is None:
+            return
+        step = record.get("step")
+        if step is not None and self._first_step is None:
+            self._first_step = int(step)
+        if _TP_KEY in record:
+            self._tp += float(record[_TP_KEY])
+            self._adv += float(record.get(_ADV_KEY, 0.0))
+            for k in _FLAGGED_KEYS:
+                if k in record:
+                    self._flagged += float(record[k])
+                    break
+        self._last = record
+
+    def decode_health(self) -> Optional[dict]:
+        """Cumulative detection precision/recall (1.0 denominators-empty:
+        nothing flagged / no live adversary is a healthy state) + the
+        newest per-step health values."""
+        if not self._last or _TP_KEY not in self._last:
+            return None
+        health = {
+            "precision": (self._tp / self._flagged) if self._flagged else 1.0,
+            "recall": (self._tp / self._adv) if self._adv else 1.0,
+            "flagged_total": self._flagged,
+            "adv_total": self._adv,
+        }
+        for k in _LAST_KEYS:
+            if k in self._last:
+                health[k] = float(self._last[k])
+        return health
+
+    # ---- emission --------------------------------------------------------
+    def beat(self, step: int, total_steps: Optional[int] = None,
+             extra: Optional[dict] = None) -> Optional[dict]:
+        """Rewrite status.json (atomic). ``extra`` merges verbatim (e.g.
+        ``{"prefetch_depth": 1}``). Returns the written payload (None when
+        disabled) so tests and callers can assert on it."""
+        if self.path is None:
+            return None
+        now = time.perf_counter()
+        done = step - (self._first_step or step) + 1
+        dt = max(now - self._t0, 1e-9)
+        rate = done / dt
+        payload = {
+            "step": int(step),
+            "total_steps": int(total_steps) if total_steps else None,
+            "steps_per_s": round(rate, 4),
+            "eta_s": (round(max(total_steps - step, 0) / rate, 1)
+                      if (total_steps and rate > 0) else None),
+            "updated_at": time.time(),
+        }
+        for k in ("loss", "prec1"):
+            if k in self._last:
+                payload[k] = float(self._last[k])
+        health = self.decode_health()
+        if health is not None:
+            payload["decode_health"] = health
+        if extra:
+            payload.update(extra)
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(payload, fh)
+        os.replace(tmp, self.path)
+        self.beats += 1
+        return payload

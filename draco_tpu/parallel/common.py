@@ -18,11 +18,16 @@ from draco_tpu.coding import cyclic as cyclic_mod
 
 def aggregate_flat_grads(grads: jnp.ndarray, adv_mask, cfg, code, rand_factor,
                          present=None, leaf_offsets=None):
-    """(n, d) per-worker flat gradients → one aggregated (d,) gradient.
+    """(n, d) per-worker flat gradients → ``(aggregated (d,), health)``.
 
     cyclic: shared-redundancy encode, adversarial injection on the encoded
-    rows, exact decode. Otherwise: injection on the raw rows, then the
-    configured robust aggregation (mean / geo-median / krum).
+    rows, exact decode — ``health`` is the in-graph decode-health dict
+    (coding/cyclic.decode ``with_health``: scalar ``residual`` ≈ 0 iff the
+    decode is self-consistent, (n,) bool ``flagged`` of located-error
+    rows). Otherwise: injection on the raw rows, then the configured robust
+    aggregation (mean / geo-median / krum) — approximate rules carry no
+    exactness certificate, so ``health`` is None and the telemetry layer
+    emits no decode-health columns for them.
 
     ``present`` ((n,) bool, optional): straggler rows marked False never
     arrive — cyclic decodes around them as erasures (known-missing, one
@@ -33,43 +38,53 @@ def aggregate_flat_grads(grads: jnp.ndarray, adv_mask, cfg, code, rand_factor,
     _make_unravel — required when ``cfg.decode_granularity == "layer"`` so
     the cyclic decode runs one locator per parameter tensor like the
     reference (cyclic_master.py:125-129), matching the CNN path.
+
+    The encode/decode phases run under ``jax.named_scope`` so XProf device
+    traces group ops by Draco's reference phase names (the device-side
+    counterpart of the host SpanTracer, draco_tpu/obs).
     """
     if cfg.approach == "cyclic":
-        if grads.ndim == 3:
-            # (n, hat_s, d): true per-worker redundant lanes
-            # (cfg.redundancy == "simulate" — the reference's r× compute,
-            # cyclic_worker.py:122-146); each worker encodes its own rows
-            enc_re, enc_im = cyclic_mod.encode(code, grads)
-        else:
-            # (n, d): one-copy batch gradients, rows formed algebraically
-            # (cfg.redundancy == "shared", the TPU-native fast path)
-            enc_re, enc_im = cyclic_mod.encode_shared(code, grads)
-        enc_re, enc_im = attacks.inject_cyclic(
-            enc_re, enc_im, adv_mask, cfg.err_mode, cfg.adversarial
-        )
-        if present is not None:
-            pw = present[:, None].astype(enc_re.dtype)
-            enc_re, enc_im = enc_re * pw, enc_im * pw
-        if cfg.decode_granularity == "layer":
-            if leaf_offsets is None:
-                raise ValueError(
-                    "decode_granularity='layer' needs leaf_offsets from "
-                    "_make_unravel"
-                )
-            agg, _honest = cyclic_mod.decode_layers(
-                code, enc_re, enc_im, rand_factor, leaf_offsets,
-                present=present,
+        with jax.named_scope("draco_encode"):
+            if grads.ndim == 3:
+                # (n, hat_s, d): true per-worker redundant lanes
+                # (cfg.redundancy == "simulate" — the reference's r× compute,
+                # cyclic_worker.py:122-146); each worker encodes its own rows
+                enc_re, enc_im = cyclic_mod.encode(code, grads)
+            else:
+                # (n, d): one-copy batch gradients, rows formed algebraically
+                # (cfg.redundancy == "shared", the TPU-native fast path)
+                enc_re, enc_im = cyclic_mod.encode_shared(code, grads)
+            enc_re, enc_im = attacks.inject_cyclic(
+                enc_re, enc_im, adv_mask, cfg.err_mode, cfg.adversarial
             )
-        else:
-            agg, _honest = cyclic_mod.decode(code, enc_re, enc_im,
-                                             rand_factor, present=present)
-        return agg
-    grads = attacks.inject_plain(grads, adv_mask, cfg.err_mode, cfg.adversarial,
-                                 n_mal=cfg.num_adversaries)
-    return aggregation.aggregate(
-        grads, cfg.mode, s=cfg.worker_fail,
-        geomedian_iters=cfg.geomedian_iters, present=present,
-    )
+            if present is not None:
+                pw = present[:, None].astype(enc_re.dtype)
+                enc_re, enc_im = enc_re * pw, enc_im * pw
+        with jax.named_scope("draco_decode"):
+            if cfg.decode_granularity == "layer":
+                if leaf_offsets is None:
+                    raise ValueError(
+                        "decode_granularity='layer' needs leaf_offsets from "
+                        "_make_unravel"
+                    )
+                agg, _honest, health = cyclic_mod.decode_layers(
+                    code, enc_re, enc_im, rand_factor, leaf_offsets,
+                    present=present, with_health=True,
+                )
+            else:
+                agg, _honest, health = cyclic_mod.decode(
+                    code, enc_re, enc_im, rand_factor, present=present,
+                    with_health=True)
+        return agg, health
+    with jax.named_scope("draco_decode"):
+        grads = attacks.inject_plain(grads, adv_mask, cfg.err_mode,
+                                     cfg.adversarial,
+                                     n_mal=cfg.num_adversaries)
+        agg = aggregation.aggregate(
+            grads, cfg.mode, s=cfg.worker_fail,
+            geomedian_iters=cfg.geomedian_iters, present=present,
+        )
+    return agg, None
 
 
 def masked_loss_metric(losses, present):
@@ -84,16 +99,61 @@ def masked_loss_metric(losses, present):
 def apply_flat_update(state, agg: jnp.ndarray, opt, unravel):
     """Aggregated flat gradient → (new_params, new_opt_state) via the
     grads-as-argument optimizer convention (reference sgd_modified.py:53)."""
-    grads_tree = unravel(agg)
-    updates, new_opt = opt.update(grads_tree, state.opt_state, state.params)
-    new_params = jax.tree.map(lambda p, u: p + u, state.params, updates)
+    with jax.named_scope("draco_update"):
+        grads_tree = unravel(agg)
+        updates, new_opt = opt.update(grads_tree, state.opt_state,
+                                      state.params)
+        new_params = jax.tree.map(lambda p, u: p + u, state.params, updates)
     return new_params, new_opt
 
 
-# column order of the (K, m) metric block train_token_many returns — the LM
-# step bodies emit exactly one scalar metric today; extend here (and in every
-# step_body) if the routes ever grow more
+# column order of the (K, m) metric block train_token_many returns on the
+# non-coded routes; cyclic routes append DECODE_HEALTH_NAMES — use
+# token_metric_names(cfg), never these tuples directly, so the step bodies
+# and the host flush can't disagree on the column order
 TOKEN_METRIC_NAMES = ("loss",)
+
+# per-step decode-health columns (in-graph scalars; coding/cyclic.py):
+#   decode_residual  self-consistency residual, ≈ 0 iff decode exact
+#   located_errors   present rows flagged as corrupt by the decode
+#   det_tp           flagged ∧ adversarial ∧ present (true positives)
+#   det_adv          adversarial ∧ present (the detectable ground truth)
+# flush boundaries derive detection precision = Σdet_tp/Σlocated_errors and
+# recall = Σdet_tp/Σdet_adv from these (obs/heartbeat.py) — the seeded
+# schedules are step inputs, so the comparison runs in-graph with no host
+# traffic.
+DECODE_HEALTH_NAMES = ("decode_residual", "located_errors", "det_tp",
+                       "det_adv")
+
+
+def token_metric_names(cfg) -> tuple:
+    """Column order of the (K, m) metric block for an LM route at ``cfg``
+    — every route builder stores this on its setup so the shared token
+    loop flushes the right schema."""
+    if cfg.approach == "cyclic":
+        return TOKEN_METRIC_NAMES + DECODE_HEALTH_NAMES
+    return TOKEN_METRIC_NAMES
+
+
+def decode_health_metrics(health, adv_mask, present) -> dict:
+    """The DECODE_HEALTH_NAMES columns from a decode-health dict + the
+    step's seeded schedules ({} when the route has no exactness
+    certificate, i.e. health is None). The present-gated counting is the
+    one shared implementation (training/step._detection_metrics — a
+    straggling adversary's row never arrives, so it is neither detectable
+    nor ground truth); only the column name differs: the cyclic flag count
+    ships as ``located_errors``."""
+    from draco_tpu.training.step import _detection_metrics
+
+    if health is None:
+        return {}
+    det = _detection_metrics(health["flagged"], adv_mask, present)
+    return {
+        "decode_residual": health["residual"],
+        "located_errors": det["det_flagged"],
+        "det_tp": det["det_tp"],
+        "det_adv": det["det_adv"],
+    }
 
 
 def make_token_train_many(step_body, token_fn=None,

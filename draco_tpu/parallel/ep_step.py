@@ -83,7 +83,7 @@ def lint_programs():
 
 
 def train_ep(cfg: TrainConfig, mesh, steps: Optional[int] = None,
-             quiet: bool = False):
+             quiet: bool = False, profile_dir: Optional[str] = None):
     """EP training loop; returns (state, last metrics)."""
     return run_token_loop(build_ep_train_setup(cfg, mesh), cfg, steps, quiet,
-                          tag="ep")
+                          tag="ep", profile_dir=profile_dir)

@@ -46,8 +46,10 @@ from draco_tpu.parallel.common import (
     TOKEN_METRIC_NAMES,
     aggregate_flat_grads,
     apply_flat_update,
+    decode_health_metrics,
     make_token_train_many,
     masked_loss_metric,
+    token_metric_names,
 )
 from draco_tpu.parallel.mesh import PP_AXIS
 from draco_tpu.parallel.tp_step import _constrain_params, shard_params
@@ -318,33 +320,38 @@ def build_pp_train_setup(cfg: TrainConfig, mesh) -> PPTrainSetup:
             if cfg.approach == "cyclic" else None)
 
     def step_body(state: TrainState, tokens, adv_mask, present=None):
-        grads, losses = per_worker_grads(state.params, tokens)
+        with jax.named_scope("draco_comp"):
+            grads, losses = per_worker_grads(state.params, tokens)
         # in-graph decode projection — no d-length program constant
         # (rng.random_projection_factors_in_graph docstring)
         rand_factor = (drng.random_projection_factors_in_graph(cfg.seed, dim)
                        if code is not None else None)
-        agg = aggregate_flat_grads(grads, adv_mask, cfg, code, rand_factor,
-                                   present=present,
-                                   leaf_offsets=leaf_offsets)
+        agg, health = aggregate_flat_grads(grads, adv_mask, cfg, code,
+                                           rand_factor, present=present,
+                                           leaf_offsets=leaf_offsets)
         new_params, new_opt = apply_flat_update(state, agg, opt, unravel)
         new_state = TrainState(
             _constrain_params(new_params, mesh, _leaf_spec), new_opt, None,
             state.step + 1,
         )
-        return new_state, {"loss": masked_loss_metric(losses, present)}
+        metrics = {"loss": masked_loss_metric(losses, present)}
+        metrics.update(decode_health_metrics(health, adv_mask, present))
+        return new_state, metrics
 
     def eval_body(params, tokens):
         return jnp.mean(per_worker_loss(params, tokens))
 
     from draco_tpu.parallel.sp_step import token_fn_from_cfg
 
+    metric_names = token_metric_names(cfg)
     with mesh:
         train_step = jax.jit(step_body, donate_argnums=(0,))
         eval_step = jax.jit(eval_body)
         loss_jit = jax.jit(per_worker_loss)
         grads_jit = jax.jit(per_worker_grads)
         train_token_many = jax.jit(
-            make_token_train_many(step_body, token_fn_from_cfg(cfg)),
+            make_token_train_many(step_body, token_fn_from_cfg(cfg),
+                                  metric_names=metric_names),
             donate_argnums=(0,),
         )
 
@@ -352,7 +359,7 @@ def build_pp_train_setup(cfg: TrainConfig, mesh) -> PPTrainSetup:
         state=state, train_step=train_step, eval_step=eval_step,
         per_worker_loss=loss_jit, per_worker_grads=grads_jit,
         code=code, unravel=unravel, dim=dim,
-        train_token_many=train_token_many,
+        train_token_many=train_token_many, metric_names=metric_names,
     )
 
 
@@ -397,9 +404,10 @@ def lint_programs():
 
 
 def train_pp(cfg: TrainConfig, mesh, steps: Optional[int] = None,
-             quiet: bool = False):
+             quiet: bool = False, profile_dir: Optional[str] = None):
     """PP training loop; returns (state, last metrics)."""
     from draco_tpu.parallel.token_loop import run_token_loop
 
     setup = build_pp_train_setup(cfg, mesh)
-    return run_token_loop(setup, cfg, steps, quiet, tag="pp")
+    return run_token_loop(setup, cfg, steps, quiet, tag="pp",
+                          profile_dir=profile_dir)

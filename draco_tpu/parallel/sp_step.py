@@ -37,8 +37,10 @@ from draco_tpu.parallel.common import (
     TOKEN_METRIC_NAMES,
     aggregate_flat_grads,
     apply_flat_update,
+    decode_health_metrics,
     make_token_train_many,
     masked_loss_metric,
+    token_metric_names,
 )
 from draco_tpu.parallel.mesh import SEQ_AXIS
 from draco_tpu.parallel.ring_attention import ring_attention
@@ -264,26 +266,29 @@ def build_sp_train_setup(cfg: TrainConfig, mesh) -> SPTrainSetup:
     shard_w3 = NamedSharding(mesh, P(WORKER_AXIS, None, None))
 
     def step_body(state: TrainState, tokens, adv_mask, present=None):
-        if simulate:
-            # gather each worker's redundant rows (n, hat_s, B, T); GSPMD
-            # inserts the w-axis collective for the cross-worker rows
-            toks_w = tokens[batch_ids]
-            grads, losses = grads_fn_sim(state.params, toks_w)
-            grads = lax.with_sharding_constraint(grads, shard_w3)
-            losses = jnp.mean(losses, axis=1)
-        else:
-            grads, losses = grads_fn(state.params, tokens)
-            grads = lax.with_sharding_constraint(grads, shard_w)
+        with jax.named_scope("draco_comp"):
+            if simulate:
+                # gather each worker's redundant rows (n, hat_s, B, T); GSPMD
+                # inserts the w-axis collective for the cross-worker rows
+                toks_w = tokens[batch_ids]
+                grads, losses = grads_fn_sim(state.params, toks_w)
+                grads = lax.with_sharding_constraint(grads, shard_w3)
+                losses = jnp.mean(losses, axis=1)
+            else:
+                grads, losses = grads_fn(state.params, tokens)
+                grads = lax.with_sharding_constraint(grads, shard_w)
         # in-graph decode projection — no d-length program constant
         # (rng.random_projection_factors_in_graph docstring)
         rand_factor = (drng.random_projection_factors_in_graph(cfg.seed, dim)
                        if code is not None else None)
-        agg = aggregate_flat_grads(grads, adv_mask, cfg, code, rand_factor,
-                                   present=present,
-                                   leaf_offsets=leaf_offsets)
+        agg, health = aggregate_flat_grads(grads, adv_mask, cfg, code,
+                                           rand_factor, present=present,
+                                           leaf_offsets=leaf_offsets)
         new_params, new_opt = apply_flat_update(state, agg, opt, unravel)
         new_state = TrainState(new_params, new_opt, None, state.step + 1)
-        return new_state, {"loss": masked_loss_metric(losses, present)}
+        metrics = {"loss": masked_loss_metric(losses, present)}
+        metrics.update(decode_health_metrics(health, adv_mask, present))
+        return new_state, metrics
 
     loss_fn = shard_map(
         device_loss,
@@ -296,18 +301,20 @@ def build_sp_train_setup(cfg: TrainConfig, mesh) -> SPTrainSetup:
     def eval_body(params, tokens):
         return jnp.mean(loss_fn(params, tokens))
 
+    metric_names = token_metric_names(cfg)
     with mesh:
         train_step = jax.jit(step_body, donate_argnums=(0,))
         eval_step = jax.jit(eval_body)
         train_token_many = jax.jit(
-            make_token_train_many(step_body, token_fn_from_cfg(cfg)),
+            make_token_train_many(step_body, token_fn_from_cfg(cfg),
+                                  metric_names=metric_names),
             donate_argnums=(0,),
         )
 
     return SPTrainSetup(
         model=model, state=state, train_step=train_step, eval_step=eval_step,
         code=code, unravel=unravel, dim=dim,
-        train_token_many=train_token_many,
+        train_token_many=train_token_many, metric_names=metric_names,
     )
 
 
@@ -350,11 +357,13 @@ def lint_programs():
     ]
 
 
-def train_sp(cfg: TrainConfig, mesh, steps: Optional[int] = None, quiet: bool = False):
+def train_sp(cfg: TrainConfig, mesh, steps: Optional[int] = None,
+             quiet: bool = False, profile_dir: Optional[str] = None):
     """SP training loop on the synthetic text stream; returns the final state
     and last-step metrics. Checkpoint/eval/resume/chunking semantics live in
-    the shared token loop (parallel/token_loop.py)."""
+    the shared token loop (parallel/token_loop.py); ``profile_dir`` captures
+    a jax.profiler device trace there (chunk-snapped under K>1)."""
     from draco_tpu.parallel.token_loop import run_token_loop
 
     return run_token_loop(build_sp_train_setup(cfg, mesh), cfg, steps, quiet,
-                          tag="sp")
+                          tag="sp", profile_dir=profile_dir)

@@ -42,7 +42,7 @@ guard and checkpointing without eval was impossible).
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Any, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -51,10 +51,24 @@ import numpy as np
 from draco_tpu import rng as drng
 from draco_tpu.config import TrainConfig
 from draco_tpu.data.batching import chunk_ranges
+from draco_tpu.obs import NULL_TRACER, RunHeartbeat
+
+
+class _LoopTelemetry(NamedTuple):
+    """Telemetry context threaded through both regimes' drivers (defaults =
+    everything disabled, so direct driver calls need no setup)."""
+
+    tracer: Any = NULL_TRACER
+    heartbeat: RunHeartbeat = RunHeartbeat(None)
+    total_end: int = 0  # last step of the run (heartbeat ETA denominator)
+    profile_dir: Optional[str] = None
+    profile_steps: tuple = (3, 8)
 
 
 def run_token_loop(setup, cfg: TrainConfig, steps: Optional[int] = None,
-                   quiet: bool = False, tag: str = "mp"):
+                   quiet: bool = False, tag: str = "mp",
+                   profile_dir: Optional[str] = None,
+                   profile_steps: tuple = (3, 8)):
     """Train ``steps or cfg.max_steps`` steps on the synthetic token stream.
 
     Same operational contract as the CNN Trainer: step-indexed Orbax
@@ -62,7 +76,15 @@ def run_token_loop(setup, cfg: TrainConfig, steps: Optional[int] = None,
     baseline_master.py:142-144), resume via ``cfg.checkpoint_step``.
     ``tag`` labels the route in error messages only; metric records carry
     the step number. Returns (state, last metrics).
+
+    Telemetry (draco_tpu/obs, same contract as Trainer.run): ``profile_dir``
+    captures a jax.profiler device trace of steps [profile_steps) — under
+    the chunked regime capture snaps to the chunks containing those steps,
+    exactly like ``Trainer._run_chunked``; ``cfg.trace_dir`` writes the
+    host-span ``trace.json``; ``cfg.train_dir`` gets the ``status.json``
+    heartbeat at every flush boundary.
     """
+    from draco_tpu.obs import make_tracer
     from draco_tpu.parallel.sp_step import synthetic_text
     from draco_tpu.utils import checkpoint as ckpt_mod
     from draco_tpu.utils.metrics import MetricWriter
@@ -85,7 +107,10 @@ def run_token_loop(setup, cfg: TrainConfig, steps: Optional[int] = None,
         if cfg.straggle_mode == "drop" and cfg.straggle_count > 0
         else None
     )
+    is_main = jax.process_index() == 0
     writer = MetricWriter(cfg.train_dir or None, quiet=quiet)
+    tracer = make_tracer(cfg.trace_dir, is_main)
+    heartbeat = RunHeartbeat(cfg.train_dir or None, enabled=is_main)
     eval_toks = None
     if cfg.eval_freq:
         # held-out stream: step 0 is never trained on
@@ -96,65 +121,110 @@ def run_token_loop(setup, cfg: TrainConfig, steps: Optional[int] = None,
 
     def boundary_eval_ckpt(step, st):
         if eval_toks is not None:
-            eval_loss = float(setup.eval_step(st.params, eval_toks))
+            with tracer.span("eval"):
+                eval_loss = float(setup.eval_step(st.params, eval_toks))
             writer.write({"step": step, "split": "eval", "loss": eval_loss})
+            writer.flush()
         if cfg.train_dir:
-            ckpt_mod.save(cfg.train_dir, step, st, compress=cfg.compress_ckpt)
+            with tracer.span("ckpt"):
+                ckpt_mod.save(cfg.train_dir, step, st,
+                              compress=cfg.compress_ckpt)
 
-    K = max(cfg.steps_per_call, 1)
-    if K > 1 or cfg.token_gen == "device":
-        # the device-generated stream exists only inside the scanned program,
-        # so that mode runs the chunked driver even at K=1
-        state, metrics = _run_chunked(setup, cfg, state, start, last_step,
-                                      adv, straggle, writer,
-                                      boundary_eval_ckpt, tag)
-    else:
-        state, metrics = _run_eager(setup, cfg, state, start, last_step,
-                                    adv, straggle, writer,
-                                    boundary_eval_ckpt)
-    if cfg.train_dir and not cfg.eval_freq:
-        # checkpointing without eval: no cadence boundaries exist, so save
-        # the final state (with eval_freq set the boundary saves stand alone,
-        # preserving the historical on-boundary-only layout)
-        ckpt_mod.save(cfg.train_dir, last_step, state,
-                      compress=cfg.compress_ckpt)
+    obs = _LoopTelemetry(tracer=tracer, heartbeat=heartbeat,
+                         total_end=last_step,
+                         profile_dir=(profile_dir if is_main else None),
+                         profile_steps=profile_steps)
+    try:
+        K = max(cfg.steps_per_call, 1)
+        if K > 1 or cfg.token_gen == "device":
+            # the device-generated stream exists only inside the scanned
+            # program, so that mode runs the chunked driver even at K=1
+            state, metrics = _run_chunked(setup, cfg, state, start, last_step,
+                                          adv, straggle, writer,
+                                          boundary_eval_ckpt, tag, obs)
+        else:
+            state, metrics = _run_eager(setup, cfg, state, start, last_step,
+                                        adv, straggle, writer,
+                                        boundary_eval_ckpt, obs)
+        if cfg.train_dir and not cfg.eval_freq:
+            # checkpointing without eval: no cadence boundaries exist, so save
+            # the final state (with eval_freq set the boundary saves stand
+            # alone, preserving the historical on-boundary-only layout)
+            with tracer.span("ckpt"):
+                ckpt_mod.save(cfg.train_dir, last_step, state,
+                              compress=cfg.compress_ckpt)
+    finally:
+        writer.close()
+        tracer.close()
     return state, metrics
 
 
 def _run_eager(setup, cfg, state, start, last_step, adv, straggle, writer,
-               boundary_eval_ckpt):
+               boundary_eval_ckpt, obs=_LoopTelemetry()):
     """One dispatch per step — the K=1 bitwise reference."""
     from draco_tpu.parallel.sp_step import synthetic_text
 
+    tracer, heartbeat, total_end, profile_dir, profile_steps = obs
     metrics = {}
+    profiling = False
     for step in range(start, last_step + 1):
-        toks = jnp.asarray(
-            synthetic_text(cfg.seed, step, cfg.num_workers, cfg.batch_size,
-                           cfg.seq_len, cfg.vocab)
-        )
-        if straggle is None:
-            state, metrics = setup.train_step(state, toks,
-                                              jnp.asarray(adv[step]))
-        else:
-            state, metrics = setup.train_step(
-                state, toks, jnp.asarray(adv[step]),
-                jnp.asarray(~straggle[step]),
+        if profile_dir and step == profile_steps[0]:
+            jax.profiler.start_trace(profile_dir)
+            profiling = True
+        if profiling and step == profile_steps[1]:
+            # drain the async-dispatch queue before stopping, or the capture
+            # truncates the still-executing profiled steps
+            jax.block_until_ready(state.params)
+            jax.profiler.stop_trace()
+            profiling = False
+        with tracer.span("gather"):
+            toks = jnp.asarray(
+                synthetic_text(cfg.seed, step, cfg.num_workers,
+                               cfg.batch_size, cfg.seq_len, cfg.vocab)
             )
+        with tracer.span("dispatch"):
+            if straggle is None:
+                state, metrics = setup.train_step(state, toks,
+                                                  jnp.asarray(adv[step]))
+            else:
+                state, metrics = setup.train_step(
+                    state, toks, jnp.asarray(adv[step]),
+                    jnp.asarray(~straggle[step]),
+                )
+        # materialize metrics at log boundaries only — the eager loop's
+        # historical device-sync cadence; fetching every step for the
+        # heartbeat would re-serialize the async-dispatch pipeline. The
+        # heartbeat therefore aggregates the LOGGED steps in this regime
+        # (the chunked driver observes every step for free at its flush)
         if step % cfg.log_every == 0:
-            writer.write({"step": step, "loss": float(metrics["loss"])})
-        if cfg.eval_freq and step % cfg.eval_freq == 0:
+            with tracer.span("sync"):
+                record = {"step": step}
+                record.update({k: float(v) for k, v in metrics.items()})
+            heartbeat.observe(record)
+            writer.write(record)
+        boundary = cfg.eval_freq and step % cfg.eval_freq == 0
+        if boundary or step == last_step:
+            with tracer.span("flush"):
+                writer.flush()
+                heartbeat.beat(step, total_end)
+                tracer.flush()
+        if boundary:
             boundary_eval_ckpt(step, state)
+    if profiling:
+        jax.block_until_ready(state.params)
+        jax.profiler.stop_trace()
     return state, metrics
 
 
 def _run_chunked(setup, cfg, state, start, last_step, adv, straggle, writer,
-                 boundary_eval_ckpt, tag="mp"):
+                 boundary_eval_ckpt, tag="mp", obs=_LoopTelemetry()):
     """One dispatch per chunk of up to K steps; metrics deferred to flush
     boundaries; next chunk assembled while the device runs the current one."""
     from draco_tpu.data.prefetch import TokenChunkPrefetcher
     from draco_tpu.parallel.sp_step import synthetic_text
     from draco_tpu.utils.metrics import DeferredMetricWriter
 
+    tracer, heartbeat, total_end, profile_dir, profile_steps = obs
     if setup.train_token_many is None:
         raise ValueError(
             f"{tag} route setup lacks train_token_many — rebuild it with "
@@ -169,38 +239,49 @@ def _run_chunked(setup, cfg, state, start, last_step, adv, straggle, writer,
         prefetch = TokenChunkPrefetcher(
             lambda step: synthetic_text(cfg.seed, step, cfg.num_workers,
                                         cfg.batch_size, cfg.seq_len,
-                                        cfg.vocab)
+                                        cfg.vocab),
+            tracer=tracer,
         )
-    deferred = DeferredMetricWriter(writer)
+    deferred = DeferredMetricWriter(writer, observer=heartbeat.observe)
 
     def should_log(step):
         return step % cfg.log_every == 0
 
     def assemble(i):
         s0, k = ranges[i]
-        if device_gen:
-            # the program regenerates the batches in-graph: upload K scalars
-            toks = np.arange(s0, s0 + k, dtype=np.int32)
-        else:
-            toks = prefetch.get(
-                ranges[i], ranges[i + 1] if i + 1 < len(ranges) else None
+        with tracer.span("gather", chunk_start=s0, k=k):
+            if device_gen:
+                # the program regenerates the batches in-graph: upload K
+                # scalars
+                toks = np.arange(s0, s0 + k, dtype=np.int32)
+            else:
+                toks = prefetch.get(
+                    ranges[i], ranges[i + 1] if i + 1 < len(ranges) else None
+                )
+            # numpy (uncommitted) so jit treats the schedules as replicated
+            masks = np.asarray(adv[s0 : s0 + k])
+            presents = (
+                np.asarray(~straggle[s0 : s0 + k])
+                if straggle is not None
+                else None
             )
-        # numpy (uncommitted) so jit treats the schedules as replicated
-        masks = np.asarray(adv[s0 : s0 + k])
-        presents = (
-            np.asarray(~straggle[s0 : s0 + k])
-            if straggle is not None
-            else None
-        )
         return toks, masks, presents
 
+    profiling = profiled = False
     try:
         chunk = assemble(0)
         for i, (s0, k) in enumerate(ranges):
             end = s0 + k - 1
+            if (profile_dir and not profiling and not profiled
+                    and end >= profile_steps[0]):
+                # chunk-snapped capture, same rule as Trainer._run_chunked:
+                # start at the first chunk reaching profile_steps[0]
+                jax.profiler.start_trace(profile_dir)
+                profiling = True
             toks, masks, presents = chunk
-            state, block = setup.train_token_many(state, toks, masks,
-                                                  presents)
+            with tracer.span("dispatch", chunk_start=s0, k=k):
+                state, block = setup.train_token_many(state, toks, masks,
+                                                      presents)
             deferred.defer(range(s0, end + 1), setup.metric_names, block)
             if i + 1 < len(ranges):  # overlap: assemble i+1 during chunk i
                 chunk = assemble(i + 1)
@@ -211,10 +292,22 @@ def _run_chunked(setup, cfg, state, start, last_step, adv, straggle, writer,
                 # backends, PERF.md §0) and writes the window's records.
                 # No separate sync(): unlike trainer._run_chunked there is
                 # no wall-clock read between barrier and flush here.
-                deferred.flush(should_log)
+                with tracer.span("flush", at_step=end):
+                    deferred.flush(should_log)
+                    heartbeat.beat(end, total_end, extra={
+                        "prefetch_depth": (prefetch.depth
+                                           if prefetch is not None else 0)})
+                    tracer.flush()
+            if profiling and end >= profile_steps[1] - 1:
+                jax.block_until_ready(state.params)
+                jax.profiler.stop_trace()
+                profiling = False
+                profiled = True
             if boundary:
                 boundary_eval_ckpt(end, state)
     finally:
+        if profiling:
+            jax.profiler.stop_trace()
         if prefetch is not None:
             prefetch.close()
     last = deferred.last

@@ -37,8 +37,10 @@ from draco_tpu.parallel.common import (
     TOKEN_METRIC_NAMES,
     aggregate_flat_grads,
     apply_flat_update,
+    decode_health_metrics,
     make_token_train_many,
     masked_loss_metric,
+    token_metric_names,
 )
 from draco_tpu.parallel.mesh import TP_AXIS
 from draco_tpu.parallel.token_loop import run_token_loop  # noqa: F401  (re-export: historical home)
@@ -228,44 +230,49 @@ def _build_gspmd_train_setup(cfg: TrainConfig, mesh, *, mp_axis: str,
             loss, g = jax.value_and_grad(lane_loss)(state.params, toks, True)
             return _flatten_tree(g), loss
 
-        if simulate:
-            toks_w = tokens[batch_ids]  # (n, hat_s, B, T) redundant rows
-            grads, losses = jax.vmap(jax.vmap(lane))(toks_w)  # (n, hat_s, d)
-            grads = jax.lax.with_sharding_constraint(grads, shard_w3)
-            losses = jnp.mean(losses, axis=1)
-        else:
-            grads, losses = jax.vmap(lane)(tokens)  # (n, d), (n,)
-            grads = jax.lax.with_sharding_constraint(grads, shard_w)
+        with jax.named_scope("draco_comp"):
+            if simulate:
+                toks_w = tokens[batch_ids]  # (n, hat_s, B, T) redundant rows
+                grads, losses = jax.vmap(jax.vmap(lane))(toks_w)  # (n, hat_s, d)
+                grads = jax.lax.with_sharding_constraint(grads, shard_w3)
+                losses = jnp.mean(losses, axis=1)
+            else:
+                grads, losses = jax.vmap(lane)(tokens)  # (n, d), (n,)
+                grads = jax.lax.with_sharding_constraint(grads, shard_w)
         # decode projection generated in-graph from the scalar seed — a
         # closed-over (d,) constant serializes into the program (638 MB at
         # d~159M: the remote-compile ceiling, rng.py docstring)
         rand_factor = (drng.random_projection_factors_in_graph(cfg.seed, dim)
                        if code is not None else None)
-        agg = aggregate_flat_grads(grads, adv_mask, cfg, code, rand_factor,
-                                   present=present,
-                                   leaf_offsets=leaf_offsets)
+        agg, health = aggregate_flat_grads(grads, adv_mask, cfg, code,
+                                           rand_factor, present=present,
+                                           leaf_offsets=leaf_offsets)
         new_params, new_opt = apply_flat_update(state, agg, opt, unravel)
         new_params = _constrain_params(new_params, mesh, partition_fn)
         new_state = TrainState(new_params, new_opt, None, state.step + 1)
-        return new_state, {"loss": masked_loss_metric(losses, present)}
+        metrics = {"loss": masked_loss_metric(losses, present)}
+        metrics.update(decode_health_metrics(health, adv_mask, present))
+        return new_state, metrics
 
     def eval_body(params, tokens):
         return jnp.mean(jax.vmap(lambda t: lane_loss(params, t, False))(tokens))
 
     from draco_tpu.parallel.sp_step import token_fn_from_cfg
 
+    metric_names = token_metric_names(cfg)
     with mesh:
         train_step = jax.jit(step_body, donate_argnums=(0,))
         eval_step = jax.jit(eval_body)
         train_token_many = jax.jit(
-            make_token_train_many(step_body, token_fn_from_cfg(cfg)),
+            make_token_train_many(step_body, token_fn_from_cfg(cfg),
+                                  metric_names=metric_names),
             donate_argnums=(0,),
         )
 
     return TPTrainSetup(
         model=model, state=state, train_step=train_step, eval_step=eval_step,
         code=code, unravel=unravel, dim=dim,
-        train_token_many=train_token_many,
+        train_token_many=train_token_many, metric_names=metric_names,
     )
 
 
@@ -353,7 +360,8 @@ def lint_programs():
 
 
 def train_tp(cfg: TrainConfig, mesh, steps: Optional[int] = None,
-             quiet: bool = False):
+             quiet: bool = False, profile_dir: Optional[str] = None):
     """TP training loop; returns (state, last metrics)."""
     return run_token_loop(build_tp_train_setup(cfg, mesh), cfg, steps, quiet,
+                          profile_dir=profile_dir,
                           tag="tp")

@@ -54,6 +54,24 @@ def _metrics(losses, precs, present=None):
             "prec1": jnp.sum(precs * w) / denom}
 
 
+def _detection_metrics(flagged, adv_mask, present):
+    """Per-step detection counts vs the seeded schedules (both of which are
+    step INPUTS, so the comparison runs in-graph — no host traffic): tp =
+    flagged ∧ adversarial ∧ present, adv = adversarial ∧ present. Flush
+    boundaries fold these into precision/recall (obs/heartbeat.py). A
+    straggling adversary's row never arrives — neither detectable nor
+    ground truth, hence the ``present`` gate on both sides."""
+    pres = (jnp.ones_like(adv_mask, dtype=bool) if present is None
+            else present)
+    adv_live = adv_mask & pres
+    flagged = flagged & pres
+    return {
+        "det_flagged": jnp.sum(flagged.astype(jnp.int32)),
+        "det_tp": jnp.sum((flagged & adv_live).astype(jnp.int32)),
+        "det_adv": jnp.sum(adv_live.astype(jnp.int32)),
+    }
+
+
 class TrainState(NamedTuple):
     params: Any  # replicated pytree
     opt_state: Any  # replicated
@@ -169,15 +187,21 @@ def build_train_setup(cfg: TrainConfig, mesh, dataset_name: Optional[str] = None
 
     def lane(p, stats, x, y, dkey):
         """One logical worker/batch lane -> (flat grad, new_stats, loss, prec1)."""
-        (loss, (new_stats, prec1)), g = jax.value_and_grad(lane_loss, has_aux=True)(
-            p, stats, x, y, dkey
-        )
+        # named scope: fwd/bwd ops group under Draco's "comp" phase in XProf
+        # device traces (reference segment names, cyclic_worker.py:154-156)
+        with jax.named_scope("draco_comp"):
+            (loss, (new_stats, prec1)), g = jax.value_and_grad(
+                lane_loss, has_aux=True
+            )(p, stats, x, y, dkey)
         return _flatten_tree(g), new_stats, loss, prec1
 
     def apply_update(state: TrainState, flat_grad, new_stats):
-        grads_tree = unravel(flat_grad)
-        updates, new_opt = opt.update(grads_tree, state.opt_state, state.params)
-        new_params = jax.tree.map(lambda p, u: p + u, state.params, updates)
+        with jax.named_scope("draco_update"):
+            grads_tree = unravel(flat_grad)
+            updates, new_opt = opt.update(grads_tree, state.opt_state,
+                                          state.params)
+            new_params = jax.tree.map(lambda p, u: p + u, state.params,
+                                      updates)
         return TrainState(
             params=new_params,
             opt_state=new_opt,
@@ -208,9 +232,11 @@ def build_train_setup(cfg: TrainConfig, mesh, dataset_name: Optional[str] = None
             grads = jax.lax.with_sharding_constraint(grads, shard_w)
             grads = attacks.inject_plain(grads, adv_mask, cfg.err_mode, adv_mag,
                                          n_mal=cfg.num_adversaries)
-            agg = aggregation.aggregate(grads, cfg.mode, s=cfg.worker_fail,
-                                        geomedian_iters=cfg.geomedian_iters,
-                                        present=present)
+            with jax.named_scope("draco_decode"):
+                agg = aggregation.aggregate(grads, cfg.mode,
+                                            s=cfg.worker_fail,
+                                            geomedian_iters=cfg.geomedian_iters,
+                                            present=present)
             new_state = apply_update(state, agg, new_stats)
             return new_state, _metrics(losses, precs, present)
 
@@ -243,10 +269,20 @@ def build_train_setup(cfg: TrainConfig, mesh, dataset_name: Optional[str] = None
             # cfg.vote_check="exact" is the collision-free option for that
             # threat model (repetition.py module docstring, tier 3).
             vkey = drng.fold(jax.random.key(cfg.seed + 4), state.step)
-            voted = rep_mod.majority_vote(rep_code, grads, present=present,
-                                          key=vkey, method=cfg.vote_check)
+            with jax.named_scope("draco_decode"):
+                voted, vhealth = rep_mod.majority_vote(
+                    rep_code, grads, present=present, key=vkey,
+                    method=cfg.vote_check, with_health=True)
             new_state = apply_update(state, voted, new_stats)
-            return new_state, _metrics(losses, precs, present)
+            out = _metrics(losses, precs, present)
+            # vote health (telemetry columns; coding/repetition.py):
+            # agreement fraction + flagged groups, and the per-row flag set
+            # scored against the seeded schedules — all in-graph
+            out["vote_agree"] = vhealth["vote_agree"]
+            out["flagged_groups"] = vhealth["flagged_groups"]
+            out.update(_detection_metrics(vhealth["flagged"], adv_mask,
+                                          present))
+            return new_state, out
 
     elif cfg.approach == "cyclic":
         code = cyclic_mod.build_cyclic_code(n, cfg.worker_fail)
@@ -278,7 +314,8 @@ def build_train_setup(cfg: TrainConfig, mesh, dataset_name: Optional[str] = None
                     lane, in_axes=(None, 0, 0, 0, 0)
                 )(state.params, state.batch_stats, x, y, dkeys)
                 grads = jax.lax.with_sharding_constraint(grads, shard_w)
-                enc_re, enc_im = cyclic_mod.encode_shared(code, grads)
+                with jax.named_scope("draco_encode"):
+                    enc_re, enc_im = cyclic_mod.encode_shared(code, grads)
                 return enc_re, enc_im, new_stats, losses, precs
 
         else:  # "simulate": the reference's true r× redundant compute
@@ -308,7 +345,8 @@ def build_train_setup(cfg: TrainConfig, mesh, dataset_name: Optional[str] = None
                 grads = jax.lax.with_sharding_constraint(
                     grads, NamedSharding(mesh, P(WORKER_AXIS, None, None))
                 )
-                enc_re, enc_im = cyclic_mod.encode(code, grads)
+                with jax.named_scope("draco_encode"):
+                    enc_re, enc_im = cyclic_mod.encode(code, grads)
                 # fold the per-sub-batch stats back to one per worker
                 new_stats = (
                     jax.tree.map(lambda t: jnp.mean(t, axis=1), new_stats)
@@ -319,35 +357,47 @@ def build_train_setup(cfg: TrainConfig, mesh, dataset_name: Optional[str] = None
 
         def step_body(state: TrainState, x, y, adv_mask, present=None):
             enc_re, enc_im, new_stats, losses, precs = compute_encoded(state, x, y)
-            enc_re, enc_im = attacks.inject_cyclic(enc_re, enc_im, adv_mask,
-                                                   cfg.err_mode, adv_mag)
-            if present is not None:
-                # straggler rows never arrive: zero-fill (erasures at known
-                # positions; decode recovers exactly within the budget —
-                # config.validate)
-                pw = present[:, None].astype(enc_re.dtype)
-                enc_re = enc_re * pw
-                enc_im = enc_im * pw
-            enc_re = jax.lax.with_sharding_constraint(enc_re, shard_w)
-            enc_im = jax.lax.with_sharding_constraint(enc_im, shard_w)
+            with jax.named_scope("draco_encode"):
+                enc_re, enc_im = attacks.inject_cyclic(enc_re, enc_im, adv_mask,
+                                                       cfg.err_mode, adv_mag)
+                if present is not None:
+                    # straggler rows never arrive: zero-fill (erasures at known
+                    # positions; decode recovers exactly within the budget —
+                    # config.validate)
+                    pw = present[:, None].astype(enc_re.dtype)
+                    enc_re = enc_re * pw
+                    enc_im = enc_im * pw
+                enc_re = jax.lax.with_sharding_constraint(enc_re, shard_w)
+                enc_im = jax.lax.with_sharding_constraint(enc_im, shard_w)
             # in-graph decode projection — no d-length program constant
             # (rng.random_projection_factors_in_graph docstring)
             rand_factor = drng.random_projection_factors_in_graph(cfg.seed,
                                                                   dim)
-            if cfg.decode_granularity == "layer":
-                # per-parameter-tensor locator + projection, like the
-                # reference's per-layer decode loop (cyclic_master.py:125-129)
-                decoded, honest_l = cyclic_mod.decode_layers(
-                    code, enc_re, enc_im, rand_factor, leaf_offsets,
-                    present=present,
-                )
-                honest = jnp.all(honest_l, axis=0)
-            else:
-                decoded, honest = cyclic_mod.decode(code, enc_re, enc_im,
-                                                    rand_factor, present=present)
+            with jax.named_scope("draco_decode"):
+                if cfg.decode_granularity == "layer":
+                    # per-parameter-tensor locator + projection, like the
+                    # reference's per-layer decode loop (cyclic_master.py:125-129)
+                    decoded, honest_l, health = cyclic_mod.decode_layers(
+                        code, enc_re, enc_im, rand_factor, leaf_offsets,
+                        present=present, with_health=True,
+                    )
+                    honest = jnp.all(honest_l, axis=0)
+                else:
+                    decoded, honest, health = cyclic_mod.decode(
+                        code, enc_re, enc_im, rand_factor, present=present,
+                        with_health=True)
             new_state = apply_update(state, decoded, new_stats)
             out = _metrics(losses, precs, present)
             out["honest_located"] = jnp.sum(honest.astype(jnp.int32))
+            # decode health (telemetry columns; coding/cyclic._locate_v
+            # docstring): residual ≈ 0 is the paper's exactness guarantee
+            # made observable, the flag set scores against the seeded
+            # schedules — all in-graph, no host traffic. One schema with
+            # the LM routes (common.decode_health_metrics; imported lazily,
+            # parallel/__init__ imports this module)
+            from draco_tpu.parallel.common import decode_health_metrics
+
+            out.update(decode_health_metrics(health, adv_mask, present))
             return new_state, out
 
     else:  # pragma: no cover
@@ -381,9 +431,18 @@ def build_train_setup(cfg: TrainConfig, mesh, dataset_name: Optional[str] = None
     # fetches once per chunk. The chunk length K is the operands' leading
     # dim, so one program per distinct chunk size (the trainer's main K and
     # its remainder chunks), not per call.
-    metric_names = ("loss", "prec1") + (
-        ("honest_located",) if cfg.approach == "cyclic" else ()
-    )
+    # decode-health telemetry columns ride the same block (ISSUE 4): the
+    # per-step values are in-graph scalars, so the chunked regime ships
+    # them for free in the one existing per-flush fetch. The cyclic column
+    # set is the LM routes' (one schema source: common.DECODE_HEALTH_NAMES)
+    from draco_tpu.parallel.common import DECODE_HEALTH_NAMES
+
+    metric_names = ("loss", "prec1")
+    if cfg.approach == "cyclic":
+        metric_names += ("honest_located",) + DECODE_HEALTH_NAMES
+    elif cfg.approach == "maj_vote":
+        metric_names += ("vote_agree", "flagged_groups", "det_flagged",
+                         "det_tp", "det_adv")
 
     def many_body(state: TrainState, xs, ys, masks, presents):
         def body(st, operand):

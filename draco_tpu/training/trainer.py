@@ -37,6 +37,7 @@ from draco_tpu.config import TrainConfig
 from draco_tpu.data import batching
 from draco_tpu.data.datasets import Dataset, load_dataset
 from draco_tpu.data.prefetch import BatchPrefetcher, ChunkPrefetcher
+from draco_tpu.obs import RunHeartbeat, make_tracer
 from draco_tpu.runtime import WORKER_AXIS, make_mesh, put_global
 from draco_tpu.training.step import build_train_setup
 from draco_tpu.utils import checkpoint as ckpt
@@ -56,6 +57,13 @@ class Trainer:
         self._is_main = jax.process_index() == 0
         self.writer = MetricWriter(cfg.train_dir if self._is_main else None,
                                    quiet=quiet or not self._is_main)
+        # telemetry (draco_tpu/obs): host span trace when cfg.trace_dir is
+        # set, status.json heartbeat whenever there is a train_dir — both
+        # no-ops off the metrics-emitting process, and the tracer is the
+        # allocation-free NULL_TRACER when disabled
+        self.tracer = make_tracer(cfg.trace_dir, self._is_main)
+        self.heartbeat = RunHeartbeat(cfg.train_dir or None,
+                                      enabled=self._is_main)
         self._shard_w = NamedSharding(self.mesh, P(WORKER_AXIS))
         self._adv_schedule = drng.adversary_schedule(
             cfg.seed, cfg.max_steps, cfg.num_workers, cfg.num_adversaries
@@ -96,7 +104,7 @@ class Trainer:
         if self._prefetch is None:
             self._prefetch = BatchPrefetcher(
                 self.ds, self._batch_indices, self.cfg.num_workers,
-                self.cfg.batch_size
+                self.cfg.batch_size, tracer=self.tracer
             )
         return self._prefetch.get(step)
 
@@ -156,17 +164,19 @@ class Trainer:
         """Assemble + upload one stacked chunk; submits next_range's host
         gather to the native pool before returning (double buffering)."""
         start, k = rng
-        x, y = self._chunk_prefetch.get(rng, next_range)
-        shard = NamedSharding(self.mesh, P(None, WORKER_AXIS))
-        xs = put_global(np.asarray(x), shard)
-        ys = put_global(np.asarray(y), shard)
-        # numpy (uncommitted) so multi-host jit treats them as replicated
-        masks = np.asarray(self._adv_schedule[start : start + k])
-        presents = (
-            np.asarray(~self._straggle_schedule[start : start + k])
-            if self._straggle_schedule is not None
-            else None
-        )
+        with self.tracer.span("gather", chunk_start=start, k=k):
+            x, y = self._chunk_prefetch.get(rng, next_range)
+        with self.tracer.span("upload", chunk_start=start, k=k):
+            shard = NamedSharding(self.mesh, P(None, WORKER_AXIS))
+            xs = put_global(np.asarray(x), shard)
+            ys = put_global(np.asarray(y), shard)
+            # numpy (uncommitted) so multi-host jit treats them as replicated
+            masks = np.asarray(self._adv_schedule[start : start + k])
+            presents = (
+                np.asarray(~self._straggle_schedule[start : start + k])
+                if self._straggle_schedule is not None
+                else None
+            )
         return xs, ys, masks, presents
 
     # ---- train -----------------------------------------------------------
@@ -205,37 +215,52 @@ class Trainer:
                 profiling = False
             seg = Segments()
             seg.begin("fetch")
-            x, y = self._device_batch(step)
-            # numpy (uncommitted) so multi-host jit treats it as replicated
-            mask = np.asarray(self._adv_schedule[step])
-            present = (
-                np.asarray(~self._straggle_schedule[step])
-                if self._straggle_schedule is not None
-                else None
-            )
+            with self.tracer.span("gather+upload", step=step):
+                x, y = self._device_batch(step)
+                # numpy (uncommitted) so multi-host jit treats it as
+                # replicated
+                mask = np.asarray(self._adv_schedule[step])
+                present = (
+                    np.asarray(~self._straggle_schedule[step])
+                    if self._straggle_schedule is not None
+                    else None
+                )
             seg.end()
 
             seg.begin("comp")  # fwd+bwd+encode+gather+decode+update, one program
-            if present is None:
-                self.state, metrics = self.setup.train_step(self.state, x, y, mask)
-            else:
-                self.state, metrics = self.setup.train_step(self.state, x, y, mask,
-                                                            present)
-            metrics = {k: float(v) for k, v in metrics.items()}
-            if present is not None:
-                metrics["present"] = float(present.sum())
-            jax.block_until_ready(self.state.params)
+            with self.tracer.span("dispatch", step=step):
+                if present is None:
+                    self.state, metrics = self.setup.train_step(self.state, x,
+                                                                y, mask)
+                else:
+                    self.state, metrics = self.setup.train_step(self.state, x,
+                                                                y, mask,
+                                                                present)
+            with self.tracer.span("sync", step=step):
+                metrics = {k: float(v) for k, v in metrics.items()}
+                if present is not None:
+                    metrics["present"] = float(present.sum())
+                jax.block_until_ready(self.state.params)
             seg.end()
 
             record = {"step": step, **metrics, **seg.as_dict()}
             last = record
+            self.heartbeat.observe(record)
             if step % cfg.log_every == 0 or step == 1:
                 self.writer.write(record)
-            if cfg.eval_freq and step % cfg.eval_freq == 0:
+            boundary = cfg.eval_freq and step % cfg.eval_freq == 0
+            if boundary or step == n_steps:
+                with self.tracer.span("flush", at_step=step):
+                    self.writer.flush()
+                    self.heartbeat.beat(step, n_steps,
+                                        extra=self._prefetch_depth())
+                    self.tracer.flush()
+            if boundary:
                 self.evaluate(step)
                 if cfg.train_dir:
-                    ckpt.save(cfg.train_dir, step, self.state,
-                              compress=cfg.compress_ckpt)
+                    with self.tracer.span("ckpt", at_step=step):
+                        ckpt.save(cfg.train_dir, step, self.state,
+                                  compress=cfg.compress_ckpt)
         if profiling:  # loop ended before profile_steps[1]
             jax.profiler.stop_trace()
         return last
@@ -252,9 +277,11 @@ class Trainer:
             return {}
         if self._chunk_prefetch is None:
             self._chunk_prefetch = ChunkPrefetcher(
-                self.ds, self._chunk_indices, cfg.num_workers, cfg.batch_size
+                self.ds, self._chunk_indices, cfg.num_workers, cfg.batch_size,
+                tracer=self.tracer
             )
-        deferred = DeferredMetricWriter(self.writer)
+        deferred = DeferredMetricWriter(self.writer,
+                                        observer=self.heartbeat.observe)
 
         def should_log(step):
             return step % cfg.log_every == 0 or step == 1
@@ -285,8 +312,9 @@ class Trainer:
                 jax.profiler.start_trace(profile_dir)
                 profiling = True
             xs, ys, masks, presents = chunk
-            self.state, block = setup.train_many(self.state, xs, ys, masks,
-                                                 presents)
+            with self.tracer.span("dispatch", chunk_start=start, k=k):
+                self.state, block = setup.train_many(self.state, xs, ys,
+                                                     masks, presents)
             extras = {"t_fetch": round(fetch_s / k, 6)}
             if presents is not None:
                 extras["present"] = presents.sum(axis=1)
@@ -304,11 +332,17 @@ class Trainer:
                 # A device→host fetch, NOT block_until_ready: the latter is
                 # only a dispatch barrier on remote-dispatch backends
                 # (utils/timing.py, PERF.md §0)
-                deferred.sync()
+                with self.tracer.span("sync", at_step=end):
+                    deferred.sync()
                 t_comp = max(time.perf_counter() - window_t0 - window_fetch,
                              0.0)
-                deferred.flush(should_log,
-                               {"t_comp": round(t_comp / window_steps, 6)})
+                with self.tracer.span("flush", at_step=end):
+                    deferred.flush(should_log,
+                                   {"t_comp": round(t_comp / window_steps,
+                                                    6)})
+                    self.heartbeat.beat(end, n_steps,
+                                        extra=self._prefetch_depth())
+                    self.tracer.flush()
                 window_t0 = time.perf_counter()
                 window_fetch = 0.0
                 window_steps = 0
@@ -320,8 +354,9 @@ class Trainer:
             if boundary:
                 self.evaluate(end)
                 if cfg.train_dir:
-                    ckpt.save(cfg.train_dir, end, self.state,
-                              compress=cfg.compress_ckpt)
+                    with self.tracer.span("ckpt", at_step=end):
+                        ckpt.save(cfg.train_dir, end, self.state,
+                                  compress=cfg.compress_ckpt)
                 # eval/checkpoint wall must not leak into the next window's
                 # t_comp (the eager loop's Segments exclude them too)
                 window_t0 = time.perf_counter()
@@ -329,6 +364,13 @@ class Trainer:
             jax.block_until_ready(self.state.params)
             jax.profiler.stop_trace()
         return deferred.last
+
+    def _prefetch_depth(self) -> dict:
+        """Heartbeat extra: in-flight prefetch requests of whichever
+        prefetcher the active regime runs."""
+        p = self._chunk_prefetch if self._chunk_prefetch is not None \
+            else self._prefetch
+        return {"prefetch_depth": p.depth if p is not None else 0}
 
     # ---- eval ------------------------------------------------------------
     def evaluate(self, step: int, batch_size: Optional[int] = None) -> dict:
@@ -338,13 +380,19 @@ class Trainer:
         evaluator.masked_full_split_eval)."""
         from draco_tpu.training.evaluator import masked_full_split_eval
 
-        p1, p5 = masked_full_split_eval(
-            lambda x, y, valid: self.setup.eval_step(self.state, x, y, valid),
-            self.ds.test_x, self.ds.test_y,
-            batch_size or self.cfg.test_batch_size,
-        )
+        with self.tracer.span("eval", at_step=step):
+            p1, p5 = masked_full_split_eval(
+                lambda x, y, valid: self.setup.eval_step(self.state, x, y,
+                                                         valid),
+                self.ds.test_x, self.ds.test_y,
+                batch_size or self.cfg.test_batch_size,
+            )
         rec = {"step": step, "prec1_test": p1, "prec5_test": p5}
         self.writer.write(rec)
+        # eval cadence is rare and follows the loops' boundary flush, so
+        # drain immediately — callers that never close() (perf tools) still
+        # get a complete metrics.jsonl
+        self.writer.flush()
         return rec
 
     def close(self):
@@ -353,6 +401,7 @@ class Trainer:
         if self._chunk_prefetch is not None:
             self._chunk_prefetch.close()
         self.writer.close()
+        self.tracer.close()
 
     # ---- checkpoint ------------------------------------------------------
     def restore(self, step: int):

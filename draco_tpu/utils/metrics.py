@@ -16,11 +16,25 @@ import numpy as np
 
 
 class MetricWriter:
-    """JSONL metrics to ``train_dir/metrics.jsonl`` + human lines to stdout."""
+    """JSONL metrics to ``train_dir/metrics.jsonl`` + human lines to stdout.
 
-    def __init__(self, train_dir: Optional[str], quiet: bool = False):
+    Records are BUFFERED: ``write`` appends to an in-memory list and the
+    file is touched only at :meth:`flush` (called by the loops at their
+    flush/eval/checkpoint boundaries and by the DeferredMetricWriter), when
+    ``buffer_records`` lines have accumulated, or on :meth:`close` — one
+    write+fsync-sized syscall burst per boundary instead of one per record,
+    matching the chunked loops' host-dark steady state. ``close()`` always
+    drains the buffer, so the tail of an interrupted-but-closed run is
+    never lost; ``buffer_records=1`` restores per-record flushing for
+    callers that tail the file live.
+    """
+
+    def __init__(self, train_dir: Optional[str], quiet: bool = False,
+                 buffer_records: int = 64):
         self._fh = None
         self._quiet = quiet
+        self._buf: list = []
+        self._buffer_records = max(int(buffer_records), 1)
         if train_dir:
             os.makedirs(train_dir, exist_ok=True)
             self._fh = open(os.path.join(train_dir, "metrics.jsonl"), "a")
@@ -28,8 +42,9 @@ class MetricWriter:
     def write(self, record: dict):
         record = dict(record, time=time.time())
         if self._fh:
-            self._fh.write(json.dumps(record) + "\n")
-            self._fh.flush()
+            self._buf.append(json.dumps(record))
+            if len(self._buf) >= self._buffer_records:
+                self.flush()
         if not self._quiet:
             step = record.get("step", "?")
             body = ", ".join(
@@ -39,9 +54,18 @@ class MetricWriter:
             )
             print(f"Step: {step}, {body}", file=sys.stdout, flush=True)
 
+    def flush(self):
+        """Drain the buffer to disk (loops call this at flush boundaries)."""
+        if self._fh and self._buf:
+            self._fh.write("\n".join(self._buf) + "\n")
+            self._fh.flush()
+            self._buf = []
+
     def close(self):
         if self._fh:
+            self.flush()
             self._fh.close()
+            self._fh = None
 
 
 class DeferredMetricWriter:
@@ -55,10 +79,16 @@ class DeferredMetricWriter:
     schema and the reference segment names are unchanged; only WHEN the
     device→host fetch happens moves, which is the whole point: in steady
     state the host never blocks on the device between chunks.
+
+    ``observer`` (optional callable) sees EVERY materialized record at
+    flush time, logged or not — the run heartbeat (obs/heartbeat.py) hooks
+    here to accumulate decode-health precision/recall without adding any
+    device fetch beyond the flush's own block materialization.
     """
 
-    def __init__(self, writer: MetricWriter):
+    def __init__(self, writer: MetricWriter, observer=None):
         self._writer = writer
+        self._observer = observer
         # (steps, names, device block, per-chunk extras)
         self._pending: list = []
         self.last: dict = {}  # most recent materialized record (any step)
@@ -100,14 +130,26 @@ class DeferredMetricWriter:
                 if common:
                     rec.update(common)
                 self.last = rec
+                if self._observer is not None:
+                    self._observer(rec)
                 if should_log is None or should_log(step):
                     self._writer.write(rec)
         self._pending = []
+        # a flush boundary is THE durability point of the chunked regime:
+        # drain the wrapped writer's record buffer with it
+        self._writer.flush()
         return self.last
 
 
 class Segments:
-    """Wall-clock segment timer with the reference's phase names."""
+    """Wall-clock segment timer with the reference's phase names.
+
+    Durations come from ``time.perf_counter`` — monotonic, so an NTP slew
+    or DST step mid-segment cannot produce negative or wildly wrong
+    t_fetch/t_comp values the way the old ``time.time()`` deltas could.
+    The record-level ``time`` field (MetricWriter.write) deliberately stays
+    wall-clock: it timestamps the record for humans; only durations need
+    monotonicity."""
 
     def __init__(self):
         self.t = {}
@@ -115,11 +157,12 @@ class Segments:
         self._name = None
 
     def begin(self, name: str):
-        self._name, self._start = name, time.time()
+        self._name, self._start = name, time.perf_counter()
 
     def end(self):
         if self._name is not None:
-            self.t[self._name] = self.t.get(self._name, 0.0) + time.time() - self._start
+            self.t[self._name] = (self.t.get(self._name, 0.0)
+                                  + time.perf_counter() - self._start)
             self._name = None
 
     def as_dict(self, prefix: str = "t_"):

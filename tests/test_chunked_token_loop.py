@@ -85,13 +85,15 @@ def test_chunked_equals_eager_bitwise(route, tmp_path):
     """Same final params AND same metrics stream (train records at
     log_every=1 + eval records at eval_freq=3) for K=1 (eager loop) vs K=4
     (scan-chunked with remainder chunks, since the eval boundary snaps
-    chunks to 3 and 7 % 3 != 0)."""
+    chunks to 3 and 7 % 3 != 0) — run with the telemetry spine enabled
+    (trace_dir + heartbeat, ISSUE 4), which must not perturb either
+    regime."""
     r = ROUTES[route]
     out = {}
     for k in (1, 4):
         d = str(tmp_path / f"{route}_k{k}")
         cfg = make_cfg(**r["kw"], steps_per_call=k, train_dir=d,
-                       eval_freq=3, log_every=1)
+                       trace_dir=d, eval_freq=3, log_every=1)
         state, metrics = r["train"](cfg)
         out[k] = (params_vec(state), metric_stream(d), float(metrics["loss"]))
     np.testing.assert_array_equal(out[1][0], out[4][0])
@@ -100,6 +102,53 @@ def test_chunked_equals_eager_bitwise(route, tmp_path):
         range(1, 8))
     assert [s for s, split, _ in out[4][1] if split == "eval"] == [3, 6]
     assert out[1][2] == out[4][2]
+    _assert_route_telemetry(route, r["kw"], tmp_path / f"{route}_k4")
+
+
+def _assert_route_telemetry(route, kw, run_dir):
+    """LM telemetry on the K=4 run: the cyclic route's decode-health
+    columns report detection precision/recall 1.0 vs the seeded schedules
+    in every train record and in status.json; trace.json carries the host
+    phases plus the token prefetcher's own labeled worker-thread lane."""
+    from draco_tpu import rng as drng
+
+    recs = [json.loads(l)
+            for l in open(os.path.join(run_dir, "metrics.jsonl"))]
+    train = [r for r in recs if r.get("split") != "eval" and "loss" in r]
+    if kw.get("approach") == "cyclic":
+        n = kw["num_workers"]
+        adv = drng.adversary_schedule(428, 8, n, kw["adversary_count"])
+        strag = drng.straggler_schedule(428, 8, n, kw["straggle_count"])
+        for r in train:
+            want = int((adv[r["step"]] & ~strag[r["step"]]).sum())
+            assert r["det_adv"] == want
+            assert r["det_tp"] == want  # recall = 1.0
+            assert r["located_errors"] == want  # precision = 1.0
+            assert r["decode_residual"] < 1e-3
+        health = json.load(open(os.path.join(run_dir,
+                                             "status.json")))["decode_health"]
+        assert health["precision"] == 1.0 and health["recall"] == 1.0
+        assert health["adv_total"] > 0
+    else:
+        assert all("det_tp" not in r for r in train)
+    trace = json.load(open(os.path.join(run_dir, "trace.json")))
+    events = trace["traceEvents"]
+    spans = [e for e in events if e["ph"] == "X"]
+    names = {e["name"] for e in spans}
+    assert {"gather", "dispatch", "flush", "prefetch.assemble"} <= names
+    lanes = {e["tid"]: e["args"]["name"] for e in events
+             if e["ph"] == "M" and e["name"] == "thread_name"}
+    assembles = {e["tid"] for e in spans if e["name"] == "prefetch.assemble"}
+    dispatches = {e["tid"] for e in spans if e["name"] == "dispatch"}
+    # the worker thread has its own labeled lane, distinct from the main
+    # loop's dispatch lane (cold-start assembly runs on main; steady-state
+    # chunks on the worker)
+    worker_tids = {t for t in assembles
+                   if lanes.get(t) == "token-chunk-prefetch"}
+    assert worker_tids, lanes  # the worker thread got its own labeled lane
+    assert not (worker_tids & dispatches)  # ...distinct from the main loop's
+    assert any(e["ph"] == "C" and e["name"] == "prefetch_depth"
+               for e in events)
 
 
 def test_device_token_gen_bitwise_and_distinct():

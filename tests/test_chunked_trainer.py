@@ -98,13 +98,16 @@ APPROACHES = {
 @pytest.mark.parametrize("approach", sorted(APPROACHES))
 def test_chunked_equals_eager_bitwise(ds, approach, tmp_path):
     """Same final params AND same metrics stream for K=1 (eager loop) vs
-    K=4 (scan-chunked, with a remainder chunk since 6 % 4 != 0)."""
+    K=4 (scan-chunked, with a remainder chunk since 6 % 4 != 0) — run with
+    the full telemetry spine enabled (trace_dir + heartbeat, ISSUE 4),
+    which must not perturb either regime."""
     kw = APPROACHES[approach]
     mesh = make_mesh(kw.get("num_workers", 8))
     out = {}
     for k in (1, 4):
         d = str(tmp_path / f"{approach}_k{k}")
-        tr = Trainer(make_cfg(**kw, steps_per_call=k, train_dir=d),
+        tr = Trainer(make_cfg(**kw, steps_per_call=k, train_dir=d,
+                              trace_dir=d),
                      mesh=mesh, dataset=ds, quiet=True)
         last = tr.run()
         out[k] = (params_vec(tr), metric_stream(d), last)
@@ -115,6 +118,70 @@ def test_chunked_equals_eager_bitwise(ds, approach, tmp_path):
     # the returned last-record agrees on the training metrics too
     for key in ("loss", "prec1", "present"):
         assert out[1][2][key] == out[4][2][key]
+    _assert_decode_health(approach, out[4][1], kw)
+    _assert_telemetry_artifacts(tmp_path / f"{approach}_k4", approach)
+
+
+def _assert_decode_health(approach, stream, kw):
+    """Decode-health columns (in-graph, ISSUE 4) on every train record:
+    detection precision AND recall are 1.0 against the seeded adversary +
+    straggler schedules — flagged set == live adversary set, step by step —
+    and the cyclic residual sits at float noise (the exactness guarantee
+    observable). The baseline approach has no exactness certificate and
+    must emit no health columns."""
+    n = kw.get("num_workers", 8)
+    adv = drng.adversary_schedule(428, 6, n, kw.get("adversary_count",
+                                                    kw["worker_fail"]))
+    strag = drng.straggler_schedule(428, 6, n, kw["straggle_count"])
+    flag_col = {"cyclic": "located_errors", "maj_vote": "det_flagged"}
+    for step, vals in stream:
+        if approach == "baseline":
+            assert "det_tp" not in vals and "decode_residual" not in vals
+            continue
+        want = int((adv[step] & ~strag[step]).sum())  # detectable truth
+        assert vals["det_adv"] == want, (step, vals)
+        assert vals["det_tp"] == want  # recall = 1.0
+        assert vals[flag_col[approach]] == want  # precision = 1.0
+        if approach == "cyclic":
+            assert vals["decode_residual"] < 1e-3
+        else:
+            pres = int((~strag[step]).sum())
+            assert vals["vote_agree"] == pytest.approx((pres - want) / pres)
+            assert vals["flagged_groups"] == (1 if want else 0)
+
+
+def _assert_telemetry_artifacts(run_dir, approach):
+    """The K=4 run is a 2-chunk CPU-mesh run (ranges (1,4),(5,2)): its
+    trace.json must parse as Chrome trace events with the host phases,
+    nested prefetcher spans and counter events, and status.json must report
+    detection precision/recall 1.0 (cyclic/maj_vote)."""
+    trace = json.load(open(run_dir / "trace.json"))
+    events = trace["traceEvents"]
+    spans = [e for e in events if e["ph"] == "X"]
+    names = {e["name"] for e in spans}
+    assert {"gather", "upload", "dispatch", "sync", "flush"} <= names
+    assert len([e for e in spans if e["name"] == "dispatch"]) == 2  # 2 chunks
+    for e in spans:
+        assert {"ts", "dur", "pid", "tid"} <= set(e) and e["dur"] >= 0
+    # prefetch spans nest inside the trainer's gather span (same thread)
+    gathers = [e for e in spans if e["name"] == "gather"]
+    inner = [e for e in spans if e["name"].startswith("prefetch.")]
+    assert inner, names
+    assert any(
+        g["tid"] == i["tid"] and g["ts"] <= i["ts"]
+        and i["ts"] + i["dur"] <= g["ts"] + g["dur"] + 1e-3
+        for i in inner for g in gathers)
+    assert any(e["ph"] == "C" for e in events)  # queue-depth counters
+    status = json.load(open(run_dir / "status.json"))
+    assert status["step"] == 6 and status["steps_per_s"] > 0
+    assert np.isfinite(status["loss"])
+    assert status["prefetch_depth"] in (0, 1)
+    if approach == "baseline":
+        assert "decode_health" not in status
+    else:
+        health = status["decode_health"]
+        assert health["precision"] == 1.0 and health["recall"] == 1.0
+        assert health["adv_total"] > 0  # the adversary was really live
 
 
 @pytest.mark.core

@@ -1,0 +1,363 @@
+"""Telemetry spine (draco_tpu/obs + in-graph decode health, ISSUE 4).
+
+Unit layer: the span tracer emits valid Chrome trace events and is a strict
+no-op when disabled; the heartbeat folds per-step detection counts into
+precision/recall and rewrites status.json atomically; MetricWriter buffers
+to flush/close boundaries; Segments times with a monotonic clock; the
+decode/vote health values are correct (and raise the fault signal beyond
+the locator budget) straight off the coding primitives; trace_report folds
+the artifacts. The integration layer — health columns flowing through both
+production loops, eager == chunked bitwise with telemetry enabled,
+trace.json/status.json from real runs — rides the existing K ∈ {1, 4}
+equivalence suites (tests/test_chunked_trainer.py,
+tests/test_chunked_token_loop.py) so it costs no extra training runs.
+"""
+
+import json
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from draco_tpu.obs import NULL_TRACER, RunHeartbeat, SpanTracer
+from draco_tpu.obs.tracer import NullTracer
+
+
+# --------------------------------------------------------------------------
+# SpanTracer
+# --------------------------------------------------------------------------
+
+@pytest.mark.core
+def test_tracer_emits_valid_chrome_trace(tmp_path):
+    """Nested spans, a worker-thread lane, counters, metadata — and the
+    file parses as the Chrome trace event format Perfetto loads."""
+    path = str(tmp_path / "trace.json")
+    tr = SpanTracer(path)
+    with tr.span("outer", step=1):
+        with tr.span("inner"):
+            pass
+        tr.counter("queue_depth", 1)
+    tr.instant("marker")
+
+    def worker():
+        tr.name_thread("worker-lane")
+        with tr.span("worker-span"):
+            pass
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join()
+    tr.close()
+
+    payload = json.load(open(path))
+    events = payload["traceEvents"]
+    spans = {e["name"]: e for e in events if e["ph"] == "X"}
+    assert set(spans) == {"outer", "inner", "worker-span"}
+    for e in spans.values():  # required Chrome-trace fields, µs numbers
+        assert {"ts", "dur", "pid", "tid"} <= set(e)
+        assert e["dur"] >= 0
+    # nesting is wall-clock containment on the same tid
+    outer, inner = spans["outer"], spans["inner"]
+    assert outer["tid"] == inner["tid"]
+    assert outer["ts"] <= inner["ts"]
+    assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"] + 1e-3
+    assert outer["args"] == {"step": 1}
+    # the worker thread got its own labeled lane
+    assert spans["worker-span"]["tid"] != outer["tid"]
+    lanes = {e["tid"]: e["args"]["name"] for e in events
+             if e["ph"] == "M" and e["name"] == "thread_name"}
+    assert lanes[spans["worker-span"]["tid"]] == "worker-lane"
+    assert lanes[outer["tid"]] == "main"
+    counters = [e for e in events if e["ph"] == "C"]
+    assert counters and counters[0]["args"] == {"queue_depth": 1}
+    assert any(e["ph"] == "i" for e in events)
+
+
+@pytest.mark.core
+def test_disabled_tracer_is_a_strict_noop(tmp_path):
+    """The disabled path allocates nothing and touches no file: span()
+    returns the one shared context-manager object, every method is inert,
+    and a loop run with NULL_TRACER leaves no artifact."""
+    assert isinstance(NULL_TRACER, NullTracer)
+    assert NULL_TRACER.enabled is False
+    cm1 = NULL_TRACER.span("a", k=1)
+    cm2 = NULL_TRACER.span("b")
+    assert cm1 is cm2  # no per-span allocation
+    with cm1:
+        NULL_TRACER.counter("c", 1)
+        NULL_TRACER.instant("i")
+        NULL_TRACER.name_thread("t")
+    NULL_TRACER.flush()
+    NULL_TRACER.close()
+    assert list(tmp_path.iterdir()) == []
+    # construction rule: no trace_dir (or a non-main process) -> the
+    # singleton, never a new object
+    from draco_tpu.obs import make_tracer
+    assert make_tracer("", True) is NULL_TRACER
+    assert make_tracer(str(tmp_path), False) is NULL_TRACER
+
+
+def test_tracer_flush_is_atomic_and_incremental(tmp_path):
+    path = str(tmp_path / "trace.json")
+    tr = SpanTracer(path)
+    with tr.span("first"):
+        pass
+    tr.flush()
+    mid = json.load(open(path))
+    assert {e["name"] for e in mid["traceEvents"] if e["ph"] == "X"} == \
+        {"first"}
+    with tr.span("second"):
+        pass
+    tr.close()
+    final = json.load(open(path))
+    assert {e["name"] for e in final["traceEvents"] if e["ph"] == "X"} == \
+        {"first", "second"}
+    assert not (tmp_path / "trace.json.tmp").exists()
+
+
+# --------------------------------------------------------------------------
+# RunHeartbeat
+# --------------------------------------------------------------------------
+
+@pytest.mark.core
+def test_heartbeat_precision_recall_and_payload(tmp_path):
+    hb = RunHeartbeat(str(tmp_path))
+    for step in range(1, 5):
+        hb.observe({"step": step, "loss": 2.0 - 0.1 * step, "prec1": 0.5,
+                    "decode_residual": 1e-7, "located_errors": 1.0,
+                    "det_tp": 1.0, "det_adv": 1.0})
+    payload = hb.beat(4, total_steps=8, extra={"prefetch_depth": 1})
+    on_disk = json.load(open(tmp_path / "status.json"))
+    assert on_disk == payload
+    assert payload["step"] == 4 and payload["total_steps"] == 8
+    assert payload["steps_per_s"] > 0 and payload["eta_s"] >= 0
+    assert payload["loss"] == pytest.approx(1.6)
+    assert payload["prefetch_depth"] == 1
+    h = payload["decode_health"]
+    assert h["precision"] == 1.0 and h["recall"] == 1.0
+    assert h["flagged_total"] == 4.0 and h["adv_total"] == 4.0
+    assert h["decode_residual"] == pytest.approx(1e-7)
+    assert not (tmp_path / "status.json.tmp").exists()
+    # a missed detection shows up as recall < 1
+    hb.observe({"step": 5, "loss": 1.0, "located_errors": 0.0,
+                "det_tp": 0.0, "det_adv": 1.0})
+    h = hb.beat(5, 8)["decode_health"]
+    assert h["recall"] == pytest.approx(4 / 5) and h["precision"] == 1.0
+
+
+@pytest.mark.core
+def test_heartbeat_disabled_is_noop(tmp_path):
+    hb = RunHeartbeat(None)
+    hb.observe({"step": 1, "loss": 1.0})
+    assert hb.beat(1, 10) is None
+    hb2 = RunHeartbeat(str(tmp_path), enabled=False)
+    assert hb2.beat(1, 10) is None
+    assert list(tmp_path.iterdir()) == []
+    # no health section when the route emits no detection columns
+    hb3 = RunHeartbeat(str(tmp_path))
+    hb3.observe({"step": 1, "loss": 1.0})
+    assert "decode_health" not in hb3.beat(1, 2)
+
+
+# --------------------------------------------------------------------------
+# MetricWriter buffering + Segments monotonic clock (utils/metrics.py)
+# --------------------------------------------------------------------------
+
+@pytest.mark.core
+def test_metric_writer_buffers_until_flush_or_close(tmp_path):
+    from draco_tpu.utils.metrics import MetricWriter
+
+    w = MetricWriter(str(tmp_path), quiet=True, buffer_records=64)
+    path = tmp_path / "metrics.jsonl"
+    for step in range(3):
+        w.write({"step": step, "loss": 1.0})
+    assert path.read_text() == ""  # buffered: no per-record file traffic
+    w.flush()
+    assert len(path.read_text().splitlines()) == 3
+    w.write({"step": 3, "loss": 1.0})
+    w.close()  # tail safety: close drains the buffer
+    recs = [json.loads(l) for l in path.read_text().splitlines()]
+    assert [r["step"] for r in recs] == [0, 1, 2, 3]
+    assert all("time" in r for r in recs)  # record stamps stay wall-clock
+
+    # the configurable cap: buffer_records=2 auto-flushes on the 2nd write
+    w2 = MetricWriter(str(tmp_path / "b"), quiet=True, buffer_records=2)
+    w2.write({"step": 0})
+    assert (tmp_path / "b" / "metrics.jsonl").read_text() == ""
+    w2.write({"step": 1})
+    assert len((tmp_path / "b" / "metrics.jsonl").read_text()
+               .splitlines()) == 2
+    w2.close()
+
+
+@pytest.mark.core
+def test_segments_use_monotonic_clock(monkeypatch):
+    """A wall-clock step backwards (NTP slew) mid-segment must not corrupt
+    the duration — begin/end read time.perf_counter, not time.time."""
+    import draco_tpu.utils.metrics as metrics_mod
+
+    walltimes = iter([1e9, 1e9 - 3600.0])  # time.time jumps back an hour
+    monkeypatch.setattr(metrics_mod.time, "time",
+                        lambda: next(walltimes, 0.0))
+    seg = metrics_mod.Segments()
+    seg.begin("comp")
+    seg.end()
+    assert 0.0 <= seg.t["comp"] < 1.0
+    assert seg.as_dict() == {"t_comp": round(seg.t["comp"], 6)}
+
+
+# --------------------------------------------------------------------------
+# decode / vote health straight off the coding primitives
+# --------------------------------------------------------------------------
+
+@pytest.mark.core
+def test_cyclic_decode_health_flags_exactly_the_corrupt_rows():
+    from draco_tpu.coding import cyclic
+
+    code = cyclic.build_cyclic_code(8, 1)
+    rng = np.random.RandomState(0)
+    g = rng.randn(8, 64).astype(np.float32)
+    rf = jnp.asarray(1.0 + rng.randn(64).astype(np.float32))
+    er, ei = cyclic.encode_shared(code, jnp.asarray(g))
+    # clean: nothing flagged, residual is float noise
+    _, _, h = cyclic.decode(code, er, ei, rf, with_health=True)
+    assert float(h["residual"]) < 1e-4
+    assert np.asarray(h["flagged"]).sum() == 0
+    # one corrupt row (rev_grad magnitude): flagged exactly, residual ~ 0
+    er1, ei1 = er.at[3].mul(-99.0), ei.at[3].mul(-99.0)
+    _, honest, h1 = cyclic.decode(code, er1, ei1, rf, with_health=True)
+    np.testing.assert_array_equal(
+        np.asarray(h1["flagged"]),
+        np.arange(8) == 3)
+    assert float(h1["residual"]) < 1e-4
+    assert not bool(np.asarray(honest)[3])
+    # erasure-only: stragglers are known-missing, never "detected"
+    pres = np.arange(8) != 5
+    _, _, h2 = cyclic.decode(code, er * pres[:, None], ei * pres[:, None],
+                             rf, present=jnp.asarray(pres), with_health=True)
+    assert np.asarray(h2["flagged"]).sum() == 0
+    assert float(h2["residual"]) < 1e-4
+
+
+@pytest.mark.core
+def test_cyclic_decode_health_raises_fault_beyond_budget():
+    """t = s+1 corruptions exceed the exactness guarantee: the health
+    signal must say so — flagged count over budget and/or a loud
+    residual — instead of reporting a clean decode."""
+    from draco_tpu.coding import cyclic
+
+    code = cyclic.build_cyclic_code(8, 1)
+    rng = np.random.RandomState(1)
+    g = rng.randn(8, 64).astype(np.float32)
+    rf = jnp.asarray(1.0 + rng.randn(64).astype(np.float32))
+    er, ei = cyclic.encode_shared(code, jnp.asarray(g))
+    for rows in ([2, 5], [0, 4], [1, 6]):
+        er2, ei2 = er, ei
+        for r in rows:
+            er2, ei2 = er2.at[r].mul(-99.0), ei2.at[r].mul(-99.0)
+        _, _, h = cyclic.decode(code, er2, ei2, rf, with_health=True)
+        flagged = int(np.asarray(h["flagged"]).sum())
+        assert flagged > code.s or float(h["residual"]) > 1e-4, (
+            rows, flagged, float(h["residual"]))
+
+
+@pytest.mark.core
+def test_cyclic_decode_layers_health_unions_layers():
+    from draco_tpu.coding import cyclic
+
+    code = cyclic.build_cyclic_code(8, 1)
+    rng = np.random.RandomState(2)
+    g = rng.randn(8, 24).astype(np.float32)
+    rf = jnp.asarray(1.0 + rng.randn(24).astype(np.float32))
+    er, ei = cyclic.encode_shared(code, jnp.asarray(g))
+    # corrupt row 4 only inside the second layer's coordinates [10, 24)
+    er = er.at[4, 10:].add(100.0)
+    _, _, h = cyclic.decode_layers(code, er, ei, rf, [0, 10, 24],
+                                   with_health=True)
+    np.testing.assert_array_equal(np.asarray(h["flagged"]),
+                                  np.arange(8) == 4)
+    assert float(h["residual"]) < 1e-4
+    assert np.ndim(h["residual"]) == 0
+
+
+@pytest.mark.core
+def test_majority_vote_health():
+    from draco_tpu.coding import repetition
+
+    code = repetition.build_repetition_code(8, 4)
+    rng = np.random.RandomState(3)
+    rows = np.tile(rng.randn(2, 1, 16).astype(np.float32),
+                   (1, 4, 1)).reshape(8, 16)
+    # all honest: full agreement, nothing flagged
+    voted, h = repetition.majority_vote(code, jnp.asarray(rows),
+                                        with_health=True)
+    assert float(h["vote_agree"]) == 1.0
+    assert int(h["flagged_groups"]) == 0
+    assert np.asarray(h["flagged"]).sum() == 0
+    # one adversary in group 1: flagged exactly, agreement drops by 1/8
+    bad = rows.copy()
+    bad[5] *= -100.0
+    voted_b, hb = repetition.majority_vote(code, jnp.asarray(bad),
+                                           with_health=True)
+    np.testing.assert_array_equal(np.asarray(hb["flagged"]),
+                                  np.arange(8) == 5)
+    assert float(hb["vote_agree"]) == pytest.approx(7 / 8)
+    assert int(hb["flagged_groups"]) == 1
+    np.testing.assert_array_equal(np.asarray(voted_b), np.asarray(voted))
+    # an absent member neither votes nor is flagged
+    pres = np.arange(8) != 5
+    _, hp = repetition.majority_vote(code, jnp.asarray(bad),
+                                     present=jnp.asarray(pres),
+                                     with_health=True)
+    assert np.asarray(hp["flagged"]).sum() == 0
+    assert float(hp["vote_agree"]) == 1.0
+    # health is an opt-in second return: the bare call is unchanged
+    bare = repetition.majority_vote(code, jnp.asarray(bad))
+    np.testing.assert_array_equal(np.asarray(bare), np.asarray(voted_b))
+
+
+# --------------------------------------------------------------------------
+# tools/trace_report.py
+# --------------------------------------------------------------------------
+
+@pytest.mark.core
+def test_trace_report_folds_trace_and_metrics(tmp_path, capsys):
+    from tools.trace_report import main, make_report
+
+    events = [
+        {"name": "dispatch", "ph": "X", "ts": 0.0, "dur": 3000.0,
+         "pid": 1, "tid": 1},
+        {"name": "dispatch", "ph": "X", "ts": 4000.0, "dur": 1000.0,
+         "pid": 1, "tid": 1},
+        {"name": "gather", "ph": "X", "ts": 3000.0, "dur": 500.0,
+         "pid": 1, "tid": 2},
+        {"name": "prefetch_depth", "ph": "C", "ts": 10.0, "pid": 1,
+         "args": {"prefetch_depth": 1}},
+    ]
+    (tmp_path / "trace.json").write_text(
+        json.dumps({"traceEvents": events}))
+    with open(tmp_path / "metrics.jsonl", "w") as fh:
+        fh.write(json.dumps({"step": 1, "loss": 2.0, "t_fetch": 0.25,
+                             "t_comp": 1.0}) + "\n")
+        fh.write(json.dumps({"step": 2, "loss": 1.5, "t_fetch": 0.25,
+                             "t_comp": 1.0}) + "\n")
+        fh.write(json.dumps({"step": 2, "split": "eval", "loss": 1.4})
+                 + "\n")
+
+    report = make_report(str(tmp_path / "trace.json"),
+                         str(tmp_path / "metrics.jsonl"))
+    assert report["traced_wall_ms"] == pytest.approx(5.0)
+    d = report["phases"]["dispatch"]
+    assert d["count"] == 2 and d["total_ms"] == pytest.approx(4.0)
+    assert d["share"] == pytest.approx(0.8)
+    assert report["counters"]["prefetch_depth"]["max"] == 1
+    assert report["metrics"]["train_records"] == 2
+    assert report["metrics"]["t_comp_total_s"] == pytest.approx(2.0)
+
+    out_json = tmp_path / "report.json"
+    rc = main([str(tmp_path), "--json", str(out_json)])
+    assert rc == 0
+    table = capsys.readouterr().out
+    assert "dispatch" in table and "80.0%" in table
+    assert json.load(open(out_json))["phases"]["gather"]["count"] == 1

@@ -1,0 +1,177 @@
+#!/usr/bin/env python
+"""Fold a run's telemetry artifacts into a per-phase wall-clock table.
+
+Reads the host span trace (``trace.json``, Chrome trace events written by
+draco_tpu/obs/tracer.py) and, when present, ``metrics.jsonl`` from the same
+train_dir, and prints where the run's host wall-clock went:
+
+  python tools/trace_report.py train_out/            # a train/trace dir
+  python tools/trace_report.py path/to/trace.json --json report.json
+
+Per phase (gather/upload/dispatch/sync/flush/eval/ckpt + the prefetcher
+lanes): call count, total/mean/max milliseconds, and share of the traced
+wall. The metrics side contributes the device-facing per-step averages the
+records already carry (t_fetch / t_comp) and the step count, so one table
+answers the question the chunked regime's dark host otherwise hides: how
+much of a chunk's wall-clock was host work vs device execution.
+
+No jax import — this is a pure-host artifact folder usable on a laptop
+against artifacts scp'd from a chip job.
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import json
+import os
+import sys
+
+
+def load_trace(path: str) -> list:
+    with open(path) as fh:
+        payload = json.load(fh)
+    events = payload.get("traceEvents", payload if isinstance(payload, list)
+                         else [])
+    if not isinstance(events, list):
+        raise SystemExit(f"{path}: no traceEvents array")
+    return events
+
+
+def fold_spans(events: list) -> "tuple[dict, float]":
+    """name -> {count, total_ms, mean_ms, max_ms, share}; traced wall is the
+    envelope of all complete events (ts..ts+dur, microseconds)."""
+    by_name = collections.defaultdict(lambda: {"count": 0, "total_ms": 0.0,
+                                               "max_ms": 0.0})
+    t_lo, t_hi = float("inf"), float("-inf")
+    for ev in events:
+        if ev.get("ph") != "X":
+            continue
+        dur_ms = float(ev.get("dur", 0.0)) / 1e3
+        row = by_name[ev["name"]]
+        row["count"] += 1
+        row["total_ms"] += dur_ms
+        row["max_ms"] = max(row["max_ms"], dur_ms)
+        t_lo = min(t_lo, float(ev["ts"]))
+        t_hi = max(t_hi, float(ev["ts"]) + float(ev.get("dur", 0.0)))
+    wall_ms = (t_hi - t_lo) / 1e3 if t_hi > t_lo else 0.0
+    for row in by_name.values():
+        row["mean_ms"] = row["total_ms"] / row["count"]
+        row["share"] = row["total_ms"] / wall_ms if wall_ms else 0.0
+    return dict(by_name), wall_ms
+
+
+def fold_counters(events: list) -> dict:
+    """counter name -> {samples, last, max}."""
+    out = {}
+    for ev in events:
+        if ev.get("ph") != "C":
+            continue
+        val = list(ev.get("args", {}).values())
+        if not val:
+            continue
+        row = out.setdefault(ev["name"], {"samples": 0, "last": 0, "max": 0})
+        row["samples"] += 1
+        row["last"] = val[0]
+        row["max"] = max(row["max"], val[0])
+    return out
+
+
+def fold_metrics(path: str) -> dict:
+    """Step count + summed per-step segment seconds from metrics.jsonl
+    (t_fetch/t_comp are per-step amortized values, so their sums are the
+    regime's host-gather and device-execution wall respectively)."""
+    steps = 0
+    sums = collections.defaultdict(float)
+    first = last = None
+    with open(path) as fh:
+        for line in fh:
+            rec = json.loads(line)
+            if "loss" not in rec or rec.get("split") == "eval":
+                continue
+            steps += 1
+            last = rec
+            if first is None:
+                first = rec
+            for key in ("t_fetch", "t_comp"):
+                if key in rec:
+                    sums[key] += float(rec[key])
+    out = {"train_records": steps}
+    out.update({f"{k}_total_s": round(v, 4) for k, v in sums.items()})
+    if first is not None:
+        out["first_loss"] = first.get("loss")
+        out["last_loss"] = last.get("loss")
+    return out
+
+
+def make_report(trace_path: str, metrics_path=None) -> dict:
+    events = load_trace(trace_path)
+    phases, wall_ms = fold_spans(events)
+    report = {
+        "trace": trace_path,
+        "traced_wall_ms": round(wall_ms, 3),
+        "phases": {
+            name: {k: (round(v, 3) if isinstance(v, float) else v)
+                   for k, v in row.items()}
+            for name, row in sorted(phases.items())
+        },
+        "counters": fold_counters(events),
+    }
+    if metrics_path and os.path.exists(metrics_path):
+        report["metrics"] = fold_metrics(metrics_path)
+        report["metrics"]["path"] = metrics_path
+    return report
+
+
+def print_table(report: dict, out=sys.stdout) -> None:
+    print(f"trace: {report['trace']}   traced wall: "
+          f"{report['traced_wall_ms']:.1f} ms", file=out)
+    hdr = f"{'phase':<22}{'count':>7}{'total ms':>12}{'mean ms':>10}" \
+          f"{'max ms':>10}{'share':>8}"
+    print(hdr, file=out)
+    print("-" * len(hdr), file=out)
+    rows = sorted(report["phases"].items(),
+                  key=lambda kv: -kv[1]["total_ms"])
+    for name, r in rows:
+        print(f"{name:<22}{r['count']:>7}{r['total_ms']:>12.2f}"
+              f"{r['mean_ms']:>10.3f}{r['max_ms']:>10.2f}"
+              f"{r['share']:>8.1%}", file=out)
+    for name, c in sorted(report.get("counters", {}).items()):
+        print(f"counter {name}: samples={c['samples']} last={c['last']} "
+              f"max={c['max']}", file=out)
+    m = report.get("metrics")
+    if m:
+        bits = [f"train_records={m['train_records']}"]
+        bits += [f"{k}={m[k]}" for k in sorted(m)
+                 if k.endswith("_total_s")]
+        if "last_loss" in m:
+            bits.append(f"loss {m.get('first_loss'):.4f} -> "
+                        f"{m.get('last_loss'):.4f}")
+        print("metrics: " + "  ".join(bits), file=out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="trace.json, or a directory holding "
+                                 "trace.json (+ metrics.jsonl)")
+    ap.add_argument("--metrics", default="",
+                    help="metrics.jsonl path (default: next to the trace)")
+    ap.add_argument("--json", default="",
+                    help="also write the folded report as JSON here")
+    args = ap.parse_args(argv)
+
+    trace_path = args.path
+    if os.path.isdir(trace_path):
+        trace_path = os.path.join(trace_path, "trace.json")
+    metrics_path = args.metrics or os.path.join(
+        os.path.dirname(trace_path), "metrics.jsonl")
+    report = make_report(trace_path, metrics_path)
+    print_table(report)
+    if args.json:
+        with open(args.json, "w") as fh:
+            json.dump(report, fh, indent=1)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
