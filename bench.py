@@ -246,7 +246,15 @@ def _compiled_flops(compiled):
 
 
 def run(cfg_kwargs, ds, mesh, steps, warmup=1, reps=2, want_flops=False):
-    """Per-step wall-clock of the jitted train step.
+    """Per-step wall-clock of the jitted train step, plus the compile cost.
+
+    Returns ``(dt_per_step_s, loss, flops, compile_s)``. The first-call
+    compile has always been excluded from ms/step by construction (the
+    ``.lower().compile()`` below runs before any timed execution); it is now
+    also MEASURED and returned so the record carries ``extra.compile_ms`` —
+    compile-time drift is a real regression class (a program that doubles
+    its compile time eats the chip window even when ms/step holds) and
+    tools/perf_watch.py tracks it round-over-round.
 
     The ``steps`` training steps are folded into ONE jitted ``lax.scan`` over
     batches pre-staged in HBM, and synchronisation is a device→host fetch of
@@ -298,10 +306,12 @@ def run(cfg_kwargs, ds, mesh, steps, warmup=1, reps=2, want_flops=False):
         x0 = [xs[i] for i in range(steps)]
         y0 = [ys[i] for i in range(steps)]
         m0 = [ms[i] for i in range(steps)]
+        tc0 = time.perf_counter()
         compiled = step_fn.lower(state, x0[0], y0[0], m0[0]).compile()
+        compile_s = time.perf_counter() - tc0
         flops = _compiled_flops(compiled) if want_flops else None
         st, metrics = compiled(state, x0[0], y0[0], m0[0])
-        jax.block_until_ready(st.params)  # compile + settle
+        jax.block_until_ready(st.params)  # settle
         t0 = time.perf_counter()
         for i in range(steps):
             st, metrics = compiled(st, x0[i], y0[i], m0[i])
@@ -309,13 +319,15 @@ def run(cfg_kwargs, ds, mesh, steps, warmup=1, reps=2, want_flops=False):
         dt = (time.perf_counter() - t0) / steps
         loss = float(metrics["loss"])
         tr.close()
-        return dt, loss, flops
+        return dt, loss, flops, compile_s
 
     # The timed program IS the production chunked loop: train_many is the
     # same jitted scan Trainer._run_chunked dispatches with
     # cfg.steps_per_call = steps — bench numbers measure the path users run,
     # not a parallel harness that could drift from it.
+    tc0 = time.perf_counter()
     compiled = tr.setup.train_many.lower(state, xs, ys, ms, None).compile()
+    compile_s = time.perf_counter() - tc0
     # XLA cost analysis counts a scan body ONCE regardless of trip count
     # (verified on this jax: scan(L=5) and scan(L=10) report identical
     # flops), so the loop's flops figure already IS the per-step figure.
@@ -327,7 +339,7 @@ def run(cfg_kwargs, ds, mesh, steps, warmup=1, reps=2, want_flops=False):
     )
     loss = float(np.asarray(jax.device_get(blocks))[-1, loss_col])
     tr.close()
-    return dt, loss, flops
+    return dt, loss, flops, compile_s
 
 
 def measure(args, metric_name, error=None, detail=None):
@@ -411,7 +423,7 @@ def measure(args, metric_name, error=None, detail=None):
 
     # the contender: cyclic code, r=2s+1 redundant compute like the reference
     _PHASE["name"] = "cyclic_leg"
-    t_cyclic, loss_c, flops_c = run(
+    t_cyclic, loss_c, flops_c, compile_c = run(
         dict(common, approach="cyclic", redundancy="simulate"),
         ds, mesh, args.steps, args.warmup, args.reps, want_flops=True,
     )
@@ -426,6 +438,10 @@ def measure(args, metric_name, error=None, detail=None):
         "flops_per_step": flops_c,
         "peak_bf16_flops": peak,
         "mfu_vs_bf16_peak": mfu,
+        # first-call compile wall of the timed program, excluded from
+        # ms/step by construction and recorded so perf_watch can track
+        # compile-time drift round-over-round (PERF.md §8)
+        "compile_ms": round(compile_c * 1000.0, 1),
     }
     _emit(record(round(t_cyclic * 1000.0, 3), None,
                  dict(cyc_extra, partial="geomedian leg pending")))
@@ -434,7 +450,7 @@ def measure(args, metric_name, error=None, detail=None):
     if _remaining() < 30.0:
         return _LAST_RECORD
     _PHASE["name"] = "geomedian_leg"
-    t_geomed, loss_g, _ = run(
+    t_geomed, loss_g, _, compile_g = run(
         dict(common, approach="baseline", mode="geometric_median"),
         ds, mesh, args.steps, args.warmup, args.reps,
     )
@@ -442,6 +458,7 @@ def measure(args, metric_name, error=None, detail=None):
         cyc_extra,
         geomedian_step_ms=round(t_geomed * 1000.0, 3),
         loss_geomedian=round(loss_g, 4),
+        geomedian_compile_ms=round(compile_g * 1000.0, 1),
     )
     value_ms = round(t_cyclic * 1000.0, 3)
     ratio_sim = round(t_geomed / t_cyclic, 4)
@@ -472,7 +489,7 @@ def measure(args, metric_name, error=None, detail=None):
         return _LAST_RECORD
     _PHASE["name"] = "shared_leg"
     try:
-        t_shared, _, _ = run(
+        t_shared, _, _, _ = run(
             dict(common, approach="cyclic", redundancy="shared"),
             ds, mesh, args.steps, args.warmup, args.reps,
         )

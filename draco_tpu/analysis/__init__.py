@@ -1,8 +1,9 @@
 """Static analysis of the chip-bound jitted programs (the program linter).
 
 ``registry``  — catalog of every hot-loop program + its Manifest
-``rules``     — the five rules (constant_bloat, donation, dtype,
-                collectives, host_traffic) over jaxpr + exported StableHLO
+``rules``     — the six rules (constant_bloat, donation, dtype,
+                collectives, host_traffic, memory_budget) over jaxpr +
+                exported StableHLO + compiled memory/cost analysis
 ``controls``  — seeded-defect programs proving each rule is live
 
 Driver: ``tools/program_lint.py`` (artifact

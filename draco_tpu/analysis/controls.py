@@ -205,6 +205,37 @@ def _build_host_outfeed_in_scan() -> BuiltProgram:
                         Manifest(collectives=_MINI_COLLECTIVES))
 
 
+def _build_memory_hog() -> BuiltProgram:
+    """Defect: a working set far beyond the manifest's declared peak-memory
+    budget — a runtime (1024, 1024) matrix product whose operands and
+    result must materialize (~8 MB of temps against a 4 MB budget). The
+    matrix derives from the batch, so neither constant folding nor the
+    serialized module absorbs it: the bytes exist only as run-time buffers,
+    exactly the class of regression (dropped donation, lost remat, stray
+    materialized temp) the memory_budget rule exists to see."""
+    import jax
+    import jax.numpy as jnp
+
+    mesh = _mini_mesh()
+
+    def f(state, x):
+        w, step = state
+        g = _psum_grads(mesh)(x).sum(0)
+        t = jnp.sin(x.sum()
+                    + jnp.arange(1024 * 1024, dtype=jnp.float32)
+                    ).reshape(1024, 1024)
+        waste = (t @ t.T).sum()  # forces the big temps to materialize
+        w = w - 0.01 * (g + waste * 1e-20)
+        return (w, step + 1), jnp.sum(w)
+
+    with mesh:
+        fn = jax.jit(f, donate_argnums=(0,))
+    return BuiltProgram("control_memory_hog", fn,
+                        (_mini_state(mesh), _mini_batch(mesh)), mesh,
+                        Manifest(collectives=_MINI_COLLECTIVES,
+                                 max_peak_bytes=4 << 20))
+
+
 def control_programs() -> Tuple[Control, ...]:
     mk = lambda name, build: LintProgram(  # noqa: E731
         name=name, build=build, route="controls")
@@ -218,4 +249,6 @@ def control_programs() -> Tuple[Control, ...]:
                 "collectives"),
         Control(mk("control_host_outfeed_in_scan",
                    _build_host_outfeed_in_scan), "host_traffic"),
+        Control(mk("control_memory_hog", _build_memory_hog),
+                "memory_budget"),
     )

@@ -73,6 +73,16 @@ class Manifest:
     ``host_transfer_budget`` is 0 for every registered program: a single
     infeed/outfeed/host-callback inside a scanned body serializes the chunk
     on the host link and defeats the whole scan-chunk design (PERF.md §0).
+
+    ``max_peak_bytes``: cap on the program's peak-memory estimate from
+    XLA's ``compiled.memory_analysis()`` (argument + output + temp +
+    generated-code bytes, minus donated-alias bytes) — the
+    ``memory_budget`` rule. The CI-sized registrations sit far under the
+    default 2 GiB cap; the cap exists so the manifest is a reviewable
+    memory budget a program cannot silently outgrow (a dropped donation or
+    a remat regression shows up here as bytes, not as an OOM three rungs up
+    the chip ladder). ``None`` skips the rule. The measured columns
+    (memory/cost) are recorded on every row regardless of the cap.
     """
 
     max_constant_bytes: int = 1 << 20  # per closed-over constant
@@ -82,6 +92,7 @@ class Manifest:
     bf16_promotion_whitelist: Tuple[str, ...] = ("convert_element_type",)
     collectives: Optional[dict] = None
     host_transfer_budget: int = 0
+    max_peak_bytes: Optional[int] = 2 << 30  # memory_budget rule cap
 
 
 @dataclasses.dataclass
@@ -92,6 +103,14 @@ class BuiltProgram:
     ``trace_ctx`` wraps trace+export (negative controls use
     ``jax.experimental.enable_x64``); ``donate_argnums`` names which args
     the ``"state"`` donation sentinel resolves over (arg 0 by convention).
+
+    ``capture_memory``: compile for the host backend to record the
+    memory/cost ledger (rules.rule_memory_budget). Chip-tier audit rows
+    opt out where a host compile is pointless or prohibitive — the
+    d≈159M lm_big rungs (a CPU backend-compile of the flagship costs
+    real minutes; the lowering audit needs only trace+export) and the
+    Pallas kernel rows (tpu_custom_call cannot compile for CPU at all);
+    the rule then reports ``skipped`` with the reason.
     """
 
     name: str
@@ -101,6 +120,7 @@ class BuiltProgram:
     manifest: Manifest = dataclasses.field(default_factory=Manifest)
     trace_ctx: Callable = contextlib.nullcontext
     extra: dict = dataclasses.field(default_factory=dict)  # report fields
+    capture_memory: bool = True
 
 
 @dataclasses.dataclass(frozen=True)

@@ -1,8 +1,9 @@
-"""The five static rules run against every registered chip-bound program.
+"""The six static rules run against every registered chip-bound program.
 
-Each rule inspects two static artifacts of a :class:`~draco_tpu.analysis.
-registry.BuiltProgram` — the closed jaxpr (``jit_fn.trace``) and the
-``jax.export``-ed StableHLO module — against the program's
+Each rule inspects the static artifacts of a :class:`~draco_tpu.analysis.
+registry.BuiltProgram` — the closed jaxpr (``jit_fn.trace``), the
+``jax.export``-ed StableHLO module, and the compiled executable's
+memory/cost analysis — against the program's
 :class:`~draco_tpu.analysis.registry.Manifest`:
 
   constant_bloat   no closed-over constant ≥ manifest.max_constant_bytes and
@@ -32,12 +33,26 @@ registry.BuiltProgram` — the closed jaxpr (``jit_fn.trace``) and the
                    custom calls or callback primitives — one host hop inside
                    a scanned body re-serializes the chunk on the ~70 ms
                    dispatch link the scan exists to hide (PERF.md §0)
+  memory_budget    the compiled executable's peak-memory estimate
+                   (``compiled.memory_analysis()``: argument + output +
+                   temp + generated-code bytes, minus donated-alias bytes)
+                   stays under manifest.max_peak_bytes; the rule row is
+                   also the per-program memory/cost LEDGER — every row
+                   carries the raw byte columns and the program's analytic
+                   flops (``cost_analysis``), so the committed artifact is
+                   the round-over-round record tools/perf_watch.py diffs
+                   (PERF.md §8). Measured on the CPU-host compile of the
+                   same program the CI mesh executes — an estimate of
+                   shape, not a chip HBM number.
 
 Rules degrade gracefully: host callbacks make a program un-exportable on
 this jax (NotImplementedError), so the jaxpr-level half of host_traffic
 still trips while module-level rules report ``skipped`` with the export
 error; any OTHER export failure is itself a violation (synthetic rule
-``export``). A rule whose manifest field is ``None`` reports ``skipped``.
+``export``). Likewise a program the host backend cannot compile reports
+``memory_budget`` as ``skipped`` with the compile error rather than
+blocking the jaxpr/module-level rules. A rule whose manifest field is
+``None`` reports ``skipped``.
 """
 
 from __future__ import annotations
@@ -52,7 +67,7 @@ from draco_tpu.analysis.registry import (
 )
 
 RULE_NAMES = ("constant_bloat", "donation", "dtype", "collectives",
-              "host_traffic")
+              "host_traffic", "memory_budget")
 
 # jaxpr primitives that move data to/from the host at run time
 _HOST_PRIMS = frozenset({
@@ -77,23 +92,62 @@ _TENSOR_ELEM_RE = re.compile(
 
 
 class Artifacts:
-    """What one trace+export pass yields; rules only read this."""
+    """What one trace+export+compile pass yields; rules only read this."""
 
     def __init__(self, built: BuiltProgram, closed_jaxpr, mlir_text,
-                 serialized_bytes, export_error):
+                 serialized_bytes, export_error, memory=None,
+                 cost_flops=None, compile_error=None):
         self.built = built
         self.manifest = built.manifest
         self.jaxpr = closed_jaxpr  # ClosedJaxpr | None
         self.mlir_text: Optional[str] = mlir_text
         self.serialized_bytes: Optional[int] = serialized_bytes
         self.export_error: Optional[str] = export_error
+        self.memory: Optional[dict] = memory  # _memory_columns() | None
+        self.cost_flops: Optional[float] = cost_flops
+        self.compile_error: Optional[str] = compile_error
+
+
+def _memory_columns(compiled) -> Optional[dict]:
+    """The per-program memory ledger: XLA's static memory analysis of the
+    compiled executable, as integer byte columns + the peak estimate the
+    memory_budget rule caps. ``peak_bytes`` = argument + output + temp +
+    generated-code − aliased (donated buffers alias into outputs, so they
+    are counted once) — XLA's own working-set accounting of the program."""
+    ma = compiled.memory_analysis()
+    if ma is None:
+        return None
+    cols = {
+        "argument_bytes": int(ma.argument_size_in_bytes),
+        "output_bytes": int(ma.output_size_in_bytes),
+        "temp_bytes": int(ma.temp_size_in_bytes),
+        "generated_code_bytes": int(ma.generated_code_size_in_bytes),
+        "alias_bytes": int(ma.alias_size_in_bytes),
+    }
+    cols["peak_bytes"] = (cols["argument_bytes"] + cols["output_bytes"]
+                          + cols["temp_bytes"]
+                          + cols["generated_code_bytes"]
+                          - cols["alias_bytes"])
+    return cols
+
+
+def _cost_flops(compiled) -> Optional[float]:
+    """Analytic FLOPs of the optimized program (same source bench.py's MFU
+    uses; a scan body is counted once regardless of trip count)."""
+    cost = compiled.cost_analysis()
+    if isinstance(cost, list):  # older jax returns [dict]
+        cost = cost[0] if cost else {}
+    flops = float(cost.get("flops", 0.0)) if cost else 0.0
+    return flops if flops > 0 else None
 
 
 def trace_and_export(built: BuiltProgram,
                      platforms=("tpu",)) -> Artifacts:
-    """Trace the closed jaxpr and cross-platform-export the module on the
-    CPU host (the lowering-check methodology: the whole StableHLO (+Pallas)
-    lowering stack runs without a chip, tools/tpu_attn_lowering_check.py)."""
+    """Trace the closed jaxpr, cross-platform-export the module on the CPU
+    host (the lowering-check methodology: the whole StableHLO (+Pallas)
+    lowering stack runs without a chip, tools/tpu_attn_lowering_check.py),
+    and compile for the host backend to capture the executable's
+    memory/cost analysis (the memory_budget ledger)."""
     import contextlib
 
     import jax.export
@@ -101,14 +155,30 @@ def trace_and_export(built: BuiltProgram,
     mesh_ctx = built.mesh if built.mesh is not None else contextlib.nullcontext()
     with mesh_ctx, built.trace_ctx():
         closed = built.fn.trace(*built.args).jaxpr
+        mlir_text = serialized = export_error = None
         try:
             exp = jax.export.export(built.fn, platforms=list(platforms))(
                 *built.args)
-            return Artifacts(built, closed, exp.mlir_module(),
-                             len(exp.mlir_module_serialized), None)
+            mlir_text = exp.mlir_module()
+            serialized = len(exp.mlir_module_serialized)
         except Exception as e:
-            return Artifacts(built, closed, None, None,
-                             f"{type(e).__name__}: {str(e)[:300]}")
+            export_error = f"{type(e).__name__}: {str(e)[:300]}"
+        memory = cost_flops = compile_error = None
+        if not built.capture_memory:
+            compile_error = ("capture_memory disabled for this program "
+                             "(chip-tier row: host compile prohibitive or "
+                             "impossible)")
+        else:
+            try:
+                compiled = built.fn.lower(*built.args).compile()
+                memory = _memory_columns(compiled)
+                cost_flops = _cost_flops(compiled)
+            except Exception as e:  # un-compilable on the host backend:
+                # memory_budget skips with the reason, other rules still run
+                compile_error = f"{type(e).__name__}: {str(e)[:300]}"
+    return Artifacts(built, closed, mlir_text, serialized, export_error,
+                     memory=memory, cost_flops=cost_flops,
+                     compile_error=compile_error)
 
 
 def _walk_eqns(jaxpr):
@@ -327,17 +397,39 @@ def rule_host_traffic(art: Artifacts) -> dict:
     return {"ok": True, **res}
 
 
+def rule_memory_budget(art: Artifacts) -> dict:
+    m = art.manifest
+    if m.max_peak_bytes is None:
+        return _skip("manifest.max_peak_bytes is None")
+    if art.memory is None:
+        return _skip(f"memory analysis unavailable: "
+                     f"{art.compile_error or 'backend reported none'}")
+    res = {"memory": art.memory, "flops": art.cost_flops}
+    peak = art.memory["peak_bytes"]
+    if peak > m.max_peak_bytes:
+        return {"ok": False, **res,
+                "error": f"peak-memory estimate {peak} bytes exceeds the "
+                         f"manifest budget {m.max_peak_bytes} — the "
+                         f"program's working set outgrew its declared "
+                         f"budget (dropped donation? lost remat? an "
+                         f"accidental materialized temp?); raise the "
+                         f"manifest only for a deliberate change "
+                         f"(PERF.md §8)"}
+    return {"ok": True, **res}
+
+
 _RULES = {
     "constant_bloat": rule_constant_bloat,
     "donation": rule_donation,
     "dtype": rule_dtype,
     "collectives": rule_collectives,
     "host_traffic": rule_host_traffic,
+    "memory_budget": rule_memory_budget,
 }
 
 
 def lint_built(built: BuiltProgram, platforms=("tpu",)) -> dict:
-    """Run all five rules; returns the report row for this program.
+    """Run all six rules; returns the report row for this program.
 
     ``lint_ok`` is True iff no rule failed AND the export either succeeded
     or was blocked by host traffic that the host rule already flagged (any

@@ -154,6 +154,21 @@ def add_fit_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                         "ckpt + prefetcher lanes) into this directory — "
                         "open in Perfetto; complements --profile-dir's "
                         "device trace (draco_tpu/obs)")
+    from draco_tpu.obs.compile_watch import GUARD_MODES
+
+    p.add_argument("--compile-guard", type=str, default="warn",
+                   choices=list(GUARD_MODES),
+                   help="steady-state recompilation guard "
+                        "(obs/compile_watch.py): every XLA executable build "
+                        "is recorded in compiles.jsonl + the trace's "
+                        "compile lane; after --compile-warmup builds per "
+                        "program a further build warns (default) or raises "
+                        "— a mid-run retrace re-pays the compile the "
+                        "scan-chunked loops exist to amortize (PERF.md §8)")
+    p.add_argument("--compile-warmup", type=int, default=1,
+                   help="XLA builds allowed per registered program (per "
+                        "chunk shape) before the compile guard treats a "
+                        "build as a steady-state recompilation")
     return p
 
 
@@ -219,6 +234,8 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         steps_per_call=args.steps_per_call,
         token_gen=args.token_gen,
         trace_dir=args.trace_dir,
+        compile_guard=args.compile_guard,
+        compile_warmup=args.compile_warmup,
         remat=args.remat,
         eval_freq=args.eval_freq,
         train_dir=args.train_dir,

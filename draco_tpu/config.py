@@ -190,6 +190,17 @@ class TrainConfig:
     # is the separate jax.profiler capture (--profile-dir), aligned via the
     # jax.named_scope phase names inside the step programs.
     trace_dir: str = ""
+    # Compile/retrace sentinel (obs/compile_watch.py; ISSUE 5). Every XLA
+    # executable build is recorded in ``compiles.jsonl`` (next to trace.json
+    # when trace_dir is set, else next to metrics.jsonl) and surfaced in the
+    # status.json heartbeat. After ``compile_warmup`` builds per registered
+    # program (per chunk shape), any further build is a steady-state
+    # recompilation — it silently re-pays the multi-second compile the
+    # scan-chunk design exists to amortize. compile_guard: "warn" (default)
+    # emits RetraceWarning, "raise" fails the dispatch (the test/CI mode the
+    # K∈{1,4} equivalence suites run under), "off" records only.
+    compile_guard: str = "warn"
+    compile_warmup: int = 1
 
     # --- misc ---
     seed: int = SEED
@@ -296,6 +307,17 @@ class TrainConfig:
             raise ValueError(
                 "token_gen='device' applies to the TransformerLM token "
                 "routes only (the CNN Trainer reads dataset batches)"
+            )
+        from draco_tpu.obs.compile_watch import GUARD_MODES
+
+        if self.compile_guard not in GUARD_MODES:
+            raise ValueError(
+                f"compile_guard must be one of {'|'.join(GUARD_MODES)}, "
+                f"got {self.compile_guard!r}"
+            )
+        if self.compile_warmup < 0:
+            raise ValueError(
+                f"compile_warmup must be >= 0, got {self.compile_warmup}"
             )
         if self.straggle_mode not in ("none", "drop"):
             raise ValueError(f"unknown straggle_mode: {self.straggle_mode}")

@@ -15,9 +15,15 @@ device fetches in steady state** and zero overhead when disabled.
                 path every loop runs by default.
   heartbeat.py  RunHeartbeat — ``train_dir/status.json`` rewritten
                 atomically at every flush boundary (step, steps/s, ETA,
-                last loss, decode health, prefetch queue depth) so external
-                monitors can watch a long chip job without touching the
-                process.
+                last loss, decode health, prefetch queue depth, compile
+                counters) so external monitors can watch a long chip job
+                without touching the process.
+  compile_watch.py  CompileWatch — the compiler-facing half (ISSUE 5):
+                every XLA executable build becomes a ``compiles.jsonl``
+                ledger row + a ``compile`` lane event in trace.json via
+                jax.monitoring, and a steady-state guard (warn by default,
+                raise in tests) trips on any recompilation of a labelled
+                registered program after its warmup build.
 
 The in-graph half of the telemetry (decode-health metric columns) lives
 where the math lives: coding/cyclic.py + coding/repetition.py produce the
@@ -27,7 +33,14 @@ and metric columns, never host callbacks, so every registered program
 stays green under the PR 3 linter's host_traffic rule.
 """
 
+from draco_tpu.obs.compile_watch import (
+    CompileWatch,
+    RetraceError,
+    RetraceWarning,
+    make_compile_watch,
+)
 from draco_tpu.obs.heartbeat import RunHeartbeat
 from draco_tpu.obs.tracer import NULL_TRACER, SpanTracer, make_tracer
 
-__all__ = ["NULL_TRACER", "RunHeartbeat", "SpanTracer", "make_tracer"]
+__all__ = ["NULL_TRACER", "CompileWatch", "RetraceError", "RetraceWarning",
+           "RunHeartbeat", "SpanTracer", "make_compile_watch", "make_tracer"]

@@ -65,6 +65,10 @@ class NullTracer:
     def span(self, name: str, **args) -> _NullSpan:
         return _NULL_SPAN
 
+    def complete(self, name: str, dur_s: float, cat: str = "host",
+                 **args) -> None:
+        pass
+
     def counter(self, name: str, value) -> None:
         pass
 
@@ -162,6 +166,26 @@ class SpanTracer:
     def span(self, name: str, **args) -> _Span:
         """Context manager timing one host phase on the calling thread."""
         return _Span(self, name, args or None)
+
+    def complete(self, name: str, dur_s: float, cat: str = "host",
+                 **args) -> None:
+        """Append an already-measured span ending now (duration in seconds)
+        on the calling thread's lane — how externally-timed phases (e.g. XLA
+        compiles observed via jax.monitoring, obs/compile_watch.py) land in
+        the trace without a context manager around them."""
+        t1 = time.perf_counter()
+        ev = {
+            "name": name,
+            "ph": "X",
+            "ts": round((t1 - self._t0 - dur_s) * 1e6, 3),
+            "dur": round(dur_s * 1e6, 3),
+            "pid": self._pid,
+            "tid": threading.get_ident(),
+            "cat": cat,
+        }
+        if args:
+            ev["args"] = args
+        self._append(ev)
 
     def counter(self, name: str, value) -> None:
         """One sample of a counter track (e.g. prefetch queue depth)."""

@@ -51,7 +51,7 @@ import numpy as np
 from draco_tpu import rng as drng
 from draco_tpu.config import TrainConfig
 from draco_tpu.data.batching import chunk_ranges
-from draco_tpu.obs import NULL_TRACER, RunHeartbeat
+from draco_tpu.obs import NULL_TRACER, CompileWatch, RunHeartbeat
 
 
 class _LoopTelemetry(NamedTuple):
@@ -63,6 +63,8 @@ class _LoopTelemetry(NamedTuple):
     total_end: int = 0  # last step of the run (heartbeat ETA denominator)
     profile_dir: Optional[str] = None
     profile_steps: tuple = (3, 8)
+    # compile/retrace sentinel; the default is an unstarted (inert) watch
+    compile_watch: CompileWatch = CompileWatch(guard="off")
 
 
 def run_token_loop(setup, cfg: TrainConfig, steps: Optional[int] = None,
@@ -84,7 +86,7 @@ def run_token_loop(setup, cfg: TrainConfig, steps: Optional[int] = None,
     host-span ``trace.json``; ``cfg.train_dir`` gets the ``status.json``
     heartbeat at every flush boundary.
     """
-    from draco_tpu.obs import make_tracer
+    from draco_tpu.obs import make_compile_watch, make_tracer
     from draco_tpu.parallel.sp_step import synthetic_text
     from draco_tpu.utils import checkpoint as ckpt_mod
     from draco_tpu.utils.metrics import MetricWriter
@@ -111,6 +113,7 @@ def run_token_loop(setup, cfg: TrainConfig, steps: Optional[int] = None,
     writer = MetricWriter(cfg.train_dir or None, quiet=quiet)
     tracer = make_tracer(cfg.trace_dir, is_main)
     heartbeat = RunHeartbeat(cfg.train_dir or None, enabled=is_main)
+    compile_watch = make_compile_watch(cfg, tracer, is_main)
     eval_toks = None
     if cfg.eval_freq:
         # held-out stream: step 0 is never trained on
@@ -133,7 +136,8 @@ def run_token_loop(setup, cfg: TrainConfig, steps: Optional[int] = None,
     obs = _LoopTelemetry(tracer=tracer, heartbeat=heartbeat,
                          total_end=last_step,
                          profile_dir=(profile_dir if is_main else None),
-                         profile_steps=profile_steps)
+                         profile_steps=profile_steps,
+                         compile_watch=compile_watch)
     try:
         K = max(cfg.steps_per_call, 1)
         if K > 1 or cfg.token_gen == "device":
@@ -155,6 +159,7 @@ def run_token_loop(setup, cfg: TrainConfig, steps: Optional[int] = None,
                               compress=cfg.compress_ckpt)
     finally:
         writer.close()
+        compile_watch.stop()
         tracer.close()
     return state, metrics
 
@@ -164,7 +169,7 @@ def _run_eager(setup, cfg, state, start, last_step, adv, straggle, writer,
     """One dispatch per step — the K=1 bitwise reference."""
     from draco_tpu.parallel.sp_step import synthetic_text
 
-    tracer, heartbeat, total_end, profile_dir, profile_steps = obs
+    tracer, heartbeat, total_end, profile_dir, profile_steps, watch = obs
     metrics = {}
     profiling = False
     for step in range(start, last_step + 1):
@@ -182,7 +187,7 @@ def _run_eager(setup, cfg, state, start, last_step, adv, straggle, writer,
                 synthetic_text(cfg.seed, step, cfg.num_workers,
                                cfg.batch_size, cfg.seq_len, cfg.vocab)
             )
-        with tracer.span("dispatch"):
+        with tracer.span("dispatch"), watch.expect("train_step"):
             if straggle is None:
                 state, metrics = setup.train_step(state, toks,
                                                   jnp.asarray(adv[step]))
@@ -206,7 +211,7 @@ def _run_eager(setup, cfg, state, start, last_step, adv, straggle, writer,
         if boundary or step == last_step:
             with tracer.span("flush"):
                 writer.flush()
-                heartbeat.beat(step, total_end)
+                heartbeat.beat(step, total_end, extra=watch.snapshot())
                 tracer.flush()
         if boundary:
             boundary_eval_ckpt(step, state)
@@ -224,7 +229,7 @@ def _run_chunked(setup, cfg, state, start, last_step, adv, straggle, writer,
     from draco_tpu.parallel.sp_step import synthetic_text
     from draco_tpu.utils.metrics import DeferredMetricWriter
 
-    tracer, heartbeat, total_end, profile_dir, profile_steps = obs
+    tracer, heartbeat, total_end, profile_dir, profile_steps, watch = obs
     if setup.train_token_many is None:
         raise ValueError(
             f"{tag} route setup lacks train_token_many — rebuild it with "
@@ -279,7 +284,8 @@ def _run_chunked(setup, cfg, state, start, last_step, adv, straggle, writer,
                 jax.profiler.start_trace(profile_dir)
                 profiling = True
             toks, masks, presents = chunk
-            with tracer.span("dispatch", chunk_start=s0, k=k):
+            with tracer.span("dispatch", chunk_start=s0, k=k), \
+                    watch.expect("train_token_many", key=k):
                 state, block = setup.train_token_many(state, toks, masks,
                                                       presents)
             deferred.defer(range(s0, end + 1), setup.metric_names, block)
@@ -296,7 +302,8 @@ def _run_chunked(setup, cfg, state, start, last_step, adv, straggle, writer,
                     deferred.flush(should_log)
                     heartbeat.beat(end, total_end, extra={
                         "prefetch_depth": (prefetch.depth
-                                           if prefetch is not None else 0)})
+                                           if prefetch is not None else 0),
+                        **watch.snapshot()})
                     tracer.flush()
             if profiling and end >= profile_steps[1] - 1:
                 jax.block_until_ready(state.params)

@@ -37,7 +37,7 @@ from draco_tpu.config import TrainConfig
 from draco_tpu.data import batching
 from draco_tpu.data.datasets import Dataset, load_dataset
 from draco_tpu.data.prefetch import BatchPrefetcher, ChunkPrefetcher
-from draco_tpu.obs import RunHeartbeat, make_tracer
+from draco_tpu.obs import RunHeartbeat, make_compile_watch, make_tracer
 from draco_tpu.runtime import WORKER_AXIS, make_mesh, put_global
 from draco_tpu.training.step import build_train_setup
 from draco_tpu.utils import checkpoint as ckpt
@@ -64,6 +64,13 @@ class Trainer:
         self.tracer = make_tracer(cfg.trace_dir, self._is_main)
         self.heartbeat = RunHeartbeat(cfg.train_dir or None,
                                       enabled=self._is_main)
+        # compile/retrace sentinel (obs/compile_watch.py): every XLA
+        # executable build lands in compiles.jsonl + the trace's compile
+        # lane, and a steady-state recompile of a labelled program trips
+        # the guard (cfg.compile_guard) — the compile-once contract the
+        # chunked regime's economics rest on
+        self.compile_watch = make_compile_watch(cfg, self.tracer,
+                                                self._is_main)
         self._shard_w = NamedSharding(self.mesh, P(WORKER_AXIS))
         self._adv_schedule = drng.adversary_schedule(
             cfg.seed, cfg.max_steps, cfg.num_workers, cfg.num_adversaries
@@ -228,7 +235,8 @@ class Trainer:
             seg.end()
 
             seg.begin("comp")  # fwd+bwd+encode+gather+decode+update, one program
-            with self.tracer.span("dispatch", step=step):
+            with self.tracer.span("dispatch", step=step), \
+                    self.compile_watch.expect("train_step"):
                 if present is None:
                     self.state, metrics = self.setup.train_step(self.state, x,
                                                                 y, mask)
@@ -253,7 +261,9 @@ class Trainer:
                 with self.tracer.span("flush", at_step=step):
                     self.writer.flush()
                     self.heartbeat.beat(step, n_steps,
-                                        extra=self._prefetch_depth())
+                                        extra={**self._prefetch_depth(),
+                                               **self.compile_watch
+                                               .snapshot()})
                     self.tracer.flush()
             if boundary:
                 self.evaluate(step)
@@ -312,7 +322,8 @@ class Trainer:
                 jax.profiler.start_trace(profile_dir)
                 profiling = True
             xs, ys, masks, presents = chunk
-            with self.tracer.span("dispatch", chunk_start=start, k=k):
+            with self.tracer.span("dispatch", chunk_start=start, k=k), \
+                    self.compile_watch.expect("train_many", key=k):
                 self.state, block = setup.train_many(self.state, xs, ys,
                                                      masks, presents)
             extras = {"t_fetch": round(fetch_s / k, 6)}
@@ -341,7 +352,9 @@ class Trainer:
                                    {"t_comp": round(t_comp / window_steps,
                                                     6)})
                     self.heartbeat.beat(end, n_steps,
-                                        extra=self._prefetch_depth())
+                                        extra={**self._prefetch_depth(),
+                                               **self.compile_watch
+                                               .snapshot()})
                     self.tracer.flush()
                 window_t0 = time.perf_counter()
                 window_fetch = 0.0
@@ -401,6 +414,7 @@ class Trainer:
         if self._chunk_prefetch is not None:
             self._chunk_prefetch.close()
         self.writer.close()
+        self.compile_watch.stop()
         self.tracer.close()
 
     # ---- checkpoint ------------------------------------------------------

@@ -35,6 +35,10 @@ def make_cfg(**kw):
         mode="normal", worker_fail=0, err_mode="rev_grad", seq_len=16,
         vocab=32, model_dim=32, model_heads=2, model_layers=1, max_steps=7,
         eval_freq=0, train_dir="", log_every=1000,
+        # strict compile sentinel (ISSUE 5): a steady-state recompilation
+        # of a labelled route program raises at the dispatch site, so every
+        # run in this suite doubles as a 0-retrace assertion
+        compile_guard="raise",
     )
     base.update(kw)
     return TrainConfig(**base)
@@ -149,6 +153,19 @@ def _assert_route_telemetry(route, kw, run_dir):
     assert not (worker_tids & dispatches)  # ...distinct from the main loop's
     assert any(e["ph"] == "C" and e["name"] == "prefetch_depth"
                for e in events)
+    # compile sentinel surface (ISSUE 5): status.json carries the counters,
+    # the ledger attributes the chunked driver's builds per chunk shape
+    # (main chunks k=3 snapped to eval_freq=3 + remainder k=1), and the
+    # trace grew a compile-category lane
+    status = json.load(open(os.path.join(run_dir, "status.json")))
+    assert status["compiles"] >= 1 and status["compile_s"] > 0
+    assert status["steady_recompiles"] == 0
+    ledger = [json.loads(l)
+              for l in open(os.path.join(run_dir, "compiles.jsonl"))]
+    labels = {r["program"] for r in ledger if r["program"]}
+    assert {"train_token_many[3]", "train_token_many[1]"} <= labels
+    assert not any(r["steady_recompile"] for r in ledger)
+    assert any(e.get("cat") == "compile" for e in events)
 
 
 def test_device_token_gen_bitwise_and_distinct():

@@ -49,6 +49,10 @@ def make_cfg(**kw):
         eval_freq=0,
         train_dir="",
         log_every=1,
+        # strict compile sentinel (ISSUE 5): any steady-state recompilation
+        # of a labelled program raises at the dispatch site, so every test
+        # in this suite doubles as a 0-retrace assertion
+        compile_guard="raise",
     )
     base.update(kw)
     return TrainConfig(**base)
@@ -111,6 +115,11 @@ def test_chunked_equals_eager_bitwise(ds, approach, tmp_path):
                      mesh=mesh, dataset=ds, quiet=True)
         last = tr.run()
         out[k] = (params_vec(tr), metric_stream(d), last)
+        # the sentinel saw the run's compiles and zero steady-state
+        # recompiles (compile_guard="raise" would already have failed the
+        # dispatch — this pins the counter surface too)
+        snap = tr.compile_watch.snapshot()
+        assert snap["compiles"] >= 1 and snap["steady_recompiles"] == 0
         tr.close()
     np.testing.assert_array_equal(out[1][0], out[4][0])
     assert out[1][1] == out[4][1]  # identical per-step metric values
@@ -176,6 +185,17 @@ def _assert_telemetry_artifacts(run_dir, approach):
     assert status["step"] == 6 and status["steps_per_s"] > 0
     assert np.isfinite(status["loss"])
     assert status["prefetch_depth"] in (0, 1)
+    # the heartbeat surfaces the compile counters (ISSUE 5)
+    assert status["compiles"] >= 1 and status["compile_s"] > 0
+    assert status["steady_recompiles"] == 0
+    # ... and the compile ledger sits next to the trace, attributing the
+    # chunked program's builds (main chunk k=4 + remainder k=2)
+    ledger = [json.loads(l) for l in open(run_dir / "compiles.jsonl")]
+    labels = {r["program"] for r in ledger if r["program"]}
+    assert {"train_many[4]", "train_many[2]"} <= labels
+    assert not any(r["steady_recompile"] for r in ledger)
+    compile_events = [e for e in events if e.get("cat") == "compile"]
+    assert len(compile_events) == len(ledger) == status["compiles"]
     if approach == "baseline":
         assert "decode_health" not in status
     else:

@@ -390,6 +390,91 @@ def test_lm_time_to_loss_tool(tmp_path):
     assert walls == sorted(walls)
 
 
+def test_perf_watch_snapshot_and_injected_regression(tmp_path):
+    """tools/perf_watch.py (jax-free): folds synthetic round artifacts,
+    snapshots a baseline, passes clean, exits nonzero on an injected 20%
+    ms/step regression (and on a peak-memory jump / a steady-state build in
+    the timed window), and treats improvements as non-fatal."""
+    import json
+
+    from tools import perf_watch
+
+    root = tmp_path
+    (root / "baselines_out").mkdir()
+    rec = {"metric": "resnet_step", "value": 100.0, "unit": "ms/step",
+           "vs_baseline": 2.0,
+           "extra": {"flops_per_step": 1e9, "compile_ms": 900.0}}
+    (root / "BENCH_r01.json").write_text(json.dumps(
+        {"n": 1, "rc": 0,
+         "tail": "driver noise\n" + json.dumps(rec) + "\n"}))
+    (root / "MULTICHIP_r01.json").write_text(
+        json.dumps({"n_devices": 8, "rc": 0, "ok": True}))
+    host_loop = {
+        "ms_per_step_by_steps_per_call": {"1": 50.0, "8": 30.0},
+        "compile_ms_by_steps_per_call": {"1": 1000.0, "8": 1500.0},
+        "timed_builds_by_steps_per_call": {"1": 0, "8": 0},
+    }
+    (root / "baselines_out" / "host_loop_overhead.json").write_text(
+        json.dumps(host_loop))
+    lint = {"all_ok": True, "rows": [
+        {"name": "p1", "ok": True,
+         "rules": {"constant_bloat": {"ok": True, "module_bytes": 1000},
+                   "memory_budget": {"ok": True, "flops": 1e6,
+                                     "memory": {"peak_bytes": 5000}}}},
+        {"name": "control_x", "ok": True, "control": True, "rules": {}},
+    ]}
+    (root / "baselines_out" / "program_lint.json").write_text(
+        json.dumps(lint))
+
+    # no baseline yet -> distinct exit code with the --snapshot hint
+    assert perf_watch.main(["--root", str(root)]) == 2
+    assert perf_watch.main(["--root", str(root), "--snapshot"]) == 0
+    snap = json.loads(
+        (root / "baselines_out" / "perf_watch.json").read_text())
+    assert "bench.resnet_step.ms_per_step" in snap["metrics"]
+    assert "lint.p1.peak_bytes" in snap["metrics"]
+    assert "lint.control_x.peak_bytes" not in str(snap)  # controls excluded
+    assert perf_watch.main(["--root", str(root)]) == 0  # clean
+
+    # a later round 20% slower: nonzero exit, the metric is named
+    (root / "BENCH_r02.json").write_text(json.dumps(
+        {"n": 2, "rc": 0, "tail": json.dumps(dict(rec, value=120.0))}))
+    out = root / "report.json"
+    assert perf_watch.main(["--root", str(root), "--json", str(out)]) == 1
+    rep = json.loads(out.read_text())
+    assert [r["metric"] for r in rep["regressions"]] == \
+        ["bench.resnet_step.ms_per_step"]
+    assert rep["regressions"][0]["rel_change"] == pytest.approx(0.2)
+
+    # 20% faster: improvements never gate
+    (root / "BENCH_r02.json").write_text(json.dumps(
+        {"n": 2, "rc": 0, "tail": json.dumps(dict(rec, value=80.0))}))
+    assert perf_watch.main(["--root", str(root), "--json", str(out)]) == 0
+    rep = json.loads(out.read_text())
+    assert any(r["metric"] == "bench.resnet_step.ms_per_step"
+               for r in rep["improvements"])
+
+    # a peak-memory jump and a build inside the timed window both gate
+    lint["rows"][0]["rules"]["memory_budget"]["memory"]["peak_bytes"] = 9000
+    (root / "baselines_out" / "program_lint.json").write_text(
+        json.dumps(lint))
+    host_loop["timed_builds_by_steps_per_call"]["8"] = 1
+    (root / "baselines_out" / "host_loop_overhead.json").write_text(
+        json.dumps(host_loop))
+    assert perf_watch.main(["--root", str(root), "--json", str(out)]) == 1
+    regs = {r["metric"] for r in
+            json.loads(out.read_text())["regressions"]}
+    assert {"lint.p1.peak_bytes", "host_loop.cnn.k8_timed_builds"} <= regs
+
+
+def test_perf_watch_passes_on_committed_artifacts():
+    """The committed baselines_out/perf_watch.json snapshot must match the
+    committed round artifacts — the same gate a future round runs."""
+    from tools import perf_watch
+
+    assert perf_watch.main(["--root", REPO]) == 0
+
+
 def test_lm_lowering_audit_matches_r5_rung():
     """Drift guard (r5 review): the offline lowering audit hardcodes the
     lm_big rung shapes because the chain script cannot be edited while it

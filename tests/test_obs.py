@@ -1,4 +1,5 @@
-"""Telemetry spine (draco_tpu/obs + in-graph decode health, ISSUE 4).
+"""Telemetry spine (draco_tpu/obs + in-graph decode health, ISSUE 4) and
+the compile/retrace sentinel (obs/compile_watch.py, ISSUE 5).
 
 Unit layer: the span tracer emits valid Chrome trace events and is a strict
 no-op when disabled; the heartbeat folds per-step detection counts into
@@ -6,21 +7,34 @@ precision/recall and rewrites status.json atomically; MetricWriter buffers
 to flush/close boundaries; Segments times with a monotonic clock; the
 decode/vote health values are correct (and raise the fault signal beyond
 the locator budget) straight off the coding primitives; trace_report folds
-the artifacts. The integration layer — health columns flowing through both
-production loops, eager == chunked bitwise with telemetry enabled,
-trace.json/status.json from real runs — rides the existing K ∈ {1, 4}
-equivalence suites (tests/test_chunked_trainer.py,
+the artifacts; the compile sentinel attributes XLA executable builds to
+labelled dispatch windows, writes the compiles.jsonl ledger + trace compile
+lane, and its steady-state guard trips on a deliberately shape-polymorphic
+control. The integration layer — health columns flowing through both
+production loops, eager == chunked bitwise with telemetry enabled AND the
+compile guard in strict mode (steady-state recompiles == 0),
+trace.json/status.json/compiles.jsonl from real runs — rides the existing
+K ∈ {1, 4} equivalence suites (tests/test_chunked_trainer.py,
 tests/test_chunked_token_loop.py) so it costs no extra training runs.
 """
 
 import json
 import threading
+import warnings
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from draco_tpu.obs import NULL_TRACER, RunHeartbeat, SpanTracer
+from draco_tpu.obs import (
+    NULL_TRACER,
+    CompileWatch,
+    RetraceError,
+    RetraceWarning,
+    RunHeartbeat,
+    SpanTracer,
+)
 from draco_tpu.obs.tracer import NullTracer
 
 
@@ -318,6 +332,165 @@ def test_majority_vote_health():
 
 
 # --------------------------------------------------------------------------
+# CompileWatch — compile ledger + steady-state retrace guard (ISSUE 5)
+# --------------------------------------------------------------------------
+
+@pytest.mark.core
+def test_compile_watch_ledger_attribution_and_trace_lane(tmp_path):
+    """A labelled dispatch window's builds land in compiles.jsonl with the
+    program name and lowering seconds, unlabelled builds record with
+    program null, the tracer gets a compile-category lane event per build,
+    and the process-wide counters advance."""
+    from draco_tpu.obs.compile_watch import global_stats
+
+    tracer = SpanTracer(str(tmp_path / "trace.json"))
+    before = global_stats()
+    with CompileWatch(ledger_dir=str(tmp_path), tracer=tracer) as w:
+        f = jax.jit(lambda x: x * 3.0)
+        x = jnp.ones(7)  # utility fill build happens OUTSIDE the label
+        with w.expect("prog_a"):
+            f(x)
+        with w.expect("prog_a"):
+            f(x)  # warm: cached, no build
+        jax.jit(lambda x: x - 1.0)(x)  # unlabelled build
+    tracer.close()
+    after = global_stats()
+
+    assert w.builds >= 2 and after["builds"] - before["builds"] >= w.builds
+    assert w.steady_recompiles == 0
+    assert w.builds_by_program.get("prog_a", 0) >= 1
+    snap = w.snapshot()
+    assert snap["compiles"] == w.builds
+    assert snap["compile_s"] > 0 and snap["steady_recompiles"] == 0
+
+    rows = [json.loads(l) for l in open(tmp_path / "compiles.jsonl")]
+    assert len(rows) == w.builds
+    labelled = [r for r in rows if r["program"] == "prog_a"]
+    assert labelled and all(not r["steady_recompile"] for r in rows)
+    assert all(r.get("lower_s", 0) >= 0 for r in rows)
+    assert any(r["program"] is None for r in rows)  # the unlabelled builds
+
+    trace = json.load(open(tmp_path / "trace.json"))
+    compile_events = [e for e in trace["traceEvents"]
+                      if e.get("cat") == "compile"]
+    assert len(compile_events) == w.builds
+    assert any(e["args"]["program"] == "prog_a" for e in compile_events)
+    for e in compile_events:
+        assert e["ph"] == "X" and e["dur"] >= 0
+
+
+@pytest.mark.core
+def test_compile_watch_retrace_guard_trips_on_shape_polymorphic_control():
+    """The deliberately shape-polymorphic control: same label, new input
+    shape each dispatch. Strict mode raises at the dispatch site after the
+    warmup window; warn mode emits RetraceWarning and counts; a cold
+    window paying several sub-builds (the program + operand fills) is ONE
+    warmup unit and never trips."""
+    w = CompileWatch(guard="raise").start()
+    try:
+        f = jax.jit(lambda x: x * 2.0)
+        with w.expect("poly"):
+            f(jnp.ones(3))  # cold window: program + fill builds — warmup
+        with w.expect("poly"):
+            f(jnp.ones(3))  # warm window: no builds
+        assert w.steady_recompiles == 0
+        with pytest.raises(RetraceError, match="steady-state recompilation"):
+            with w.expect("poly"):
+                f(jnp.ones((4, 4)))  # the retrace
+        assert w.steady_recompiles == 1
+    finally:
+        w.stop()
+
+    w2 = CompileWatch(guard="warn").start()
+    try:
+        g = jax.jit(lambda x: x + 2.0)
+        with w2.expect("poly2"):
+            g(jnp.ones(2))
+        with warnings.catch_warnings(record=True) as rec:
+            warnings.simplefilter("always")
+            with w2.expect("poly2"):
+                g(jnp.ones((2, 2)))
+        assert any(issubclass(r.category, RetraceWarning) for r in rec)
+        assert w2.steady_recompiles >= 1
+    finally:
+        w2.stop()
+
+    # guard="off" records but never warns/raises; unlabelled builds are
+    # never guarded in any mode
+    w3 = CompileWatch(guard="off").start()
+    try:
+        h = jax.jit(lambda x: x - 2.0)
+        with w3.expect("poly3"):
+            h(jnp.ones(2))
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            with w3.expect("poly3"):
+                h(jnp.ones((3, 2)))
+            jax.jit(lambda x: x / 2.0)(jnp.ones(5))  # unlabelled
+        assert w3.steady_recompiles >= 1  # counted, silently
+    finally:
+        w3.stop()
+
+
+@pytest.mark.core
+def test_compile_watch_warmup_and_key_variants(tmp_path):
+    """``key`` separates legitimate shape variants (the chunked loops'
+    remainder chunks): each (name, key) label warms up independently. A
+    raised warmup budget allows that many compiling windows."""
+    w = CompileWatch(guard="raise").start()
+    try:
+        f = jax.jit(lambda x: x.sum())
+        with w.expect("many", key=4):
+            f(jnp.ones(4))
+        with w.expect("many", key=2):  # remainder chunk: its own warmup
+            f(jnp.ones(2))
+        assert w.steady_recompiles == 0
+        assert set(w.builds_by_program) >= {"many[4]", "many[2]"}
+    finally:
+        w.stop()
+
+    w2 = CompileWatch(guard="raise", warmup=2).start()
+    try:
+        g = jax.jit(lambda x: x.max())
+        with w2.expect("p"):
+            g(jnp.ones(3))
+        with w2.expect("p"):
+            g(jnp.ones((2, 3)))  # second compiling window: within warmup=2
+        assert w2.steady_recompiles == 0
+        with pytest.raises(RetraceError):
+            with w2.expect("p"):
+                g(jnp.ones((3, 3)))  # third: beyond warmup
+    finally:
+        w2.stop()
+
+
+@pytest.mark.core
+def test_make_compile_watch_construction_rule(tmp_path):
+    """Ledger goes next to the trace when tracing, else next to
+    metrics.jsonl; non-main processes never write a ledger; config
+    validates the guard mode."""
+    from draco_tpu.config import TrainConfig
+    from draco_tpu.obs import make_compile_watch
+
+    cfg = TrainConfig(trace_dir=str(tmp_path / "t"),
+                      train_dir=str(tmp_path / "d"))
+    w = make_compile_watch(cfg, NULL_TRACER, True)
+    assert w.path == str(tmp_path / "t" / "compiles.jsonl")
+    w.stop()
+    w = make_compile_watch(TrainConfig(train_dir=str(tmp_path / "d")),
+                           NULL_TRACER, True)
+    assert w.path == str(tmp_path / "d" / "compiles.jsonl")
+    w.stop()
+    w = make_compile_watch(cfg, NULL_TRACER, False)  # non-main process
+    assert w.path is None
+    w.stop()
+    with pytest.raises(ValueError, match="compile_guard"):
+        TrainConfig(compile_guard="explode").validate()
+    with pytest.raises(ValueError, match="guard"):
+        CompileWatch(guard="explode")
+
+
+# --------------------------------------------------------------------------
 # tools/trace_report.py
 # --------------------------------------------------------------------------
 
@@ -361,3 +534,48 @@ def test_trace_report_folds_trace_and_metrics(tmp_path, capsys):
     table = capsys.readouterr().out
     assert "dispatch" in table and "80.0%" in table
     assert json.load(open(out_json))["phases"]["gather"]["count"] == 1
+
+
+@pytest.mark.core
+def test_trace_report_tolerates_partial_artifacts(tmp_path, capsys):
+    """A killed run's leftovers must still fold: missing metrics.jsonl,
+    then an empty one, then one with a torn tail line — and the tracer's
+    droppedEvents count is surfaced in the header instead of silently
+    omitted (the trace is a sliding window when it's nonzero)."""
+    from tools.trace_report import main, make_report
+
+    events = [{"name": "dispatch", "ph": "X", "ts": 0.0, "dur": 1000.0,
+               "pid": 1, "tid": 1}]
+    (tmp_path / "trace.json").write_text(json.dumps(
+        {"traceEvents": events, "droppedEvents": 123}))
+
+    # missing metrics.jsonl
+    report = make_report(str(tmp_path / "trace.json"),
+                         str(tmp_path / "metrics.jsonl"))
+    assert "metrics" not in report
+    assert report["dropped_events"] == 123
+    rc = main([str(tmp_path)])
+    assert rc == 0
+    head = capsys.readouterr().out.splitlines()[0]
+    assert "DROPPED EVENTS: 123" in head
+
+    # empty metrics.jsonl
+    (tmp_path / "metrics.jsonl").write_text("")
+    report = make_report(str(tmp_path / "trace.json"),
+                         str(tmp_path / "metrics.jsonl"))
+    assert report["metrics"]["train_records"] == 0
+
+    # torn tail line (run killed mid-write) + blank lines
+    (tmp_path / "metrics.jsonl").write_text(
+        json.dumps({"step": 1, "loss": 2.0}) + "\n\n"
+        + '{"step": 2, "los')
+    report = make_report(str(tmp_path / "trace.json"),
+                         str(tmp_path / "metrics.jsonl"))
+    assert report["metrics"]["train_records"] == 1
+    # a clean trace reports dropped_events == 0 and no header warning
+    (tmp_path / "trace.json").write_text(json.dumps(
+        {"traceEvents": events}))
+    rc = main([str(tmp_path)])
+    assert rc == 0
+    head = capsys.readouterr().out.splitlines()[0]
+    assert "DROPPED" not in head

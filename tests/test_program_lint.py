@@ -103,6 +103,20 @@ def test_committed_artifact_is_consistent_with_registry():
         f"{sorted(missing)} — rerun tools/program_lint.py")
     controls = [r for r in report["rows"] if r.get("control")]
     assert {c["expected_fail"] for c in controls} == set(RULE_NAMES)
+    # every registered (non-control) row carries the memory/cost ledger
+    # columns the memory_budget rule records (ISSUE 5) — the round-over-
+    # round series tools/perf_watch.py diffs
+    for r in report["rows"]:
+        if r.get("control"):
+            continue
+        mb = r["rules"]["memory_budget"]
+        assert not mb.get("skipped"), (r["name"], mb)
+        mem = mb["memory"]
+        for col in ("argument_bytes", "output_bytes", "temp_bytes",
+                    "generated_code_bytes", "alias_bytes", "peak_bytes"):
+            assert isinstance(mem.get(col), int), (r["name"], col, mem)
+        assert mem["peak_bytes"] > 0
+        assert mb["flops"] > 0, (r["name"], mb)
 
 
 def test_bench_refuses_chip_run_on_lint_violation(tmp_path):

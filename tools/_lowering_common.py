@@ -56,7 +56,7 @@ def run_rows(out_path: str, method: str, named_rows, extra=None):
 
 
 def lint_row(program, extra_row=None):
-    """Run the five program-lint rules on a registered
+    """Run the six program-lint rules on a registered
     :class:`draco_tpu.analysis.LintProgram` and shape the result as a
     run_rows row: ``ok`` is the lint verdict, ``failed_rules``/``rules``
     carry the per-rule detail. The three lowering-check tools build their

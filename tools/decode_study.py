@@ -157,8 +157,8 @@ def main(argv=None) -> int:
             print(f"[decode_study] granularity={gran} full step ...",
                   file=sys.stderr, flush=True)
             try:
-                dt, _loss, _f = bench.run(kw, ds, mesh, args.steps,
-                                          warmup=1, reps=2)
+                dt, _loss, _f, _c = bench.run(kw, ds, mesh, args.steps,
+                                              warmup=1, reps=2)
                 report["granularity"][gran] = round(dt * 1e3, 3)
             except Exception as e:
                 report["granularity"][gran] = f"{type(e).__name__}: {e}"[:300]

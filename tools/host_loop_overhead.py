@@ -23,6 +23,17 @@ config/seed/steps. TransformerLM is matmul-dominated like FC, so the
 XLA:CPU scanned-conv caveat does not apply there either — the artifact
 records that directly (chunked vs eager on the same CPU mesh).
 
+Per K the artifact now records compile and steady-state wall SEPARATELY
+(ISSUE 5): the warmup pass's executable-build cost (lower + backend
+compile seconds observed via the compile sentinel's process-wide counters,
+obs/compile_watch.py ``global_stats``) lands in
+``compile_ms_by_steps_per_call`` while the timed pass remains pure
+steady-state — and ``timed_builds_by_steps_per_call`` records how many
+builds fired DURING the timed window (must be 0; anything else means the
+timed number silently included a retrace). That split is what makes the
+K-sweep comparable across rounds: tools/perf_watch.py diffs both series
+against the committed snapshot.
+
 Output: one JSON (default baselines_out/host_loop_overhead.json;
 --lm defaults to baselines_out/host_loop_overhead_lm.json).
 """
@@ -38,10 +49,38 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def _build_split(fn_warm, fn_timed):
+    """Run warmup then the timed section, splitting executable-build cost
+    (lower + backend compile seconds, process-wide jax.monitoring counters:
+    obs/compile_watch.global_stats) out of each: returns
+    ``(timed_result, {"compile_ms", "timed_builds", "timed_compile_ms"})``.
+    ``timed_builds`` must be 0 — a build inside the timed window means the
+    steady-state number silently absorbed a retrace."""
+    from draco_tpu.obs.compile_watch import global_stats, install
+
+    install()
+    t_start = global_stats()
+    fn_warm()
+    t_mid = global_stats()
+    result = fn_timed()
+    t_end = global_stats()
+
+    def cost_ms(a, b):
+        return round((b["lower_s"] - a["lower_s"]
+                      + b["compile_s"] - a["compile_s"]) * 1000.0, 1)
+
+    return result, {
+        "compile_ms": cost_ms(t_start, t_mid),
+        "timed_builds": t_end["builds"] - t_mid["builds"],
+        "timed_compile_ms": cost_ms(t_mid, t_end),
+    }
+
+
 def measure_loop(cfg_kwargs: dict, ds, mesh, warmup_steps: int,
-                 timed_steps: int) -> float:
-    """ms/step of Trainer.run over ``timed_steps`` steps, after a warmup run
-    that settles compilation (main chunk shape) and the prefetch pipeline."""
+                 timed_steps: int) -> "tuple[float, dict]":
+    """(ms/step, compile split) of Trainer.run over ``timed_steps`` steps,
+    after a warmup run that settles compilation (main chunk shape) and the
+    prefetch pipeline."""
     import jax
 
     from draco_tpu.config import TrainConfig
@@ -50,19 +89,25 @@ def measure_loop(cfg_kwargs: dict, ds, mesh, warmup_steps: int,
     cfg = TrainConfig(**cfg_kwargs)
     tr = Trainer(cfg, mesh=mesh, dataset=ds, quiet=True)
     try:
-        tr.run(max_steps=warmup_steps)
-        jax.block_until_ready(tr.state.params)
-        t0 = time.perf_counter()
-        tr.run(max_steps=warmup_steps + timed_steps)
-        jax.block_until_ready(tr.state.params)
-        return (time.perf_counter() - t0) / timed_steps * 1000.0
+        def warm():
+            tr.run(max_steps=warmup_steps)
+            jax.block_until_ready(tr.state.params)
+
+        def timed():
+            t0 = time.perf_counter()
+            tr.run(max_steps=warmup_steps + timed_steps)
+            jax.block_until_ready(tr.state.params)
+            return (time.perf_counter() - t0) / timed_steps * 1000.0
+
+        return _build_split(warm, timed)
     finally:
         tr.close()
 
 
 def measure_lm_loop(cfg_kwargs: dict, mesh, warmup_steps: int,
-                    timed_steps: int) -> float:
-    """ms/step of the production run_token_loop over ``timed_steps`` steps.
+                    timed_steps: int) -> "tuple[float, dict]":
+    """(ms/step, compile split) of the production run_token_loop over
+    ``timed_steps`` steps.
 
     A warmup pass on a deep-copied state settles compilation (the jitted
     programs are cached on the setup's callables, keyed by chunk shape), then
@@ -77,13 +122,20 @@ def measure_lm_loop(cfg_kwargs: dict, mesh, warmup_steps: int,
 
     cfg = TrainConfig(**cfg_kwargs)
     setup = build_tp_train_setup(cfg, mesh)
-    warm = setup._replace(state=jax.tree.map(jnp.copy, setup.state))
-    st, _ = run_token_loop(warm, cfg, steps=warmup_steps, quiet=True)
-    jax.block_until_ready(st.params)
-    t0 = time.perf_counter()
-    st, _ = run_token_loop(setup, cfg, steps=timed_steps, quiet=True)
-    jax.block_until_ready(st.params)
-    return (time.perf_counter() - t0) / timed_steps * 1000.0
+    warm_setup = setup._replace(state=jax.tree.map(jnp.copy, setup.state))
+
+    def warm():
+        st, _ = run_token_loop(warm_setup, cfg, steps=warmup_steps,
+                               quiet=True)
+        jax.block_until_ready(st.params)
+
+    def timed():
+        t0 = time.perf_counter()
+        st, _ = run_token_loop(setup, cfg, steps=timed_steps, quiet=True)
+        jax.block_until_ready(st.params)
+        return (time.perf_counter() - t0) / timed_steps * 1000.0
+
+    return _build_split(warm, timed)
 
 
 def main(argv=None) -> int:
@@ -175,16 +227,23 @@ def main(argv=None) -> int:
             "timed_steps": args.steps,
         }
 
-    rows = {}
+    rows, compile_rows, timed_builds = {}, {}, {}
     for k in ks:
         if args.lm:
-            ms = measure_lm_loop(dict(common, steps_per_call=k), mesh,
-                                 warmup_steps=k, timed_steps=args.steps)
+            ms, split = measure_lm_loop(dict(common, steps_per_call=k), mesh,
+                                        warmup_steps=k,
+                                        timed_steps=args.steps)
         else:
-            ms = measure_loop(dict(common, steps_per_call=k), ds, mesh,
-                              warmup_steps=k, timed_steps=args.steps)
+            ms, split = measure_loop(dict(common, steps_per_call=k), ds,
+                                     mesh, warmup_steps=k,
+                                     timed_steps=args.steps)
         rows[str(k)] = round(ms, 4)
-        print(f"K={k}: {ms:.3f} ms/step", flush=True)
+        compile_rows[str(k)] = split["compile_ms"]
+        timed_builds[str(k)] = split["timed_builds"]
+        print(f"K={k}: {ms:.3f} ms/step steady "
+              f"(compile {split['compile_ms']:.0f} ms in warmup, "
+              f"{split['timed_builds']} builds in the timed window)",
+              flush=True)
 
     eager = rows["1"]
     big_ks = [k for k in ks if k >= 8]
@@ -195,6 +254,12 @@ def main(argv=None) -> int:
         "mode": "lm_token_loop" if args.lm else "cnn_trainer",
         "config": cfg_report,
         "ms_per_step_by_steps_per_call": rows,
+        # compile vs steady-state split (ISSUE 5): warmup-pass executable
+        # build cost per K, and builds observed during the timed window
+        # (must be 0 — else ms/step silently absorbed a retrace); both are
+        # perf_watch series
+        "compile_ms_by_steps_per_call": compile_rows,
+        "timed_builds_by_steps_per_call": timed_builds,
         "eager_ms_per_step": eager,
         "best_chunked_k8plus_ms_per_step": best_big,
         "overhead_saved_ms_per_step": (

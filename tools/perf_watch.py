@@ -1,0 +1,334 @@
+#!/usr/bin/env python
+"""Perf-regression watch: fold the committed round artifacts into one flat
+metric set and diff it against the committed baseline snapshot.
+
+VERDICT r4's core complaint is that evidence does not accumulate across
+rounds: every BENCH_r*.json is a point measurement and nothing notices when
+a round's ms/step, module bytes, peak-memory estimate, or compile time
+quietly drifts from the last committed state. This tool is the accumulation
+point — jax-free (pure artifact folding, runs on a laptop against scp'd
+files), so it can gate a round without touching a backend:
+
+  python tools/perf_watch.py --snapshot        # (re)write the baseline
+                                               #   baselines_out/perf_watch.json
+  python tools/perf_watch.py                   # diff current artifacts vs
+                                               #   baseline; exit 1 on any
+                                               #   out-of-tolerance regression
+  python tools/perf_watch.py --json report.json
+
+Folded sources (all optional — a missing artifact folds nothing):
+
+  BENCH_r*.json                 driver bench records (the tail's last JSON
+                                line per metric, highest round wins):
+                                ms/step, vs_baseline ratio, flops/step, and
+                                the compile_ms field bench.py now records
+  MULTICHIP_r*.json             the multichip dry-run verdict (ok flag +
+                                device count)
+  baselines_out/host_loop_overhead*.json
+                                the K-sweep: eager & per-K steady-state
+                                ms/step, plus the compile-vs-steady split
+                                (compile_ms / timed-run builds per K)
+  baselines_out/program_lint.json
+                                per-program module bytes (constant_bloat
+                                rule) and the memory/cost ledger columns
+                                (memory_budget rule: peak_bytes, flops)
+
+Tolerances are per metric KIND (relative change vs baseline): time metrics
+default 10% (ms/step, a 20% regression trips loudly), bytes 10%, flops 2%
+(analytic flops should not drift at all without an algorithm change),
+ratios (higher-better) 10%, compile time 50% (host-load noisy), booleans 0
+(a multichip ok that goes false is always a regression). Improvements and
+new metrics are reported, never fatal; metrics that disappear are reported
+as missing (fatal only under --strict-missing, so artifact sets can evolve).
+
+Exit codes: 0 clean / snapshot written; 1 regression(s); 2 no baseline
+(run --snapshot first and commit it).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+SNAPSHOT_REL = os.path.join("baselines_out", "perf_watch.json")
+
+# metric kinds: comparison direction + default relative tolerance
+KINDS = {
+    "time_ms": {"dir": "lower_better", "tol": 0.10},
+    "compile_ms": {"dir": "lower_better", "tol": 0.50},
+    "bytes": {"dir": "lower_better", "tol": 0.10},
+    "flops": {"dir": "lower_better", "tol": 0.02},
+    "count": {"dir": "lower_better", "tol": 0.0},  # e.g. steady-state builds
+    "ratio": {"dir": "higher_better", "tol": 0.10},
+    "ok": {"dir": "higher_better", "tol": 0.0},
+}
+
+
+def _read_json(path):
+    try:
+        with open(path) as fh:
+            return json.load(fh)
+    except Exception:
+        return None
+
+
+def _tail_records(tail: str) -> list:
+    """The structured JSON lines a bench emitted into the driver tail."""
+    out = []
+    for line in (tail or "").splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            rec = json.loads(line)
+        except Exception:
+            continue
+        if isinstance(rec, dict) and "metric" in rec:
+            out.append(rec)
+    return out
+
+
+def _round_of(path: str):
+    m = re.search(r"_r(\d+)\.json$", os.path.basename(path))
+    return int(m.group(1)) if m else -1
+
+
+def fold_bench(root: str, metrics: dict) -> None:
+    """Latest round's record per bench metric name (the driver keeps the
+    tail line, so the LAST record in a tail is the most complete one)."""
+    latest: dict = {}  # metric name -> (round, record)
+    for path in sorted(glob.glob(os.path.join(root, "BENCH_r*.json"))):
+        data = _read_json(path)
+        if not isinstance(data, dict):
+            continue
+        rnd = _round_of(path)
+        for rec in _tail_records(data.get("tail", "")):
+            name = rec["metric"]
+            if name not in latest or rnd >= latest[name][0]:
+                latest[name] = (rnd, rec)
+    for name, (rnd, rec) in sorted(latest.items()):
+        src = f"BENCH_r{rnd:02d}"
+        extra = rec.get("extra") or {}
+        if isinstance(rec.get("value"), (int, float)):
+            metrics[f"bench.{name}.ms_per_step"] = {
+                "value": float(rec["value"]), "kind": "time_ms",
+                "source": src}
+        if isinstance(rec.get("vs_baseline"), (int, float)):
+            metrics[f"bench.{name}.vs_baseline"] = {
+                "value": float(rec["vs_baseline"]), "kind": "ratio",
+                "source": src}
+        if isinstance(extra.get("flops_per_step"), (int, float)):
+            metrics[f"bench.{name}.flops_per_step"] = {
+                "value": float(extra["flops_per_step"]), "kind": "flops",
+                "source": src}
+        if isinstance(extra.get("compile_ms"), (int, float)):
+            metrics[f"bench.{name}.compile_ms"] = {
+                "value": float(extra["compile_ms"]), "kind": "compile_ms",
+                "source": src}
+
+
+def fold_multichip(root: str, metrics: dict) -> None:
+    paths = sorted(glob.glob(os.path.join(root, "MULTICHIP_r*.json")),
+                   key=_round_of)
+    if not paths:
+        return
+    data = _read_json(paths[-1])
+    if not isinstance(data, dict):
+        return
+    src = os.path.basename(paths[-1]).rsplit(".", 1)[0]
+    if "ok" in data:
+        metrics["multichip.ok"] = {"value": float(bool(data["ok"])),
+                                   "kind": "ok", "source": src}
+    if isinstance(data.get("n_devices"), (int, float)):
+        metrics["multichip.n_devices"] = {
+            "value": float(data["n_devices"]), "kind": "ratio", "source": src}
+
+
+def fold_host_loop(root: str, metrics: dict) -> None:
+    for fname, mode in (("host_loop_overhead.json", "cnn"),
+                        ("host_loop_overhead_lm.json", "lm")):
+        path = os.path.join(root, "baselines_out", fname)
+        data = _read_json(path)
+        if not isinstance(data, dict):
+            continue
+        src = f"baselines_out/{fname}"
+        rows = data.get("ms_per_step_by_steps_per_call") or {}
+        for k, ms in sorted(rows.items(), key=lambda kv: int(kv[0])):
+            if isinstance(ms, (int, float)):
+                metrics[f"host_loop.{mode}.k{k}_ms_per_step"] = {
+                    "value": float(ms), "kind": "time_ms", "source": src}
+        for k, ms in sorted((data.get("compile_ms_by_steps_per_call")
+                             or {}).items(), key=lambda kv: int(kv[0])):
+            if isinstance(ms, (int, float)):
+                metrics[f"host_loop.{mode}.k{k}_compile_ms"] = {
+                    "value": float(ms), "kind": "compile_ms", "source": src}
+        for k, n in sorted((data.get("timed_builds_by_steps_per_call")
+                            or {}).items(), key=lambda kv: int(kv[0])):
+            if isinstance(n, (int, float)):
+                # steady-state executable builds during the timed window —
+                # must stay 0; any growth is a retrace regression
+                metrics[f"host_loop.{mode}.k{k}_timed_builds"] = {
+                    "value": float(n), "kind": "count", "source": src}
+
+
+def fold_program_lint(root: str, metrics: dict) -> None:
+    path = os.path.join(root, "baselines_out", "program_lint.json")
+    data = _read_json(path)
+    if not isinstance(data, dict):
+        return
+    src = "baselines_out/program_lint.json"
+    if "all_ok" in data:
+        metrics["lint.all_ok"] = {"value": float(bool(data["all_ok"])),
+                                  "kind": "ok", "source": src}
+    for row in data.get("rows", []):
+        if row.get("control"):
+            continue
+        name = row.get("name")
+        rules = row.get("rules") or {}
+        module_bytes = (rules.get("constant_bloat") or {}).get("module_bytes")
+        if isinstance(module_bytes, (int, float)):
+            metrics[f"lint.{name}.module_bytes"] = {
+                "value": float(module_bytes), "kind": "bytes", "source": src}
+        mem = (rules.get("memory_budget") or {}).get("memory") or {}
+        if isinstance(mem.get("peak_bytes"), (int, float)):
+            metrics[f"lint.{name}.peak_bytes"] = {
+                "value": float(mem["peak_bytes"]), "kind": "bytes",
+                "source": src}
+        flops = (rules.get("memory_budget") or {}).get("flops")
+        if isinstance(flops, (int, float)):
+            metrics[f"lint.{name}.flops"] = {
+                "value": float(flops), "kind": "flops", "source": src}
+
+
+def fold_all(root: str) -> dict:
+    metrics: dict = {}
+    fold_bench(root, metrics)
+    fold_multichip(root, metrics)
+    fold_host_loop(root, metrics)
+    fold_program_lint(root, metrics)
+    return metrics
+
+
+def compare(baseline: dict, current: dict, tols: dict) -> dict:
+    """Per-metric verdicts. A metric regresses when its relative change in
+    the kind's bad direction exceeds the kind's tolerance."""
+    regressions, improvements, unchanged, missing, new = [], [], [], [], []
+    for name in sorted(set(baseline) | set(current)):
+        if name not in baseline:
+            new.append({"metric": name, **current[name]})
+            continue
+        if name not in current:
+            missing.append({"metric": name, **baseline[name]})
+            continue
+        base, cur = baseline[name], current[name]
+        kind = cur.get("kind", base.get("kind", "time_ms"))
+        spec = KINDS.get(kind, KINDS["time_ms"])
+        tol = tols.get(kind, spec["tol"])
+        b, c = float(base["value"]), float(cur["value"])
+        if b == 0.0:
+            rel = 0.0 if c == 0.0 else float("inf") * (1 if c > 0 else -1)
+        else:
+            rel = (c - b) / abs(b)
+        bad = rel > tol if spec["dir"] == "lower_better" else rel < -tol
+        good = rel < -tol if spec["dir"] == "lower_better" else rel > tol
+        row = {"metric": name, "kind": kind, "baseline": b, "current": c,
+               "rel_change": (round(rel, 4) if rel == rel
+                              and abs(rel) != float("inf") else None),
+               "tolerance": tol}
+        (regressions if bad else improvements if good else unchanged
+         ).append(row)
+    return {"regressions": regressions, "improvements": improvements,
+            "unchanged": unchanged, "missing": missing, "new": new,
+            "ok": not regressions}
+
+
+def _print_report(cmp_report: dict, out=None) -> None:
+    out = out if out is not None else sys.stdout  # resolve at call time
+
+    def show(rows, tag):
+        for r in rows:
+            rel = r["rel_change"]
+            # rel is None when the baseline was 0 (e.g. timed_builds going
+            # 0 -> 1): an infinite relative change, not a no-op
+            pct = ("inf%" if rel is None
+                   else f"{'+' if rel >= 0 else ''}{rel * 100:.1f}%")
+            print(f"  [{tag}] {r['metric']} ({r['kind']}): "
+                  f"{r['baseline']:g} -> {r['current']:g} "
+                  f"({pct} vs tol {r['tolerance'] * 100:.0f}%)", file=out)
+
+    print(f"perf_watch: {len(cmp_report['regressions'])} regression(s), "
+          f"{len(cmp_report['improvements'])} improvement(s), "
+          f"{len(cmp_report['unchanged'])} unchanged, "
+          f"{len(cmp_report['missing'])} missing, "
+          f"{len(cmp_report['new'])} new", file=out)
+    show(cmp_report["regressions"], "REGRESSION")
+    show(cmp_report["improvements"], "improved")
+    for r in cmp_report["missing"]:
+        print(f"  [missing] {r['metric']} (was {r['value']:g}, "
+              f"{r['source']})", file=out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--root", type=str, default=".",
+                    help="repo root holding BENCH_r*.json / baselines_out/")
+    ap.add_argument("--baseline", type=str, default="",
+                    help=f"baseline snapshot (default <root>/{SNAPSHOT_REL})")
+    ap.add_argument("--snapshot", action="store_true",
+                    help="write the current fold as the new baseline "
+                         "snapshot instead of comparing")
+    ap.add_argument("--json", type=str, default="",
+                    help="also write the comparison report as JSON here")
+    ap.add_argument("--tol-time", type=float, default=KINDS["time_ms"]["tol"])
+    ap.add_argument("--tol-bytes", type=float, default=KINDS["bytes"]["tol"])
+    ap.add_argument("--tol-flops", type=float, default=KINDS["flops"]["tol"])
+    ap.add_argument("--tol-compile", type=float,
+                    default=KINDS["compile_ms"]["tol"])
+    ap.add_argument("--tol-ratio", type=float, default=KINDS["ratio"]["tol"])
+    ap.add_argument("--strict-missing", action="store_true",
+                    help="treat metrics that disappeared from the artifacts "
+                         "as regressions")
+    args = ap.parse_args(argv)
+
+    baseline_path = args.baseline or os.path.join(args.root, SNAPSHOT_REL)
+    current = fold_all(args.root)
+
+    if args.snapshot:
+        payload = {
+            "schema": 1,
+            "tool": "tools/perf_watch.py --snapshot",
+            "metrics": current,
+        }
+        os.makedirs(os.path.dirname(baseline_path) or ".", exist_ok=True)
+        with open(baseline_path, "w") as fh:
+            json.dump(payload, fh, indent=1, sort_keys=True)
+        print(f"perf_watch: snapshot of {len(current)} metrics -> "
+              f"{baseline_path}")
+        return 0
+
+    snap = _read_json(baseline_path)
+    if not isinstance(snap, dict) or "metrics" not in snap:
+        print(f"perf_watch: no baseline snapshot at {baseline_path} — run "
+              f"`python tools/perf_watch.py --snapshot` and commit it",
+              file=sys.stderr)
+        return 2
+
+    tols = {"time_ms": args.tol_time, "bytes": args.tol_bytes,
+            "flops": args.tol_flops, "compile_ms": args.tol_compile,
+            "ratio": args.tol_ratio}
+    report = compare(snap["metrics"], current, tols)
+    if args.strict_missing and report["missing"]:
+        report["ok"] = False
+    _print_report(report)
+    if args.json:
+        with open(args.json, "w") as fh:
+            json.dump(report, fh, indent=1)
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

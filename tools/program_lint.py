@@ -1,15 +1,20 @@
 #!/usr/bin/env python
 """Repo-wide static audit of every registered chip-bound program.
 
-Runs the five lint rules (draco_tpu/analysis/rules.py: constant_bloat,
-donation, dtype, collectives, host_traffic) against every program in the
-registry (draco_tpu/analysis/registry.py — the coded-DP CNN
+Runs the six lint rules (draco_tpu/analysis/rules.py: constant_bloat,
+donation, dtype, collectives, host_traffic, memory_budget) against every
+program in the registry (draco_tpu/analysis/registry.py — the coded-DP CNN
 train_step/train_many and all five LM token routes including the K-fused
 scan drivers), on the CPU-host mesh via the cross-platform-export
-methodology of the lowering-check tools. Then runs the five seeded-defect
+methodology of the lowering-check tools. Then runs the six seeded-defect
 NEGATIVE CONTROLS (analysis/controls.py); a control row is ``ok`` iff it
 trips exactly its rule — a linter that stops seeing defects fails its own
 artifact.
+
+The memory_budget rows double as the per-program memory/cost LEDGER
+(argument/output/temp/generated-code bytes, peak estimate, analytic
+flops): the committed artifact is what tools/perf_watch.py diffs
+round-over-round (PERF.md §8).
 
   python tools/program_lint.py [--out baselines_out/program_lint.json]
       [--fast] [--programs name,name] [--skip-controls]
@@ -85,10 +90,11 @@ def main(argv=None) -> int:
 
     report = run_rows(
         args.out,
-        "five static rules (constant_bloat, donation, dtype, collectives, "
-        "host_traffic) over jit.trace jaxprs + jax.export StableHLO on the "
-        "CPU-host mesh; rows named control_* are seeded-defect negative "
-        "controls whose ok means 'tripped exactly its rule'",
+        "six static rules (constant_bloat, donation, dtype, collectives, "
+        "host_traffic, memory_budget) over jit.trace jaxprs + jax.export "
+        "StableHLO + compiled memory/cost analysis on the CPU-host mesh; "
+        "rows named control_* are seeded-defect negative controls whose ok "
+        "means 'tripped exactly its rule'",
         named,
         extra={"fast": args.fast, "devices": args.devices,
                "rules": list(RULE_NAMES)},

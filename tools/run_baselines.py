@@ -107,7 +107,7 @@ def main(argv=None) -> int:
                     import bench as bench_mod
 
                     steps = min(args.scan_steps, cfg.max_steps)
-                    dt, loss, flops = bench_mod.run(
+                    dt, loss, flops, _compile_s = bench_mod.run(
                         dataclasses.asdict(cfg), ds, make_mesh(cfg.num_workers),
                         steps, warmup=1, reps=2, want_flops=True,
                     )

@@ -78,7 +78,10 @@ def main(argv=None) -> int:
                 )(q, k, v))
             else:
                 f = jax.jit(fn)
-            return BuiltProgram(name, f, (q, q, q), None, kernel_manifest)
+            # Pallas tpu_custom_call cannot compile for the CPU backend —
+            # skip the memory capture instead of paying a guaranteed failure
+            return BuiltProgram(name, f, (q, q, q), None, kernel_manifest,
+                                capture_memory=False)
 
         return LintProgram(name=name, build=build, route="attn_kernel",
                            fast=False)

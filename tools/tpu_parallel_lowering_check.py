@@ -43,7 +43,7 @@ def axis_program(name, tag, overrides, collectives, w=8):
     audited program is the route builder's own donated ``train_step`` (the
     old bespoke thunk re-wrapped it in a fresh jit, which dropped the
     donation attrs — the lint donation rule needs the real program), and
-    the row carries the five-rule verdict including the axis's explicit
+    the row carries the six-rule verdict including the axis's explicit
     collective budget: the ring/pipeline hop structure IS the row's claim,
     so count drift fails the audit even when lowering succeeds."""
     from draco_tpu.analysis import BuiltProgram, LintProgram, Manifest
@@ -125,7 +125,7 @@ def main(argv=None) -> int:
         "jax.export cross-platform lowering, platforms=['tpu'], 16 virtual "
         "CPU devices, w=8 cyclic s=1 coded DP x axis2=2, the route "
         "builders' own donated train_step programs; each row carries the "
-        "five-rule program-lint verdict incl. the axis's explicit "
+        "six-rule program-lint verdict incl. the axis's explicit "
         "collective budget (draco_tpu/analysis)",
         named,
     )

@@ -32,8 +32,9 @@ def step_ms(cfg_kwargs, ds, mesh, steps=10, reps=2):
     """Scanned whole-train-step timing (same protocol as bench.run)."""
     import bench
 
-    dt, loss, flops = bench.run(cfg_kwargs, ds, mesh, steps, warmup=1,
-                                reps=reps, want_flops=True)
+    dt, loss, flops, _compile_s = bench.run(cfg_kwargs, ds, mesh, steps,
+                                            warmup=1, reps=reps,
+                                            want_flops=True)
     return dt * 1e3, flops
 
 

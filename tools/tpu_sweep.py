@@ -98,9 +98,10 @@ def main(argv=None) -> int:
             print(f"[tpu_sweep] {label} ...", file=sys.stderr, flush=True)
             t0 = time.time()
             try:
-                dt, loss, flops = bench.run(kw, ds, mesh, args.steps,
-                                            warmup=1, reps=2,
-                                            want_flops=True)
+                dt, loss, flops, compile_s = bench.run(kw, ds, mesh,
+                                                       args.steps, warmup=1,
+                                                       reps=2,
+                                                       want_flops=True)
             except Exception as e:
                 print(f"[tpu_sweep] {label} FAILED: {type(e).__name__}: {e}",
                       file=sys.stderr, flush=True)
@@ -119,6 +120,7 @@ def main(argv=None) -> int:
             pt = {
                 "label": label, "batch": bs, "dtype": dtype,
                 "step_ms": round(dt * 1e3, 3),
+                "compile_ms": round(compile_s * 1e3, 1),
                 "flops_per_step": flops,
                 "mfu_vs_bf16_peak": round(mfu, 4) if mfu else None,
                 "examples_per_s": round(bs * args.num_workers / dt, 1),

@@ -16,7 +16,11 @@ answers the question the chunked regime's dark host otherwise hides: how
 much of a chunk's wall-clock was host work vs device execution.
 
 No jax import — this is a pure-host artifact folder usable on a laptop
-against artifacts scp'd from a chip job.
+against artifacts scp'd from a chip job. It tolerates the partial-artifact
+states a killed run leaves behind (missing/empty metrics.jsonl, a torn
+JSONL tail) and surfaces the tracer's top-level ``droppedEvents`` count in
+the header — a long run's trace is a sliding window of its newest spans,
+and a report that hid the drop count would present the window as the run.
 """
 
 from __future__ import annotations
@@ -28,14 +32,19 @@ import os
 import sys
 
 
-def load_trace(path: str) -> list:
+def load_trace(path: str) -> "tuple[list, int]":
+    """(events, droppedEvents). The tracer's bounded buffer drops the
+    oldest spans on very long runs and records the count top-level
+    (obs/tracer.py); a report that hid it would present a sliding window
+    as the whole run."""
     with open(path) as fh:
         payload = json.load(fh)
-    events = payload.get("traceEvents", payload if isinstance(payload, list)
-                         else [])
+    if isinstance(payload, list):  # bare event-array form of the format
+        return payload, 0
+    events = payload.get("traceEvents", [])
     if not isinstance(events, list):
         raise SystemExit(f"{path}: no traceEvents array")
-    return events
+    return events, int(payload.get("droppedEvents", 0) or 0)
 
 
 def fold_spans(events: list) -> "tuple[dict, float]":
@@ -80,13 +89,23 @@ def fold_counters(events: list) -> dict:
 def fold_metrics(path: str) -> dict:
     """Step count + summed per-step segment seconds from metrics.jsonl
     (t_fetch/t_comp are per-step amortized values, so their sums are the
-    regime's host-gather and device-execution wall respectively)."""
+    regime's host-gather and device-execution wall respectively). Blank or
+    torn lines are skipped — a run killed mid-write must not take the
+    report down with it."""
     steps = 0
     sums = collections.defaultdict(float)
     first = last = None
     with open(path) as fh:
         for line in fh:
-            rec = json.loads(line)
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue  # torn tail line of an interrupted run
+            if not isinstance(rec, dict):
+                continue
             if "loss" not in rec or rec.get("split") == "eval":
                 continue
             steps += 1
@@ -105,11 +124,12 @@ def fold_metrics(path: str) -> dict:
 
 
 def make_report(trace_path: str, metrics_path=None) -> dict:
-    events = load_trace(trace_path)
+    events, dropped = load_trace(trace_path)
     phases, wall_ms = fold_spans(events)
     report = {
         "trace": trace_path,
         "traced_wall_ms": round(wall_ms, 3),
+        "dropped_events": dropped,
         "phases": {
             name: {k: (round(v, 3) if isinstance(v, float) else v)
                    for k, v in row.items()}
@@ -117,15 +137,27 @@ def make_report(trace_path: str, metrics_path=None) -> dict:
         },
         "counters": fold_counters(events),
     }
+    # a missing or empty metrics.jsonl is a normal state (no train_dir, or
+    # a run killed before its first flush) — the trace half still folds
     if metrics_path and os.path.exists(metrics_path):
-        report["metrics"] = fold_metrics(metrics_path)
-        report["metrics"]["path"] = metrics_path
+        try:
+            report["metrics"] = fold_metrics(metrics_path)
+            report["metrics"]["path"] = metrics_path
+        except OSError:
+            pass
     return report
 
 
-def print_table(report: dict, out=sys.stdout) -> None:
+def print_table(report: dict, out=None) -> None:
+    # resolve stdout at call time: a default bound at import time pins
+    # whatever stream was installed then (pytest capture, a redirect) and
+    # outlives it
+    out = out if out is not None else sys.stdout
+    dropped = report.get("dropped_events", 0)
     print(f"trace: {report['trace']}   traced wall: "
-          f"{report['traced_wall_ms']:.1f} ms", file=out)
+          f"{report['traced_wall_ms']:.1f} ms"
+          + (f"   DROPPED EVENTS: {dropped} (sliding window — totals "
+             f"undercount the run)" if dropped else ""), file=out)
     hdr = f"{'phase':<22}{'count':>7}{'total ms':>12}{'mean ms':>10}" \
           f"{'max ms':>10}{'share':>8}"
     print(hdr, file=out)
