@@ -169,6 +169,41 @@ def add_fit_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                    help="XLA builds allowed per registered program (per "
                         "chunk shape) before the compile guard treats a "
                         "build as a steady-state recompilation")
+    # resilience layer (draco_tpu/resilience; ISSUE 6)
+    p.add_argument("--step-guard", type=str, default="off",
+                   choices=["off", "on"],
+                   help="in-graph step guard (resilience/guards.py): fold "
+                        "decode-health signals + a global-finite check and "
+                        "SKIP untrusted optimizer updates via branch-free "
+                        "carry passthrough; emits guard_trips/"
+                        "skipped_steps metric columns at zero extra device "
+                        "fetches. Bitwise-transparent on clean steps")
+    p.add_argument("--guard-residual-tol", type=float, default=1e-3,
+                   help="decode_residual above this is a guard trip "
+                        "(clean decodes sit at f32 solve noise ~1e-6)")
+    p.add_argument("--fault-spec", type=str, default="",
+                   help="deterministic fault-injection plan "
+                        "(resilience/faults.py): comma-separated "
+                        "'kind@step[:w<worker>][:d<seconds>]' events — "
+                        "nan_grad/inf_grad/over_budget in-graph, "
+                        "prefetch_crash/prefetch_hang/sigterm on the host; "
+                        "tools/chaos_run.py drives the full matrix")
+    p.add_argument("--prefetch-timeout", type=float, default=300.0,
+                   dest="prefetch_timeout_s", metavar="SECONDS",
+                   help="bound on a token-prefetch worker-thread queue "
+                        "wait (0 = wait forever): a dead/hung worker "
+                        "raises the named PrefetchStallError instead of "
+                        "wedging the main loop (the CNN prefetchers' "
+                        "native gather surfaces failures synchronously)")
+    p.add_argument("--prefetch-restarts", type=int, default=2,
+                   help="bounded prefetcher supervision: on a worker "
+                        "exception/stall, abandon + rebuild the prefetcher "
+                        "with exponential backoff up to N times before the "
+                        "error propagates (0 disables)")
+    p.add_argument("--keep-checkpoints", type=int, default=0, metavar="N",
+                   help="retain-last-N checkpoint GC after every save (0 = "
+                        "keep all, the historical behavior); the newest "
+                        "checkpoint always survives")
     return p
 
 
@@ -179,13 +214,17 @@ def maybe_force_cpu_mesh(args: argparse.Namespace) -> None:
     bench.py routes through here so cache policy lives in one place.
 
     The cache is skipped when an explicit CPU mode is requested
-    (--cpu-mesh / --cpu-interpret: CI smokes, where cache churn is waste).
+    (--cpu-mesh / --cpu-interpret: CI smokes, where cache churn is waste)
+    or when JAX_PLATFORMS=cpu is set (enable_compile_cache refuses there:
+    cache-built XLA:CPU executables corrupt donated carries, PERF.md §9).
     It is NOT gated on the resolved backend — probing that here would
     initialize jax in-process, the exact ~25-minute wedge bench.py's
-    subprocess probes exist to avoid — so a flagless run that lands on CPU
-    does cache XLA:CPU results; that is safe because enable_compile_cache
-    scopes entries by a host-microarch fingerprint (foreign feature-pinned
-    CPU AOT reloads are the SIGILL hazard)."""
+    subprocess probes exist to avoid — so a flagless run that silently
+    FALLS BACK to CPU still caches XLA:CPU results and is exposed to the
+    §9 donated-carry corruption; prefer an explicit --cpu-mesh (or
+    JAX_PLATFORMS=cpu) whenever CPU execution is the intent. The
+    microarch-fingerprint cache scoping separately guards against foreign
+    feature-pinned CPU AOT reloads (the SIGILL hazard)."""
     if not (getattr(args, "cpu_mesh", 0) or getattr(args, "cpu_interpret", False)):
         from draco_tpu.runtime import enable_compile_cache
 
@@ -236,6 +275,12 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         trace_dir=args.trace_dir,
         compile_guard=args.compile_guard,
         compile_warmup=args.compile_warmup,
+        step_guard=args.step_guard,
+        guard_residual_tol=args.guard_residual_tol,
+        fault_spec=args.fault_spec,
+        prefetch_timeout_s=args.prefetch_timeout_s,
+        prefetch_restarts=args.prefetch_restarts,
+        keep_checkpoints=args.keep_checkpoints,
         remat=args.remat,
         eval_freq=args.eval_freq,
         train_dir=args.train_dir,

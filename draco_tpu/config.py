@@ -137,7 +137,10 @@ class TrainConfig:
     # --- eval / checkpoint (reference: distributed_nn.py:56-75) ---
     eval_freq: int = 50
     train_dir: str = "./train_out/"
-    checkpoint_step: int = 0  # resume from this step if >0
+    # resume from this step if >0; -1 resumes from the NEWEST loadable
+    # checkpoint in train_dir (corrupt ones are skipped — the automatic
+    # walk-back of resilience/supervisor.restore_with_walkback)
+    checkpoint_step: int = 0
     # write checkpoints as shuffled-deflate .dcg archives instead of Orbax
     # dirs — the descendant of the reference's --compress-grad wire toggle
     # (compress_gradient.py:7-15), for train_dirs crossing a slow link.
@@ -201,6 +204,51 @@ class TrainConfig:
     # K∈{1,4} equivalence suites run under), "off" records only.
     compile_guard: str = "warn"
     compile_warmup: int = 1
+
+    # --- resilience (draco_tpu/resilience; ISSUE 6) ---
+    # In-graph step guard: fold the decode-health signals (loud
+    # decode_residual, located rows beyond the s budget, vote disagreement
+    # past budget) with a global-finite check on the aggregated gradient
+    # and SKIP the optimizer update via branchless carry passthrough when a
+    # step is untrusted (resilience/guards.py). The guard emits
+    # guard_trips/skipped_steps metric columns riding the existing (K, m)
+    # block — zero extra device fetches, zero retraces (the guard is
+    # config-static). "off" keeps today's unguarded update bit-for-bit;
+    # "on" is bitwise identical on clean steps (jnp.where select) and the
+    # bounded-degradation posture under faults the code does not model
+    # (non-finite gradients from faulty-but-honest workers, beyond-budget
+    # corruption — the Stochastic Gradient Coding framing, PAPERS.md).
+    step_guard: str = "off"
+    # decode_residual above this is "loud" (clean decodes sit at f32 solve
+    # noise, ~1e-6 relative; a mislocated beyond-budget decode is O(1))
+    guard_residual_tol: float = 1e-3
+    # Deterministic fault-injection plan (resilience/faults.py): comma-
+    # separated "kind@step[:w<worker>][:d<seconds>]" events, same seeded
+    # discipline as the adversary schedules. In-graph kinds (nan_grad /
+    # inf_grad / over_budget) corrupt the step inputs; host kinds
+    # (prefetch_crash / prefetch_hang / sigterm) fire in the host loop.
+    # "" (default) injects nothing and compiles the exact unfaulted
+    # programs. tools/chaos_run.py drives the fault × loop matrix.
+    fault_spec: str = ""
+    # Bound on a worker-THREAD prefetch queue wait (seconds; 0 disables):
+    # a dead/hung token-prefetch worker (TokenChunkPrefetcher — the one
+    # prefetcher whose assembly runs user code on a thread) raises the
+    # named PrefetchStallError instead of blocking the main loop forever
+    # (data/prefetch.py). The CNN prefetchers' native row gather has no
+    # bounded-wait API; its failures surface synchronously as exceptions,
+    # which the same supervision retries.
+    prefetch_timeout_s: float = 300.0
+    # Bounded prefetcher supervision (resilience/supervisor.py): on a
+    # worker-thread exception or stall the prefetcher is abandoned and
+    # rebuilt with exponential backoff, up to this many restarts per
+    # request before the error propagates. 0 disables supervision.
+    prefetch_restarts: int = 2
+    # Retain-last-N checkpoint GC (utils/checkpoint.py gc_checkpoints):
+    # after each save, delete all but the newest N checkpoints in
+    # train_dir. 0 (default) keeps everything (current behavior); GC never
+    # deletes the newest checkpoint. N >= 2 leaves the corrupt-newest
+    # walk-back (checkpoint_step=-1) an older checkpoint to fall back to.
+    keep_checkpoints: int = 0
 
     # --- misc ---
     seed: int = SEED
@@ -319,6 +367,40 @@ class TrainConfig:
             raise ValueError(
                 f"compile_warmup must be >= 0, got {self.compile_warmup}"
             )
+        if self.step_guard not in ("off", "on"):
+            raise ValueError(
+                f"step_guard must be off|on, got {self.step_guard!r}"
+            )
+        if self.guard_residual_tol <= 0:
+            raise ValueError(
+                f"guard_residual_tol must be > 0, got "
+                f"{self.guard_residual_tol}"
+            )
+        if self.prefetch_timeout_s < 0:
+            raise ValueError(
+                f"prefetch_timeout_s must be >= 0, got "
+                f"{self.prefetch_timeout_s}"
+            )
+        if self.prefetch_restarts < 0:
+            raise ValueError(
+                f"prefetch_restarts must be >= 0, got "
+                f"{self.prefetch_restarts}"
+            )
+        if self.keep_checkpoints < 0:
+            raise ValueError(
+                f"keep_checkpoints must be >= 0, got {self.keep_checkpoints}"
+            )
+        if self.checkpoint_step < -1:
+            raise ValueError(
+                "checkpoint_step must be >= -1 (-1 resumes from the newest "
+                f"loadable checkpoint), got {self.checkpoint_step}"
+            )
+        if self.fault_spec:
+            # parse errors surface here (config time), not mid-run; the
+            # parsed plan itself is rebuilt (cached) where it is consumed
+            from draco_tpu.resilience.faults import FaultPlan
+
+            FaultPlan.parse(self.fault_spec, self.seed, self.num_workers)
         if self.straggle_mode not in ("none", "drop"):
             raise ValueError(f"unknown straggle_mode: {self.straggle_mode}")
         if self.decode_granularity not in ("global", "layer"):

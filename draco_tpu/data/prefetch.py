@@ -23,6 +23,25 @@ from draco_tpu.data.datasets import Dataset
 from draco_tpu.obs.tracer import NULL_TRACER
 
 
+class PrefetchStallError(RuntimeError):
+    """A prefetcher queue wait exceeded its bound — the worker thread is
+    dead or hung. Named (instead of blocking the main loop forever) so the
+    supervisor can restart the prefetcher (resilience/supervisor.py) and
+    operators can tell a stalled data path from a wedged device. Carries
+    the stalled request, the timeout, and the tracer's last recorded span
+    (the best available 'what was the worker doing' breadcrumb)."""
+
+    def __init__(self, request, timeout_s: float, last_span=None):
+        super().__init__(
+            f"prefetch wait for request {request!r} exceeded "
+            f"{timeout_s:g}s (worker thread dead or hung; last tracer "
+            f"span: {last_span!r})"
+        )
+        self.request = request
+        self.timeout_s = timeout_s
+        self.last_span = last_span
+
+
 class _PipelinedGather:
     """Submit/wait scaffolding shared by both prefetchers, keyed on an
     opaque hashable request (a step int, or a (start, k) chunk range).
@@ -90,6 +109,18 @@ class _PipelinedGather:
             )
         tracer.counter("prefetch_depth", self.depth)
         return batch
+
+    def abandon(self):
+        """Supervisor restart path: drop any in-flight request and release
+        the loader best-effort (never raising — the instance is being
+        replaced, not drained)."""
+        self._inflight = None
+        loader, self._loader = self._loader, None
+        if loader is not None:
+            try:
+                loader.close()
+            except Exception:
+                pass
 
     def close(self):
         if self._loader is not None:
@@ -181,11 +212,15 @@ class TokenChunkPrefetcher:
     """
 
     def __init__(self, gen_fn: Callable[[int], np.ndarray],
-                 tracer=NULL_TRACER):
+                 tracer=NULL_TRACER, timeout_s: float = 0.0):
         import concurrent.futures
 
         self._gen = gen_fn
         self._tracer = tracer
+        # bound on any single queue wait (0 = wait forever, the historical
+        # behavior): a dead/hung worker raises the named PrefetchStallError
+        # instead of wedging the main loop (ISSUE 6 satellite)
+        self._timeout_s = float(timeout_s)
         self._pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="token-chunk-prefetch",
             # labels the worker's trace lane (runs once, on the worker
@@ -193,6 +228,22 @@ class TokenChunkPrefetcher:
             initializer=lambda: tracer.name_thread("token-chunk-prefetch"),
         )
         self._inflight: Optional[tuple] = None  # (range, future)
+        self._stalled = False  # a stall was observed: never join this pool
+
+    def _wait(self, rng: tuple, future):
+        """Bounded wait on a worker future; a worker exception propagates
+        as itself (concurrent.futures re-raises it here, on the main
+        thread), a timeout becomes the named stall error."""
+        import concurrent.futures
+
+        try:
+            return future.result(self._timeout_s or None)
+        except concurrent.futures.TimeoutError:
+            # the worker is hung; remember it so close() abandons instead
+            # of re-wedging the loop on shutdown(wait=True)
+            self._stalled = True
+            raise PrefetchStallError(rng, self._timeout_s,
+                                     self._tracer.last_span) from None
 
     @property
     def depth(self) -> int:
@@ -210,21 +261,48 @@ class TokenChunkPrefetcher:
         rng = tuple(rng)
         if self._inflight is not None and self._inflight[0] == rng:
             with self._tracer.span("prefetch.wait"):
-                block = self._inflight[1].result()
-            self._inflight = None
+                inflight, self._inflight = self._inflight, None
+                block = self._wait(rng, inflight[1])
         else:  # cold start / non-sequential access (e.g. resume)
             if self._inflight is not None:
-                self._inflight[1].result()
-                self._inflight = None
-            block = self._assemble(rng)
+                inflight, self._inflight = self._inflight, None
+                self._wait(inflight[0], inflight[1])
+            # cold-start assembly ALSO runs on the worker under the bounded
+            # wait: assembling inline on the main thread would turn a
+            # persistently hung source into an untimeboxable main-thread
+            # hang on the supervisor's very first retry
+            block = self._wait(rng, self._pool.submit(self._assemble, rng))
         if next_range is not None:
             nxt = tuple(next_range)
             self._inflight = (nxt, self._pool.submit(self._assemble, nxt))
         self._tracer.counter("prefetch_depth", self.depth)
         return block
 
+    def abandon(self):
+        """Drop everything without waiting — for the supervisor's restart
+        path, where the worker may be hung and close()'s drain would wedge
+        the supervisor too. The abandoned worker runs on in the background
+        and NOTHING IN-PROCESS joins it; the one residual is Python's own
+        interpreter-shutdown join of executor threads
+        (concurrent.futures' atexit hook), so a worker hung FOREVER (not
+        just slow) still stalls process exit — a bounded main loop cannot
+        fully absolve an unbounded thread."""
+        self._inflight = None
+        self._pool.shutdown(wait=False, cancel_futures=True)
+
     def close(self):
+        if self._stalled:
+            # a hung worker was already detected: joining it would re-wedge
+            # the loop the queue-wait bound exists to protect
+            self.abandon()
+            return
         if self._inflight is not None:
-            self._inflight[1].result()
-            self._inflight = None
+            inflight, self._inflight = self._inflight, None
+            try:
+                self._wait(inflight[0], inflight[1])
+            except Exception:
+                pass  # closing: a failed/stalled tail fetch is discarded
+        if self._stalled:  # ...including one that stalled just now
+            self.abandon()
+            return
         self._pool.shutdown(wait=True)

@@ -25,6 +25,14 @@ flush materializes anyway.
 
 A stale ``updated_at`` is itself the signal: a watchdog that sees no beat
 for a few flush periods knows the run is wedged without attaching to it.
+
+Terminal states (ISSUE 6): every beat carries ``state: "running"``; the
+loops end the file's life with :meth:`terminal` — ``"done"`` on normal
+completion, ``"preempted"`` (plus ``resumable_step``) when a
+SIGTERM/SIGINT graceful stop snapped a boundary checkpoint, ``"crashed"``
+(plus a one-line ``cause``) when an unhandled exception escapes — so
+``tools/trace_report.py`` and operators can distinguish the three without
+parsing a traceback.
 """
 
 from __future__ import annotations
@@ -61,7 +69,10 @@ class RunHeartbeat:
         self._tp = 0.0
         self._adv = 0.0
         self._flagged = 0.0
+        self._guard_trips = 0.0
+        self._skipped_steps = 0.0
         self._last: dict = {}
+        self._last_payload: dict = {}
         self.beats = 0
 
     # ---- accumulation ----------------------------------------------------
@@ -81,6 +92,9 @@ class RunHeartbeat:
                 if k in record:
                     self._flagged += float(record[k])
                     break
+        if "guard_trips" in record:
+            self._guard_trips += float(record["guard_trips"])
+            self._skipped_steps += float(record.get("skipped_steps", 0.0))
         self._last = record
 
     def decode_health(self) -> Optional[dict]:
@@ -113,6 +127,7 @@ class RunHeartbeat:
         dt = max(now - self._t0, 1e-9)
         rate = done / dt
         payload = {
+            "state": "running",
             "step": int(step),
             "total_steps": int(total_steps) if total_steps else None,
             "steps_per_s": round(rate, 4),
@@ -126,11 +141,43 @@ class RunHeartbeat:
         health = self.decode_health()
         if health is not None:
             payload["decode_health"] = health
+        if self._guard_trips or self._skipped_steps or \
+                "guard_trips" in self._last:
+            payload["guard"] = {"trips": self._guard_trips,
+                                "skipped_steps": self._skipped_steps}
         if extra:
             payload.update(extra)
+        self._write(payload)
+        self.beats += 1
+        return payload
+
+    def terminal(self, state: str, cause: Optional[str] = None,
+                 resumable_step: Optional[int] = None) -> Optional[dict]:
+        """Write the run's FINAL status.json state: ``done`` | ``preempted``
+        | ``crashed`` (module docstring). Builds on the last beat's payload
+        so a monitor keeps step/rate/health context, then overrides
+        ``state`` (+ one-line ``cause``, + ``resumable_step`` when a
+        graceful stop snapped a boundary checkpoint to resume from)."""
+        if self.path is None:
+            return None
+        # seed from the last payload for step/rate/health context, but
+        # strip terminal-only keys: a terminal seeded from a PREVIOUS
+        # terminal (block-wise callers re-run between beats) must not leak
+        # a stale cause or resumable_step into a different final state
+        payload = {k: v for k, v in self._last_payload.items()
+                   if k not in ("state", "cause", "resumable_step")}
+        payload["state"] = state
+        payload["updated_at"] = time.time()
+        if cause is not None:
+            payload["cause"] = str(cause)[:500]
+        if resumable_step is not None:
+            payload["resumable_step"] = int(resumable_step)
+        self._write(payload)
+        return payload
+
+    def _write(self, payload: dict) -> None:
+        self._last_payload = payload
         tmp = self.path + ".tmp"
         with open(tmp, "w") as fh:
             json.dump(payload, fh)
         os.replace(tmp, self.path)
-        self.beats += 1
-        return payload

@@ -61,6 +61,7 @@ class NullTracer:
 
     __slots__ = ()
     enabled = False
+    last_span = None  # no spans recorded, ever
 
     def span(self, name: str, **args) -> _NullSpan:
         return _NULL_SPAN
@@ -209,6 +210,16 @@ class SpanTracer:
         ev = {"name": "thread_name", "ph": "M", "pid": self._pid,
               "tid": threading.get_ident(), "args": {"name": label}}
         self._append(ev)
+
+    @property
+    def last_span(self) -> Optional[str]:
+        """Name of the newest completed span — the 'what was happening
+        last' breadcrumb error paths attach (e.g. PrefetchStallError)."""
+        with self._lock:
+            for ev in reversed(self._events):
+                if ev.get("ph") == "X":
+                    return ev.get("name")
+        return None
 
     # ---- persistence -----------------------------------------------------
     def flush(self) -> None:

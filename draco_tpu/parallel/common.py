@@ -17,8 +17,14 @@ from draco_tpu.coding import cyclic as cyclic_mod
 
 
 def aggregate_flat_grads(grads: jnp.ndarray, adv_mask, cfg, code, rand_factor,
-                         present=None, leaf_offsets=None):
+                         present=None, leaf_offsets=None, step=None):
     """(n, d) per-worker flat gradients → ``(aggregated (d,), health)``.
+
+    ``step`` (optional traced scalar): the training step, threaded so the
+    deterministic fault plan (``cfg.fault_spec``,
+    resilience/faults.corrupt_grads) can inject its in-graph NaN/Inf
+    worker-gradient faults — identity (no added ops) when no plan is
+    configured.
 
     cyclic: shared-redundancy encode, adversarial injection on the encoded
     rows, exact decode — ``health`` is the in-graph decode-health dict
@@ -43,6 +49,9 @@ def aggregate_flat_grads(grads: jnp.ndarray, adv_mask, cfg, code, rand_factor,
     traces group ops by Draco's reference phase names (the device-side
     counterpart of the host SpanTracer, draco_tpu/obs).
     """
+    from draco_tpu.resilience import faults as faults_mod
+
+    grads = faults_mod.corrupt_grads(grads, cfg, step)
     if cfg.approach == "cyclic":
         with jax.named_scope("draco_encode"):
             if grads.ndim == 3:
@@ -107,11 +116,49 @@ def apply_flat_update(state, agg: jnp.ndarray, opt, unravel):
     return new_params, new_opt
 
 
+def finish_flat_step(cfg, state, agg, health, opt, unravel, present=None,
+                     constrain=None, constrain_opt=None):
+    """The shared flat-gradient step tail: optimizer update → optional
+    param/opt-state sharding constraints → advance the carry, with the
+    in-graph step guard folded in when ``cfg.step_guard == "on"``
+    (resilience/guards.guard_update: untrusted steps keep the previous
+    params/opt_state via branch-free carry passthrough, the step counter
+    still advances). One implementation for every LM route (sp / tp / ep /
+    pp) so the guard semantics cannot diverge between them. Returns
+    ``(new_state, guard_metric_columns)`` — the columns dict is empty when
+    the guard is off, so the metric schema only grows for guarded configs
+    (token_metric_names).
+
+    ``constrain_opt``: routes whose carry must hold a GSPMD-stable layout
+    (the real tp/ep meshes) pin the new opt state to the input layout here
+    — otherwise the partitioner is free to reshard momentum buffers on the
+    first execution and the SECOND dispatch of the K-fused program
+    retraces against the drifted shardings (a silent steady-state
+    recompile the PR 5 sentinel flags)."""
+    new_params, new_opt = apply_flat_update(state, agg, opt, unravel)
+    if constrain is not None:
+        new_params = constrain(new_params)
+    if constrain_opt is not None:
+        new_opt = constrain_opt(new_opt)
+    new_state = state._replace(params=new_params, opt_state=new_opt,
+                               step=state.step + 1)
+    if cfg.step_guard != "on":
+        return new_state, {}
+    from draco_tpu.resilience import guards
+
+    return guards.guard_update(cfg, state, new_state, agg, health, present)
+
+
 # column order of the (K, m) metric block train_token_many returns on the
-# non-coded routes; cyclic routes append DECODE_HEALTH_NAMES — use
+# non-coded routes; cyclic routes append DECODE_HEALTH_NAMES and guarded
+# configs (cfg.step_guard == "on") append GUARD_METRIC_NAMES — use
 # token_metric_names(cfg), never these tuples directly, so the step bodies
 # and the host flush can't disagree on the column order
 TOKEN_METRIC_NAMES = ("loss",)
+
+# per-step guard columns (resilience/guards.py): guard_trips = health
+# signals fired, skipped_steps = 1 iff the update was passthrough-skipped
+from draco_tpu.resilience.guards import GUARD_METRIC_NAMES  # noqa: E402
 
 # per-step decode-health columns (in-graph scalars; coding/cyclic.py):
 #   decode_residual  self-consistency residual, ≈ 0 iff decode exact
@@ -130,9 +177,12 @@ def token_metric_names(cfg) -> tuple:
     """Column order of the (K, m) metric block for an LM route at ``cfg``
     — every route builder stores this on its setup so the shared token
     loop flushes the right schema."""
+    names = TOKEN_METRIC_NAMES
     if cfg.approach == "cyclic":
-        return TOKEN_METRIC_NAMES + DECODE_HEALTH_NAMES
-    return TOKEN_METRIC_NAMES
+        names = names + DECODE_HEALTH_NAMES
+    if cfg.step_guard == "on":
+        names = names + GUARD_METRIC_NAMES
+    return names
 
 
 def decode_health_metrics(health, adv_mask, present) -> dict:

@@ -45,7 +45,7 @@ from draco_tpu.models.transformer import Block
 from draco_tpu.parallel.common import (
     TOKEN_METRIC_NAMES,
     aggregate_flat_grads,
-    apply_flat_update,
+    finish_flat_step,
     decode_health_metrics,
     make_token_train_many,
     masked_loss_metric,
@@ -328,14 +328,15 @@ def build_pp_train_setup(cfg: TrainConfig, mesh) -> PPTrainSetup:
                        if code is not None else None)
         agg, health = aggregate_flat_grads(grads, adv_mask, cfg, code,
                                            rand_factor, present=present,
-                                           leaf_offsets=leaf_offsets)
-        new_params, new_opt = apply_flat_update(state, agg, opt, unravel)
-        new_state = TrainState(
-            _constrain_params(new_params, mesh, _leaf_spec), new_opt, None,
-            state.step + 1,
+                                           leaf_offsets=leaf_offsets,
+                                           step=state.step)
+        new_state, guard_cols = finish_flat_step(
+            cfg, state, agg, health, opt, unravel, present=present,
+            constrain=lambda p: _constrain_params(p, mesh, _leaf_spec),
         )
         metrics = {"loss": masked_loss_metric(losses, present)}
         metrics.update(decode_health_metrics(health, adv_mask, present))
+        metrics.update(guard_cols)
         return new_state, metrics
 
     def eval_body(params, tokens):

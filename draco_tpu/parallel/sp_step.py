@@ -36,8 +36,8 @@ from draco_tpu.parallel.a2a_attention import a2a_attention
 from draco_tpu.parallel.common import (
     TOKEN_METRIC_NAMES,
     aggregate_flat_grads,
-    apply_flat_update,
     decode_health_metrics,
+    finish_flat_step,
     make_token_train_many,
     masked_loss_metric,
     token_metric_names,
@@ -283,11 +283,14 @@ def build_sp_train_setup(cfg: TrainConfig, mesh) -> SPTrainSetup:
                        if code is not None else None)
         agg, health = aggregate_flat_grads(grads, adv_mask, cfg, code,
                                            rand_factor, present=present,
-                                           leaf_offsets=leaf_offsets)
-        new_params, new_opt = apply_flat_update(state, agg, opt, unravel)
-        new_state = TrainState(new_params, new_opt, None, state.step + 1)
+                                           leaf_offsets=leaf_offsets,
+                                           step=state.step)
+        new_state, guard_cols = finish_flat_step(cfg, state, agg, health,
+                                                 opt, unravel,
+                                                 present=present)
         metrics = {"loss": masked_loss_metric(losses, present)}
         metrics.update(decode_health_metrics(health, adv_mask, present))
+        metrics.update(guard_cols)
         return new_state, metrics
 
     loss_fn = shard_map(
@@ -342,8 +345,8 @@ def lint_programs():
 
     manifest = Manifest(collectives=LINT_COLLECTIVES)
 
-    def _build(name, many):
-        cfg = ci_lm_config(seq_shards=2)
+    def _build(name, many, **overrides):
+        cfg = ci_lm_config(seq_shards=2, **overrides)
         mesh = make_mesh_2d(4, 2)  # 8 CI devices; n=8 folds 2 lanes/device
         setup = build_sp_train_setup(cfg, mesh)
         return built_token_program(name, cfg, mesh, setup, manifest,
@@ -354,6 +357,11 @@ def lint_programs():
                     build=lambda: _build("lm_sp_ring_step", False)),
         LintProgram("lm_sp_ring_many_k2", route="sp",
                     build=lambda: _build("lm_sp_ring_many_k2", True)),
+        # guarded production program (ISSUE 6): the step guard must not
+        # change the ring's explicit-collective budget or donation
+        LintProgram("lm_sp_ring_many_guard_k2", route="sp",
+                    build=lambda: _build("lm_sp_ring_many_guard_k2", True,
+                                         step_guard="on")),
     ]
 
 

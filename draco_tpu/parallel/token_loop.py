@@ -52,11 +52,18 @@ from draco_tpu import rng as drng
 from draco_tpu.config import TrainConfig
 from draco_tpu.data.batching import chunk_ranges
 from draco_tpu.obs import NULL_TRACER, CompileWatch, RunHeartbeat
+from draco_tpu.resilience import faults as faults_mod
+from draco_tpu.resilience.supervisor import (
+    GracefulStop,
+    SupervisedPrefetcher,
+    restore_with_walkback,
+)
 
 
 class _LoopTelemetry(NamedTuple):
-    """Telemetry context threaded through both regimes' drivers (defaults =
-    everything disabled, so direct driver calls need no setup)."""
+    """Telemetry + resilience context threaded through both regimes'
+    drivers (defaults = everything disabled, so direct driver calls need no
+    setup)."""
 
     tracer: Any = NULL_TRACER
     heartbeat: RunHeartbeat = RunHeartbeat(None)
@@ -65,6 +72,37 @@ class _LoopTelemetry(NamedTuple):
     profile_steps: tuple = (3, 8)
     # compile/retrace sentinel; the default is an unstarted (inert) watch
     compile_watch: CompileWatch = CompileWatch(guard="off")
+    # deterministic host-fault injector (inert without cfg.fault_spec) and
+    # the graceful-stop holder run_token_loop installs (ISSUE 6)
+    injector: Any = faults_mod.NULL_INJECTOR
+    stop: Optional[GracefulStop] = None
+
+
+def _stop_requested(obs: _LoopTelemetry, step: int) -> bool:
+    """True when the loop should stop after ``step`` — a SIGTERM/SIGINT
+    arrived, or the fault plan injects one here (delivered through the
+    real handler path; the shared poll lives in supervisor.stop_requested,
+    one implementation for both production loops)."""
+    from draco_tpu.resilience.supervisor import stop_requested
+
+    return stop_requested(obs.stop, obs.injector, step)
+
+
+def _snap_stop(cfg, state, step: int, obs: _LoopTelemetry,
+               already_saved: bool = False) -> None:
+    """Honor a graceful stop: snap a resumable boundary checkpoint and
+    record where (the terminal "preempted" heartbeat reports it).
+    ``already_saved``: the boundary path just checkpointed this exact step
+    — don't pay the device_get + write twice."""
+    from draco_tpu.utils import checkpoint as ckpt_mod
+
+    if cfg.train_dir and not already_saved:
+        with obs.tracer.span("ckpt", at_step=step):
+            ckpt_mod.save(cfg.train_dir, step, state,
+                          compress=cfg.compress_ckpt,
+                          keep=cfg.keep_checkpoints)
+    if obs.stop is not None:
+        obs.stop.stopped_step = step
 
 
 def run_token_loop(setup, cfg: TrainConfig, steps: Optional[int] = None,
@@ -93,16 +131,33 @@ def run_token_loop(setup, cfg: TrainConfig, steps: Optional[int] = None,
 
     state = setup.state
     start = 1
-    if cfg.checkpoint_step > 0:
-        state = ckpt_mod.load(cfg.train_dir, cfg.checkpoint_step,
-                              jax.tree.map(lambda x: x, state))
-        start = cfg.checkpoint_step + 1
+    if cfg.checkpoint_step > 0 or cfg.checkpoint_step == -1:
+        # walk-back restore (resilience/supervisor.py): a corrupt
+        # checkpoint is skipped, not fatal; -1 means "newest loadable" —
+        # and, for restart controllers, an EMPTY train_dir means a fresh
+        # start rather than a crash loop
+        try:
+            state, loaded, _skipped = restore_with_walkback(
+                cfg.train_dir, cfg.checkpoint_step,
+                jax.tree.map(lambda x: x, state))
+            start = loaded + 1
+        except FileNotFoundError:
+            if cfg.checkpoint_step != -1:
+                raise
+            print(f"checkpoint_step=-1: no checkpoints in "
+                  f"{cfg.train_dir!r}; starting fresh", flush=True)
     total = steps or cfg.max_steps
     last_step = start + total - 1
     # live adversaries may be fewer than the code parameter s when decode
-    # budget is reserved for stragglers (config.adversary_count)
-    adv = drng.adversary_schedule(cfg.seed, start + total + 1,
-                                  cfg.num_workers, cfg.num_adversaries)
+    # budget is reserved for stragglers (config.adversary_count); the
+    # fault plan's over_budget events (cfg.fault_spec) push their steps'
+    # rows past the s budget — deterministically, like everything else
+    fault_plan = faults_mod.plan_from_cfg(cfg)
+    adv = faults_mod.apply_over_budget(
+        drng.adversary_schedule(cfg.seed, start + total + 1,
+                                cfg.num_workers, cfg.num_adversaries),
+        fault_plan, cfg.worker_fail,
+    )
     straggle = (
         drng.straggler_schedule(cfg.seed, start + total + 1, cfg.num_workers,
                                 cfg.straggle_count)
@@ -131,32 +186,58 @@ def run_token_loop(setup, cfg: TrainConfig, steps: Optional[int] = None,
         if cfg.train_dir:
             with tracer.span("ckpt"):
                 ckpt_mod.save(cfg.train_dir, step, st,
-                              compress=cfg.compress_ckpt)
+                              compress=cfg.compress_ckpt,
+                              keep=cfg.keep_checkpoints)
 
-    obs = _LoopTelemetry(tracer=tracer, heartbeat=heartbeat,
-                         total_end=last_step,
-                         profile_dir=(profile_dir if is_main else None),
-                         profile_steps=profile_steps,
-                         compile_watch=compile_watch)
+    # resilience envelope (ISSUE 6), mirroring Trainer.run: SIGTERM/SIGINT
+    # become a cooperative stop honored at step/chunk boundaries (boundary
+    # checkpoint + "preempted" terminal heartbeat state); an unhandled
+    # exception stamps a "crashed" terminal status.json before re-raising
     try:
-        K = max(cfg.steps_per_call, 1)
-        if K > 1 or cfg.token_gen == "device":
-            # the device-generated stream exists only inside the scanned
-            # program, so that mode runs the chunked driver even at K=1
-            state, metrics = _run_chunked(setup, cfg, state, start, last_step,
-                                          adv, straggle, writer,
-                                          boundary_eval_ckpt, tag, obs)
+        with GracefulStop() as stop:
+            obs = _LoopTelemetry(tracer=tracer, heartbeat=heartbeat,
+                                 total_end=last_step,
+                                 profile_dir=(profile_dir if is_main
+                                              else None),
+                                 profile_steps=profile_steps,
+                                 compile_watch=compile_watch,
+                                 injector=faults_mod.HostFaultInjector(
+                                     fault_plan),
+                                 stop=stop)
+            K = max(cfg.steps_per_call, 1)
+            if K > 1 or cfg.token_gen == "device":
+                # the device-generated stream exists only inside the
+                # scanned program, so that mode runs the chunked driver
+                # even at K=1
+                state, metrics = _run_chunked(setup, cfg, state, start,
+                                              last_step, adv, straggle,
+                                              writer, boundary_eval_ckpt,
+                                              tag, obs)
+            else:
+                state, metrics = _run_eager(setup, cfg, state, start,
+                                            last_step, adv, straggle,
+                                            writer, boundary_eval_ckpt, obs)
+            if (cfg.train_dir and not cfg.eval_freq
+                    and stop.stopped_step is None):
+                # checkpointing without eval: no cadence boundaries exist,
+                # so save the final state (with eval_freq set the boundary
+                # saves stand alone, preserving the historical
+                # on-boundary-only layout); a preempted run already snapped
+                # its resumable checkpoint at the stop point
+                with tracer.span("ckpt"):
+                    ckpt_mod.save(cfg.train_dir, last_step, state,
+                                  compress=cfg.compress_ckpt,
+                                  keep=cfg.keep_checkpoints)
+        if stop.stopped_step is not None:
+            heartbeat.terminal(
+                "preempted", cause=f"graceful stop on {stop.signame}",
+                resumable_step=(stop.stopped_step if cfg.train_dir
+                                else None))
         else:
-            state, metrics = _run_eager(setup, cfg, state, start, last_step,
-                                        adv, straggle, writer,
-                                        boundary_eval_ckpt, obs)
-        if cfg.train_dir and not cfg.eval_freq:
-            # checkpointing without eval: no cadence boundaries exist, so save
-            # the final state (with eval_freq set the boundary saves stand
-            # alone, preserving the historical on-boundary-only layout)
-            with tracer.span("ckpt"):
-                ckpt_mod.save(cfg.train_dir, last_step, state,
-                              compress=cfg.compress_ckpt)
+            heartbeat.terminal("done")
+    except BaseException as e:
+        heartbeat.terminal("crashed", cause=f"{type(e).__name__}: {e}")
+        raise
     finally:
         writer.close()
         compile_watch.stop()
@@ -169,7 +250,9 @@ def _run_eager(setup, cfg, state, start, last_step, adv, straggle, writer,
     """One dispatch per step — the K=1 bitwise reference."""
     from draco_tpu.parallel.sp_step import synthetic_text
 
-    tracer, heartbeat, total_end, profile_dir, profile_steps, watch = obs
+    tracer, heartbeat, watch = obs.tracer, obs.heartbeat, obs.compile_watch
+    total_end, profile_dir, profile_steps = (obs.total_end, obs.profile_dir,
+                                             obs.profile_steps)
     metrics = {}
     profiling = False
     for step in range(start, last_step + 1):
@@ -215,6 +298,11 @@ def _run_eager(setup, cfg, state, start, last_step, adv, straggle, writer,
                 tracer.flush()
         if boundary:
             boundary_eval_ckpt(step, state)
+        if _stop_requested(obs, step):
+            with tracer.span("flush"):
+                writer.flush()
+            _snap_stop(cfg, state, step, obs, already_saved=bool(boundary))
+            break
     if profiling:
         jax.block_until_ready(state.params)
         jax.profiler.stop_trace()
@@ -229,7 +317,9 @@ def _run_chunked(setup, cfg, state, start, last_step, adv, straggle, writer,
     from draco_tpu.parallel.sp_step import synthetic_text
     from draco_tpu.utils.metrics import DeferredMetricWriter
 
-    tracer, heartbeat, total_end, profile_dir, profile_steps, watch = obs
+    tracer, heartbeat, watch = obs.tracer, obs.heartbeat, obs.compile_watch
+    total_end, profile_dir, profile_steps = (obs.total_end, obs.profile_dir,
+                                             obs.profile_steps)
     if setup.train_token_many is None:
         raise ValueError(
             f"{tag} route setup lacks train_token_many — rebuild it with "
@@ -241,12 +331,20 @@ def _run_chunked(setup, cfg, state, start, last_step, adv, straggle, writer,
     device_gen = cfg.token_gen == "device"
     prefetch = None
     if not device_gen:
-        prefetch = TokenChunkPrefetcher(
+        # generation fn wrapped by the fault injector (inert by default),
+        # prefetcher wrapped by restart supervision with a bounded queue
+        # wait — a dead/hung worker thread is retried with backoff, then
+        # surfaces as the named PrefetchStallError, never a silent hang
+        gen_fn = obs.injector.wrap_step_fn(
             lambda step: synthetic_text(cfg.seed, step, cfg.num_workers,
                                         cfg.batch_size, cfg.seq_len,
-                                        cfg.vocab),
-            tracer=tracer,
-        )
+                                        cfg.vocab))
+        factory = lambda: TokenChunkPrefetcher(  # noqa: E731
+            gen_fn, tracer=tracer, timeout_s=cfg.prefetch_timeout_s)
+        prefetch = (SupervisedPrefetcher(factory,
+                                         restarts=cfg.prefetch_restarts,
+                                         tracer=tracer)
+                    if cfg.prefetch_restarts > 0 else factory())
     deferred = DeferredMetricWriter(writer, observer=heartbeat.observe)
 
     def should_log(step):
@@ -312,6 +410,14 @@ def _run_chunked(setup, cfg, state, start, last_step, adv, straggle, writer,
                 profiled = True
             if boundary:
                 boundary_eval_ckpt(end, state)
+            if _stop_requested(obs, end):
+                # chunk boundary = legal stop point: drain pending metric
+                # blocks, then snap the resumable checkpoint exactly here
+                with tracer.span("flush", at_step=end):
+                    deferred.flush(should_log)
+                _snap_stop(cfg, state, end, obs,
+                           already_saved=bool(boundary))
+                break
     finally:
         if profiling:
             jax.profiler.stop_trace()

@@ -36,7 +36,7 @@ from draco_tpu.models.transformer import TransformerLM
 from draco_tpu.parallel.common import (
     TOKEN_METRIC_NAMES,
     aggregate_flat_grads,
-    apply_flat_update,
+    finish_flat_step,
     decode_health_metrics,
     make_token_train_many,
     masked_loss_metric,
@@ -90,12 +90,25 @@ def param_partition_spec(path) -> P:
     return P(*spec)
 
 
+def _norm_spec(spec) -> P:
+    """PartitionSpec with trailing Nones stripped — XLA reports output
+    shardings in this normalized spelling (``P('tp', None)`` comes back as
+    ``P('tp')``), and jit's cache compares shardings by equality, so an
+    UN-normalized input spec against a normalized output spec retraces the
+    K-fused program on its second dispatch (the silent steady-state
+    recompile the PR 5 sentinel flags on the real tp/ep meshes)."""
+    parts = tuple(spec)
+    while parts and parts[-1] is None:
+        parts = parts[:-1]
+    return P(*parts)
+
+
 def shard_params(params, mesh, partition_fn=param_partition_spec):
     """Annotate a parameter pytree with its (w-replicated, mp-sharded)
     placement."""
     return jax.tree_util.tree_map_with_path(
         lambda path, x: jax.device_put(
-            x, NamedSharding(mesh, partition_fn(path))
+            x, NamedSharding(mesh, _norm_spec(partition_fn(path)))
         ),
         params,
     )
@@ -104,7 +117,7 @@ def shard_params(params, mesh, partition_fn=param_partition_spec):
 def _constrain_params(params, mesh, partition_fn):
     return jax.tree_util.tree_map_with_path(
         lambda path, x: jax.lax.with_sharding_constraint(
-            x, NamedSharding(mesh, partition_fn(path))
+            x, NamedSharding(mesh, _norm_spec(partition_fn(path)))
         ),
         params,
     )
@@ -189,6 +202,14 @@ def _build_gspmd_train_setup(cfg: TrainConfig, mesh, *, mp_axis: str,
         batch_stats=None,
         step=jax.device_put(jnp.asarray(1, jnp.int32), repl),
     )
+    # pin the step's output opt state to the carry's INPUT layout: left
+    # unconstrained, GSPMD is free to reshard momentum buffers on the
+    # first execution (e.g. a replicated LayerNorm-scale slot coming back
+    # tp-sharded), and the K-fused program then RETRACES on its second
+    # dispatch against the drifted shardings (_norm_spec docstring)
+    opt_shardings = jax.tree.map(lambda x: x.sharding, state.opt_state)
+    constrain_opt = lambda o: jax.tree.map(  # noqa: E731
+        jax.lax.with_sharding_constraint, o, opt_shardings)
 
     def lane_loss(params, toks, train: bool):
         """Whole-sequence next-token CE for one worker's (B, T) batch.
@@ -246,12 +267,16 @@ def _build_gspmd_train_setup(cfg: TrainConfig, mesh, *, mp_axis: str,
                        if code is not None else None)
         agg, health = aggregate_flat_grads(grads, adv_mask, cfg, code,
                                            rand_factor, present=present,
-                                           leaf_offsets=leaf_offsets)
-        new_params, new_opt = apply_flat_update(state, agg, opt, unravel)
-        new_params = _constrain_params(new_params, mesh, partition_fn)
-        new_state = TrainState(new_params, new_opt, None, state.step + 1)
+                                           leaf_offsets=leaf_offsets,
+                                           step=state.step)
+        new_state, guard_cols = finish_flat_step(
+            cfg, state, agg, health, opt, unravel, present=present,
+            constrain=lambda p: _constrain_params(p, mesh, partition_fn),
+            constrain_opt=constrain_opt,
+        )
         metrics = {"loss": masked_loss_metric(losses, present)}
         metrics.update(decode_health_metrics(health, adv_mask, present))
+        metrics.update(guard_cols)
         return new_state, metrics
 
     def eval_body(params, tokens):
@@ -260,13 +285,24 @@ def _build_gspmd_train_setup(cfg: TrainConfig, mesh, *, mp_axis: str,
     from draco_tpu.parallel.sp_step import token_fn_from_cfg
 
     metric_names = token_metric_names(cfg)
+    # the carry's layout is pinned at the JIT boundary: out_shardings for
+    # the state output = the state input's shardings. A with_sharding_
+    # constraint inside the scanned body does not win the scan carry's
+    # unified layout — GSPMD still resharded replicated momentum slots to
+    # tp-sharded on the real tp mesh, and the second dispatch then
+    # retraced against the drifted input (_norm_spec docstring). The
+    # boundary pin makes state-in == state-out by construction (and lets
+    # donation alias cleanly). The metrics output stays compiler-chosen.
+    state_shardings = jax.tree.map(lambda x: x.sharding, state)
     with mesh:
-        train_step = jax.jit(step_body, donate_argnums=(0,))
+        train_step = jax.jit(step_body, donate_argnums=(0,),
+                             out_shardings=(state_shardings, None))
         eval_step = jax.jit(eval_body)
         train_token_many = jax.jit(
             make_token_train_many(step_body, token_fn_from_cfg(cfg),
                                   metric_names=metric_names),
             donate_argnums=(0,),
+            out_shardings=(state_shardings, None),
         )
 
     return TPTrainSetup(
@@ -301,8 +337,8 @@ def lint_programs():
     )
     from draco_tpu.parallel.mesh import make_folded_wtp_mesh, make_mesh_wtp
 
-    def _tp2(name, many):
-        cfg = ci_lm_config(tensor_shards=2)
+    def _tp2(name, many, **overrides):
+        cfg = ci_lm_config(tensor_shards=2, **overrides)
         mesh = make_mesh_wtp(4, 2)  # 8 CI devices; n=8 folds 2 lanes/device
         setup = build_tp_train_setup(cfg, mesh)
         return built_token_program(name, cfg, mesh, setup,
@@ -353,6 +389,10 @@ def lint_programs():
         mk("lm_fold_devgen_many_k2",
            lambda: _fold("lm_fold_devgen_many_k2", True, token_gen="device",
                          steps_per_call=2)),
+        # guarded production program (ISSUE 6): the in-graph step guard on
+        # the GSPMD route — still zero explicit collectives, no host traffic
+        mk("lm_tp2_many_guard_k2",
+           lambda: _tp2("lm_tp2_many_guard_k2", True, step_guard="on")),
         mk("lm_fold_big_bf16_many_k2",
            lambda: _fold_big("lm_fold_big_bf16_many_k2"),
            fast=False, export_platforms=("cpu",)),

@@ -1,0 +1,44 @@
+"""Resilience layer (ISSUE 6): deterministic fault injection, in-graph step
+guards, and preemption-safe graceful degradation.
+
+DRACO's contract is exact recovery from ≤ s Byzantine workers; production
+runs die to faults the contract does not model. This package is the
+detect → degrade-boundedly → keep-training posture:
+
+  faults.py      seeded fault-injection plan (``cfg.fault_spec``) — the
+                 chaos counterpart of attacks.py's adversary schedules
+  guards.py      branchless in-graph step guard: fold decode-health +
+                 global-finite signals, skip untrusted updates via carry
+                 passthrough, emit guard_trips/skipped_steps columns
+  supervisor.py  host-side half: prefetcher restart supervision with
+                 backoff, checkpoint walk-back past corruption, and the
+                 SIGTERM → boundary-checkpoint → "preempted" status path
+
+``tools/chaos_run.py`` drives the fault × loop matrix and commits
+``baselines_out/chaos_matrix.json``; ``tools/perf_watch.py`` gates on a
+fault class flipping from masked to crashed.
+"""
+
+# guards.py is deliberately NOT imported here: it needs jax, while this
+# package surface (faults/supervisor) stays importable from jax-free
+# contexts (config.validate parses fault specs; tools fold artifacts).
+# Step bodies import draco_tpu.resilience.guards directly.
+from draco_tpu.resilience.faults import (
+    FaultEvent,
+    FaultPlan,
+    HostFaultInjector,
+    InjectedFaultError,
+    NULL_INJECTOR,
+    plan_from_cfg,
+)
+from draco_tpu.resilience.supervisor import (
+    GracefulStop,
+    SupervisedPrefetcher,
+    restore_with_walkback,
+)
+
+__all__ = [
+    "FaultEvent", "FaultPlan", "GracefulStop", "HostFaultInjector",
+    "InjectedFaultError", "NULL_INJECTOR", "SupervisedPrefetcher",
+    "plan_from_cfg", "restore_with_walkback",
+]

@@ -1,0 +1,280 @@
+"""Deterministic fault injection — the chaos counterpart of ``attacks.py``.
+
+The adversary schedules (draco_tpu/rng.py) make Byzantine behavior a seeded,
+replayable experiment input; this module extends the same discipline to the
+faults DRACO's code contract does NOT model (ISSUE 6): non-finite gradients
+from faulty-but-honest workers, corruption past the s budget, dead or hung
+prefetch threads, and SIGTERM mid-run. A :class:`FaultPlan` is parsed from
+``cfg.fault_spec`` — a comma-separated list of ``kind@step`` events — so the
+same plan replays bit-for-bit across runs, regimes (eager vs chunked) and
+processes, which is what lets ``tools/chaos_run.py`` classify each fault
+class as *masked* (final state bitwise-equal to a fault-free run) or
+*gracefully degraded* (named error / resumable checkpoint / correct
+terminal heartbeat state) instead of "something happened".
+
+Event grammar (``FaultPlan.parse``)::
+
+    kind@step[:w<worker>][:d<seconds>]
+
+    nan_grad@5          worker (seeded draw) emits a NaN gradient at step 5
+    inf_grad@5:w2       worker 2 emits an Inf gradient at step 5
+    over_budget@7       step 7's adversary row is pushed to s+1 live
+                        adversaries (beyond the code's locator budget)
+    prefetch_crash@5    the prefetcher host fn raises InjectedFaultError
+                        the first time step 5's data is requested
+    prefetch_hang@5:d6  ... sleeps 6 s instead (a stalled worker thread)
+    sigterm@5           SIGTERM is raised in-process once step 5 completes
+    ckpt_corrupt@8      consumed by tools/chaos_run.py: flip bytes in the
+    ckpt_truncate@8     step-8 checkpoint / truncate it, then resume
+
+In-graph kinds are applied with the same branch-free ``jnp.where`` masking
+as ``attacks.inject_plain`` — the fault is part of the compiled program
+(config-static: an empty plan compiles the exact unfaulted program, and a
+given plan compiles once; no steady-state retraces). Host kinds fire
+one-shot through :class:`HostFaultInjector` so a supervised retry
+(resilience/supervisor.py) re-executes the request cleanly — exactly how a
+transient real-world fault behaves.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import re
+from typing import Optional, Tuple
+
+import numpy as np
+
+# in-graph kinds corrupt the step's compiled inputs; host kinds fire in the
+# host loop / prefetcher; ckpt kinds are consumed by tools/chaos_run.py
+INGRAPH_KINDS = ("nan_grad", "inf_grad")
+HOST_KINDS = ("prefetch_crash", "prefetch_hang", "sigterm")
+CKPT_KINDS = ("ckpt_corrupt", "ckpt_truncate")
+FAULT_KINDS = INGRAPH_KINDS + ("over_budget",) + HOST_KINDS + CKPT_KINDS
+
+_EVENT_RE = re.compile(r"^(?P<kind>[a-z_]+)@(?P<step>\d+)"
+                       r"(?::w(?P<worker>\d+))?(?::d(?P<dur>[\d.]+))?$")
+
+
+class InjectedFaultError(RuntimeError):
+    """The named error a ``prefetch_crash`` event raises — distinguishable
+    from any organic failure, so chaos tests can assert the supervision
+    path masked exactly the injected fault and nothing else."""
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultEvent:
+    kind: str
+    step: int  # 1-based training step the event targets
+    worker: Optional[int] = None  # in-graph kinds: the corrupted row
+    duration_s: float = 30.0  # prefetch_hang: how long the worker sleeps
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    """An immutable, seed-deterministic set of fault events."""
+
+    events: Tuple[FaultEvent, ...]
+    seed: int
+    num_workers: int
+
+    @classmethod
+    def parse(cls, spec: str, seed: int, num_workers: int) -> "FaultPlan":
+        events = []
+        for i, tok in enumerate(t.strip() for t in spec.split(",")):
+            if not tok:
+                continue
+            m = _EVENT_RE.match(tok)
+            if not m:
+                raise ValueError(
+                    f"fault_spec event {tok!r} does not match "
+                    f"'kind@step[:w<worker>][:d<seconds>]'"
+                )
+            kind, step = m.group("kind"), int(m.group("step"))
+            if kind not in FAULT_KINDS:
+                raise ValueError(
+                    f"unknown fault kind {kind!r}; known: "
+                    f"{'|'.join(FAULT_KINDS)}"
+                )
+            if step < 1:
+                raise ValueError(f"fault step must be >= 1 in {tok!r}")
+            worker = m.group("worker")
+            if worker is not None:
+                worker = int(worker)
+                if worker >= num_workers:
+                    raise ValueError(
+                        f"fault worker {worker} out of range "
+                        f"(num_workers={num_workers}) in {tok!r}"
+                    )
+            elif kind in INGRAPH_KINDS:
+                # seeded per-event draw — the same "every participant can
+                # recompute it" property as rng.adversary_schedule
+                r = np.random.RandomState((seed ^ 0x4641554C) + 7919 * i)
+                worker = int(r.randint(num_workers))
+            dur = m.group("dur")
+            events.append(FaultEvent(
+                kind=kind, step=step, worker=worker,
+                duration_s=float(dur) if dur is not None else 30.0,
+            ))
+        return cls(events=tuple(events), seed=seed, num_workers=num_workers)
+
+    def of_kind(self, *kinds: str) -> Tuple[FaultEvent, ...]:
+        return tuple(e for e in self.events if e.kind in kinds)
+
+    @property
+    def ingraph_events(self) -> Tuple[FaultEvent, ...]:
+        return self.of_kind(*INGRAPH_KINDS)
+
+
+@functools.lru_cache(maxsize=64)
+def _cached_plan(spec: str, seed: int, num_workers: int) -> FaultPlan:
+    return FaultPlan.parse(spec, seed, num_workers)
+
+
+def plan_from_cfg(cfg) -> Optional[FaultPlan]:
+    """The cfg's parsed plan, or None when no faults are configured (the
+    common case — every consumer below is an exact no-op then)."""
+    if not getattr(cfg, "fault_spec", ""):
+        return None
+    return _cached_plan(cfg.fault_spec, cfg.seed, cfg.num_workers)
+
+
+# ---- in-graph injection ----------------------------------------------------
+
+
+def corrupt_grads(grads, cfg, step):
+    """Branch-free NaN/Inf injection into the (n, ...) per-worker gradient
+    stack at the plan's in-graph events — IDENTITY (no added ops, no graph
+    change) when the plan has none. ``step`` may be a traced scalar (the
+    scanned drivers feed it per-iteration), so the comparison runs in-graph
+    against the events' tiny static step/worker vectors: the same masked
+    ``jnp.where`` discipline as attacks.inject_plain, and no retrace ever
+    (the plan is config-static)."""
+    plan = plan_from_cfg(cfg)
+    if plan is None or not plan.ingraph_events or step is None:
+        return grads
+    import jax.numpy as jnp
+
+    n = grads.shape[0]
+    mask = jnp.zeros((n,), bool)
+    payload = jnp.zeros((n,), grads.dtype)
+    for ev in plan.ingraph_events:
+        hit = (jnp.asarray(ev.step, jnp.int32) ==
+               jnp.asarray(step, jnp.int32))
+        row = jnp.arange(n) == ev.worker
+        mask = mask | (hit & row)
+        val = jnp.nan if ev.kind == "nan_grad" else jnp.inf
+        payload = jnp.where(hit & row, jnp.asarray(val, grads.dtype),
+                            payload)
+    shape = (n,) + (1,) * (grads.ndim - 1)
+    return jnp.where(mask.reshape(shape), payload.reshape(shape), grads)
+
+
+def apply_over_budget(adv_schedule: np.ndarray, plan: Optional[FaultPlan],
+                      worker_fail: int) -> np.ndarray:
+    """Host-side schedule mutation for ``over_budget`` events: the targeted
+    steps' adversary rows gain seeded extra workers until s+1 are live —
+    one corruption past the code's locator budget, the regime where exact
+    recovery is impossible and the guard (resilience/guards.py) is the only
+    thing standing between a silently poisoned update and a skipped one.
+    Returns the (possibly copied) schedule; the input is never mutated."""
+    if plan is None:
+        return adv_schedule
+    events = plan.of_kind("over_budget")
+    if not events:
+        return adv_schedule
+    adv = np.array(adv_schedule, copy=True)
+    n = adv.shape[1]
+    want = min(worker_fail + 1, n)
+    for ev in events:
+        if ev.step >= adv.shape[0]:
+            continue  # beyond the run's schedule table — inert
+        row = adv[ev.step]
+        r = np.random.RandomState((plan.seed ^ 0x0B0D6E7) + ev.step)
+        order = r.permutation(n)
+        for w in order:
+            if row.sum() >= want:
+                break
+            row[w] = True
+        adv[ev.step] = row
+    return adv
+
+
+# ---- host-side one-shot triggering ----------------------------------------
+
+
+class HostFaultInjector:
+    """Fires each host fault event exactly once, however many times the
+    surrounding request is retried — so a supervised restart
+    (resilience/supervisor.py) observes a clean re-execution, the way a
+    transient real fault would behave. Inert (every method a cheap no-op)
+    when built with ``plan=None``."""
+
+    def __init__(self, plan: Optional[FaultPlan]):
+        self._plan = plan
+        self._fired: set = set()
+
+    @property
+    def active(self) -> bool:
+        return self._plan is not None and bool(self._plan.events)
+
+    def _fire(self, kinds, lo: int, hi: Optional[int] = None):
+        """First unfired event of ``kinds`` with step in [lo, hi] (hi
+        defaults to lo), marked fired."""
+        if self._plan is None:
+            return None
+        hi = lo if hi is None else hi
+        for ev in self._plan.of_kind(*kinds):
+            key = (ev.kind, ev.step, ev.worker)
+            if key not in self._fired and lo <= ev.step <= hi:
+                self._fired.add(key)
+                return ev
+        return None
+
+    def wrap_step_fn(self, fn):
+        """Wrap a per-step host data fn (``fn(step) -> x``) so prefetch
+        fault events fire when their step's data is first requested."""
+        if not self.active:
+            return fn
+
+        def wrapped(step):
+            self._maybe_prefetch_fault(step, step)
+            return fn(step)
+
+        return wrapped
+
+    def wrap_range_fn(self, fn):
+        """Wrap a chunk-range host data fn (``fn(start, k) -> x``) so
+        prefetch fault events fire when the chunk containing their step is
+        first requested."""
+        if not self.active:
+            return fn
+
+        def wrapped(start, k):
+            self._maybe_prefetch_fault(start, start + k - 1)
+            return fn(start, k)
+
+        return wrapped
+
+    def _maybe_prefetch_fault(self, lo: int, hi: int) -> None:
+        ev = self._fire(("prefetch_crash", "prefetch_hang"), lo, hi)
+        if ev is None:
+            return
+        if ev.kind == "prefetch_crash":
+            raise InjectedFaultError(
+                f"injected prefetch_crash at step {ev.step} "
+                f"(fault plan event)"
+            )
+        import time
+
+        time.sleep(ev.duration_s)
+
+    def sigterm_due(self, end_step: int) -> bool:
+        """True once, when a sigterm event's step has been reached — the
+        loop then raises the real signal in-process so the registered
+        GracefulStop handler (resilience/supervisor.py) runs the genuine
+        preemption path."""
+        return self._fire(("sigterm",), 1, end_step) is not None
+
+
+NULL_INJECTOR = HostFaultInjector(None)

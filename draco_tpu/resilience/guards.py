@@ -1,0 +1,109 @@
+"""Branchless in-graph step guard: skip the optimizer update when a step is
+untrusted, keep training.
+
+DRACO's decode is *exact* only inside its contract (≤ s Byzantine rows,
+erasures within budget, finite arithmetic). Outside it — a
+faulty-but-honest worker emitting NaN/Inf, corruption past the locator
+budget, a vote with no honest majority — the decoded "gradient" is silently
+poisoned. The PR 4 decode-health columns already *detect* these states
+in-graph; this module *acts* on them (the detect → degrade-boundedly →
+keep-training posture of the Stochastic Gradient Coding line, PAPERS.md
+arXiv:1905.05383):
+
+  signal                         trips when
+  ------                         ----------
+  nonfinite                      any non-finite value in the aggregated /
+                                 decoded flat gradient (all approaches)
+  residual_loud                  cyclic decode_residual > cfg.guard_residual_tol
+                                 (clean decodes sit at f32 solve noise ~1e-6;
+                                 a mislocated beyond-budget decode is O(1));
+                                 NaN residual counts as loud
+  over_budget                    located/flagged present rows > s — more
+                                 corruption than the code can certify
+                                 (cyclic locator roots; maj_vote out-voted
+                                 rows, i.e. vote disagreement past budget)
+
+When any signal trips the step's update is SKIPPED via carry passthrough:
+``jnp.where`` selects the previous params/opt_state/batch_stats while the
+step counter still advances — branch-free, so the compiled program is the
+same every step (zero retraces under the PR 5 compile guard) and bitwise
+identical to the unguarded program on trusted steps (``where(True, new,
+old)`` is a select). The per-step verdict ships as two new metric columns
+(``guard_trips``/``skipped_steps``) riding the existing (K, m) block — zero
+extra device fetches.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+# column order of the guard's metric-block contribution; appended to a
+# route's metric_names iff cfg.step_guard == "on" (parallel/common.
+# token_metric_names and the CNN path's metric_names both consume this)
+GUARD_METRIC_NAMES = ("guard_trips", "skipped_steps")
+
+
+class GuardVerdict(NamedTuple):
+    ok: jnp.ndarray  # scalar bool — the step's update is trusted
+    trips: jnp.ndarray  # scalar int32 — how many signals fired
+
+
+def assess(cfg, agg: jnp.ndarray, health: Optional[dict] = None,
+           present=None) -> GuardVerdict:
+    """Fold the step's health signals into one trust verdict (docstring
+    table). ``health`` is the in-graph decode-health dict the coded paths
+    already produce (coding/cyclic.decode with_health; the maj_vote path
+    passes its ``flagged`` row set) — None for routes with no exactness
+    certificate (baseline robust aggregation), where only the finite check
+    applies. All comparisons are NaN-safe in the conservative direction:
+    a NaN residual or a NaN gradient is never trusted."""
+    trips = []
+    # <= so a NaN (any comparison False) lands on the untrusted side
+    finite = jnp.all(jnp.isfinite(agg))
+    trips.append(~finite)
+    if health is not None:
+        if "residual" in health:
+            loud = ~(health["residual"] <= cfg.guard_residual_tol)
+            trips.append(loud)
+        if "flagged" in health:
+            flagged = health["flagged"]
+            if present is not None:
+                flagged = flagged & present
+            located = jnp.sum(flagged.astype(jnp.int32))
+            trips.append(located > cfg.worker_fail)
+    trip_vec = jnp.stack(trips)
+    n_trips = jnp.sum(trip_vec.astype(jnp.int32))
+    return GuardVerdict(ok=~jnp.any(trip_vec), trips=n_trips)
+
+
+def select_state(ok, new_state, prev_state) -> Any:
+    """Carry passthrough: the new state when trusted, the previous state
+    (step counter still advanced) when not — a branch-free per-leaf select,
+    bitwise-transparent on trusted steps."""
+    passthrough = prev_state._replace(step=new_state.step)
+    return jax.tree.map(lambda a, b: jnp.where(ok, a, b), new_state,
+                        passthrough)
+
+
+def metric_columns(verdict: GuardVerdict) -> dict:
+    """The GUARD_METRIC_NAMES columns for the step's metrics dict."""
+    return {
+        "guard_trips": verdict.trips,
+        "skipped_steps": (~verdict.ok).astype(jnp.int32),
+    }
+
+
+def guard_update(cfg, prev_state, new_state, agg, health=None,
+                 present=None):
+    """One-call wrapper for step bodies: assess + select + columns.
+    Returns ``(state, metric_columns_dict)`` — the unguarded
+    ``(new_state, {})`` when cfg.step_guard is off, so call sites stay
+    branch-free too."""
+    if cfg.step_guard != "on":
+        return new_state, {}
+    verdict = assess(cfg, agg, health, present)
+    return select_state(verdict.ok, new_state, prev_state), \
+        metric_columns(verdict)

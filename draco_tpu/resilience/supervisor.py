@@ -1,0 +1,224 @@
+"""Host-side graceful degradation: prefetcher supervision, checkpoint
+walk-back, and preemption-safe stop.
+
+The in-graph guard (resilience/guards.py) covers the faults that reach the
+compiled program; this module covers the host half of the resilience layer
+(ISSUE 6) — the places a production run actually dies:
+
+  SupervisedPrefetcher    a prefetcher worker exception or stall abandons
+                          the broken instance and rebuilds it (exponential
+                          backoff, bounded restarts) so a transient fault
+                          re-executes the same deterministic request and
+                          the run continues bit-for-bit; when restarts are
+                          exhausted the ORIGINAL named error propagates.
+  restore_with_walkback   resume never dies on one corrupt checkpoint:
+                          walk back through older checkpoints until one
+                          loads (CheckpointCorruptError rows are skipped
+                          and reported). Walk-back needs something to walk
+                          back TO — retain-last-N GC keeps the newest N by
+                          step, not by integrity, so run with
+                          keep_checkpoints >= 2 (or 0) where torn newest
+                          checkpoints are a live concern.
+  GracefulStop            SIGTERM/SIGINT request a stop instead of killing
+                          the process mid-chunk: the loops check
+                          ``stop.requested`` at chunk boundaries, snap a
+                          boundary checkpoint, and write the terminal
+                          ``status.json`` state ("preempted", resumable) —
+                          which makes the chunk-boundary checkpoints the
+                          preemption/elasticity mechanism ROADMAP item 1
+                          calls for.
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+import time
+from typing import Any, Callable, Optional
+
+from draco_tpu.obs.tracer import NULL_TRACER
+
+
+class SupervisedPrefetcher:
+    """Wraps any prefetcher (``get``/``depth``/``close``) built by
+    ``factory`` with restart-on-failure supervision.
+
+    A failed ``get`` abandons the instance (best-effort, never waiting on a
+    hung worker), sleeps an exponentially growing backoff, rebuilds via
+    ``factory`` and retries the SAME request — deterministic data sources
+    (all of draco_tpu's are) make the retry bitwise-identical to an
+    untroubled fetch, so a transient fault is fully masked. After
+    ``restarts`` rebuilds the original exception propagates: bounded, never
+    an infinite crash loop. ``restarts=0`` is a transparent passthrough."""
+
+    def __init__(self, factory: Callable[[], Any], restarts: int = 2,
+                 backoff_s: float = 0.05, tracer=NULL_TRACER):
+        self._factory = factory
+        self._restarts = max(int(restarts), 0)
+        self._backoff_s = backoff_s
+        self._tracer = tracer
+        self._p = factory()
+        self.restarts_used = 0
+
+    @property
+    def depth(self) -> int:
+        return self._p.depth
+
+    def get(self, *args, **kwargs):
+        if self._p is None:  # rebuilt lazily after an exhausted-retry raise
+            self._p = self._factory()
+        delay = self._backoff_s
+        for attempt in range(self._restarts + 1):
+            try:
+                return self._p.get(*args, **kwargs)
+            except Exception as e:
+                # the failing instance is ALWAYS abandoned — on the final
+                # attempt too, so the caller's cleanup (close()) never
+                # joins a worker known to be broken/hung
+                self._abandon()
+                if attempt == self._restarts:
+                    raise
+                self._tracer.instant(
+                    "prefetch.restart",
+                    error=f"{type(e).__name__}: {e}"[:200],
+                    attempt=attempt + 1,
+                )
+                time.sleep(delay)
+                delay *= 2
+                self._p = self._factory()
+                self.restarts_used += 1
+
+    def _abandon(self) -> None:
+        """Drop the broken instance without ever blocking on it (a hung
+        worker thread must not hang the supervisor too)."""
+        p, self._p = self._p, None
+        try:
+            if hasattr(p, "abandon"):
+                p.abandon()
+            else:
+                p.close()
+        except Exception:
+            pass
+
+    def close(self) -> None:
+        if self._p is not None:
+            try:
+                self._p.close()
+            except Exception:
+                pass
+
+
+# ---- checkpoint walk-back --------------------------------------------------
+
+
+def restore_with_walkback(train_dir: str, step: int, abstract_state,
+                          loader=None):
+    """Load the checkpoint at ``step`` (or the newest one when ``step ==
+    -1``), walking back through older checkpoints past any that fail with
+    :class:`~draco_tpu.utils.checkpoint.CheckpointCorruptError`.
+
+    Returns ``(state, loaded_step, skipped)`` where ``skipped`` is a list of
+    ``(step, error_str)`` for every corrupt checkpoint walked past — each is
+    also printed here (one report site for both production loops; a corrupt
+    newest checkpoint is a real event, just not a fatal one). Raises the
+    LAST corruption error when nothing loads, or FileNotFoundError when the
+    dir holds no checkpoints at all. Any non-corruption load failure
+    propagates immediately: walk-back is for torn bytes, not for masking
+    structural mismatches."""
+    from draco_tpu.utils import checkpoint as ckpt
+
+    load = loader or ckpt.load
+    steps = ckpt.available_steps(train_dir)
+    if step == -1:
+        candidates = sorted(steps, reverse=True)
+    else:
+        candidates = [step] + sorted((s for s in steps if s < step),
+                                     reverse=True)
+    if not candidates:
+        raise FileNotFoundError(
+            f"no checkpoints in {train_dir!r} to restore from"
+        )
+    skipped = []
+    last_err: Optional[Exception] = None
+    for s in candidates:
+        try:
+            return load(train_dir, s, abstract_state), s, skipped
+        except ckpt.CheckpointCorruptError as e:
+            print(f"checkpoint walk-back: skipped corrupt step {s} ({e})",
+                  flush=True)
+            skipped.append((s, str(e)))
+            last_err = e
+    raise last_err
+
+
+# ---- preemption-safe stop --------------------------------------------------
+
+
+class GracefulStop:
+    """Context manager converting SIGTERM/SIGINT into a cooperative stop
+    request the training loops poll at chunk boundaries.
+
+    Installs handlers on ``__enter__`` (main thread only — elsewhere, e.g.
+    under a test runner thread, it degrades to an inert flag holder) and
+    restores the previous handlers on ``__exit__``. A second signal while a
+    stop is already pending restores the previous handler and re-raises it,
+    so a stuck shutdown can still be killed the ordinary way."""
+
+    def __init__(self, signals=(signal.SIGTERM, signal.SIGINT)):
+        self._signals = signals
+        self._previous: dict = {}
+        self.requested = False
+        self.signame: Optional[str] = None
+        # the loop that honored the stop records where it snapped the
+        # resumable checkpoint, for the terminal status.json
+        self.stopped_step: Optional[int] = None
+
+    def _handler(self, signum, frame):
+        if self.requested:  # second signal: give up gracefulness
+            for sig, prev in self._previous.items():
+                signal.signal(sig, prev)
+            signal.raise_signal(signum)
+            return
+        self.requested = True
+        self.signame = signal.Signals(signum).name
+
+    @property
+    def installed(self) -> bool:
+        """True when this instance's handlers are live (main-thread
+        __enter__); False means deliver_signal degrades to the flag."""
+        return bool(self._previous)
+
+    def deliver_signal(self, sig=signal.SIGTERM) -> None:
+        """Deliver ``sig`` through the REAL handler path when installed
+        (the genuine preemption flow — what the fault plan's sigterm event
+        uses), degrading to a direct stop request when handlers could not
+        be installed (non-main-thread runners, e.g. under a test
+        harness)."""
+        if self.installed:
+            signal.raise_signal(sig)
+        else:
+            self.requested = True
+            self.signame = signal.Signals(sig).name
+
+    def __enter__(self) -> "GracefulStop":
+        if threading.current_thread() is threading.main_thread():
+            for sig in self._signals:
+                self._previous[sig] = signal.signal(sig, self._handler)
+        return self
+
+    def __exit__(self, *exc):
+        for sig, prev in self._previous.items():
+            signal.signal(sig, prev)
+        self._previous = {}
+        return False
+
+
+def stop_requested(stop: Optional[GracefulStop], injector,
+                   step: int) -> bool:
+    """The one stop-poll both production loops share: fire the fault
+    plan's pending sigterm event (delivered through the real handler
+    path), then report whether a graceful stop is pending. ``stop`` may be
+    None (driver called without the resilience envelope)."""
+    if injector.sigterm_due(step) and stop is not None:
+        stop.deliver_signal(signal.SIGTERM)
+    return stop is not None and stop.requested

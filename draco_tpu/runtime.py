@@ -79,6 +79,16 @@ def enable_compile_cache(path: Optional[str] = None) -> str:
     """
     import sys
 
+    # Explicit CPU environment: skip without touching jax. CPU compiles are
+    # cheap, and — measured on this container (PERF.md §9) — XLA:CPU
+    # executables built with the persistent cache enabled exhibit
+    # donated-carry buffer aliasing corruption: a jit output state that
+    # MUTATES under subsequent dispatches (two consecutive device_get of
+    # the same array differ, NaNs bleed into later checkpoints). The chaos
+    # harness's bitwise classifications caught it; until the upstream
+    # runtime is fixed, CPU runs stay uncached.
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        return ""
     # If a backend is ALREADY initialized and it's plain CPU, skip: CPU
     # compiles are cheap and the AOT reload warning is noise (nested tools —
     # e.g. convergence_grid driving time_to_acc rows — land here). Only

@@ -41,7 +41,23 @@ from draco_tpu.coding import cyclic as cyclic_mod
 from draco_tpu.coding import repetition as rep_mod
 from draco_tpu.data import augment as augment_mod
 from draco_tpu.models import build_model, input_shape
+from draco_tpu.resilience import faults as faults_mod
 from draco_tpu.runtime import WORKER_AXIS
+
+
+def _maybe_guard(cfg, prev_state, new_state, agg, health, present, out):
+    """Fold the in-graph step guard (resilience/guards.py) into a CNN step
+    body's tail: untrusted updates become branch-free carry passthrough and
+    the guard columns land in the metrics dict. Identity when
+    cfg.step_guard is off — the unguarded program is unchanged."""
+    if cfg.step_guard != "on":
+        return new_state
+    from draco_tpu.resilience import guards
+
+    new_state, cols = guards.guard_update(cfg, prev_state, new_state, agg,
+                                          health, present)
+    out.update(cols)
+    return new_state
 
 
 def _metrics(losses, precs, present=None):
@@ -230,6 +246,7 @@ def build_train_setup(cfg: TrainConfig, mesh, dataset_name: Optional[str] = None
                 state.params, state.batch_stats, x, y, dkeys
             )
             grads = jax.lax.with_sharding_constraint(grads, shard_w)
+            grads = faults_mod.corrupt_grads(grads, cfg, state.step)
             grads = attacks.inject_plain(grads, adv_mask, cfg.err_mode, adv_mag,
                                          n_mal=cfg.num_adversaries)
             with jax.named_scope("draco_decode"):
@@ -238,7 +255,12 @@ def build_train_setup(cfg: TrainConfig, mesh, dataset_name: Optional[str] = None
                                             geomedian_iters=cfg.geomedian_iters,
                                             present=present)
             new_state = apply_update(state, agg, new_stats)
-            return new_state, _metrics(losses, precs, present)
+            out = _metrics(losses, precs, present)
+            # no exactness certificate on approximate rules: the guard's
+            # only signal here is the global-finite check
+            new_state = _maybe_guard(cfg, state, new_state, agg, None,
+                                     present, out)
+            return new_state, out
 
     elif cfg.approach == "maj_vote":
         code = None
@@ -261,6 +283,7 @@ def build_train_setup(cfg: TrainConfig, mesh, dataset_name: Optional[str] = None
                 state.params, state.batch_stats, x, y, dkeys
             )
             grads = jax.lax.with_sharding_constraint(grads, shard_w)
+            grads = faults_mod.corrupt_grads(grads, cfg, state.step)
             grads = attacks.inject_plain(grads, adv_mask, cfg.err_mode, adv_mag,
                                          n_mal=cfg.num_adversaries)
             # per-step fingerprint salt, identical on every device (folded
@@ -282,6 +305,11 @@ def build_train_setup(cfg: TrainConfig, mesh, dataset_name: Optional[str] = None
             out["flagged_groups"] = vhealth["flagged_groups"]
             out.update(_detection_metrics(vhealth["flagged"], adv_mask,
                                           present))
+            # guard signals: finite vote + out-voted rows (vote
+            # disagreement) within the s budget
+            new_state = _maybe_guard(cfg, state, new_state, voted,
+                                     {"flagged": vhealth["flagged"]},
+                                     present, out)
             return new_state, out
 
     elif cfg.approach == "cyclic":
@@ -314,6 +342,7 @@ def build_train_setup(cfg: TrainConfig, mesh, dataset_name: Optional[str] = None
                     lane, in_axes=(None, 0, 0, 0, 0)
                 )(state.params, state.batch_stats, x, y, dkeys)
                 grads = jax.lax.with_sharding_constraint(grads, shard_w)
+                grads = faults_mod.corrupt_grads(grads, cfg, state.step)
                 with jax.named_scope("draco_encode"):
                     enc_re, enc_im = cyclic_mod.encode_shared(code, grads)
                 return enc_re, enc_im, new_stats, losses, precs
@@ -345,6 +374,7 @@ def build_train_setup(cfg: TrainConfig, mesh, dataset_name: Optional[str] = None
                 grads = jax.lax.with_sharding_constraint(
                     grads, NamedSharding(mesh, P(WORKER_AXIS, None, None))
                 )
+                grads = faults_mod.corrupt_grads(grads, cfg, state.step)
                 with jax.named_scope("draco_encode"):
                     enc_re, enc_im = cyclic_mod.encode(code, grads)
                 # fold the per-sub-batch stats back to one per worker
@@ -398,6 +428,10 @@ def build_train_setup(cfg: TrainConfig, mesh, dataset_name: Optional[str] = None
             from draco_tpu.parallel.common import decode_health_metrics
 
             out.update(decode_health_metrics(health, adv_mask, present))
+            # guard signals: finite decode + loud residual + located rows
+            # beyond the locator budget (the beyond-budget fault class)
+            new_state = _maybe_guard(cfg, state, new_state, decoded, health,
+                                     present, out)
             return new_state, out
 
     else:  # pragma: no cover
@@ -443,6 +477,11 @@ def build_train_setup(cfg: TrainConfig, mesh, dataset_name: Optional[str] = None
     elif cfg.approach == "maj_vote":
         metric_names += ("vote_agree", "flagged_groups", "det_flagged",
                          "det_tp", "det_adv")
+    if cfg.step_guard == "on":
+        # guard columns ride the same (K, m) block (resilience/guards.py)
+        from draco_tpu.resilience.guards import GUARD_METRIC_NAMES
+
+        metric_names += GUARD_METRIC_NAMES
 
     def many_body(state: TrainState, xs, ys, masks, presents):
         def body(st, operand):
@@ -533,4 +572,10 @@ def lint_programs():
         mk("cnn_cyclic_many_k2", cfg=_cfg(), many=True),
         # the repetition-vote path (group_size=4 >= 2s+1, n % r == 0)
         mk("cnn_majvote_step", cfg=_cfg(approach="maj_vote", group_size=4)),
+        # the guarded production program (ISSUE 6): the in-graph step guard
+        # must keep the manifest green — still zero explicit collectives,
+        # full state donation, no host traffic (the guard is selects +
+        # reductions, never a callback)
+        mk("cnn_cyclic_many_guard_k2", cfg=_cfg(step_guard="on"),
+           many=True),
     ]

@@ -38,6 +38,12 @@ from draco_tpu.data import batching
 from draco_tpu.data.datasets import Dataset, load_dataset
 from draco_tpu.data.prefetch import BatchPrefetcher, ChunkPrefetcher
 from draco_tpu.obs import RunHeartbeat, make_compile_watch, make_tracer
+from draco_tpu.resilience import faults as faults_mod
+from draco_tpu.resilience.supervisor import (
+    GracefulStop,
+    SupervisedPrefetcher,
+    restore_with_walkback,
+)
 from draco_tpu.runtime import WORKER_AXIS, make_mesh, put_global
 from draco_tpu.training.step import build_train_setup
 from draco_tpu.utils import checkpoint as ckpt
@@ -72,8 +78,17 @@ class Trainer:
         self.compile_watch = make_compile_watch(cfg, self.tracer,
                                                 self._is_main)
         self._shard_w = NamedSharding(self.mesh, P(WORKER_AXIS))
-        self._adv_schedule = drng.adversary_schedule(
-            cfg.seed, cfg.max_steps, cfg.num_workers, cfg.num_adversaries
+        # resilience wiring (draco_tpu/resilience): the parsed fault plan
+        # (None without cfg.fault_spec), its one-shot host-event injector,
+        # and the graceful-stop holder the active run() installs
+        self._fault_plan = faults_mod.plan_from_cfg(cfg)
+        self._injector = faults_mod.HostFaultInjector(self._fault_plan)
+        self._stop: Optional[GracefulStop] = None
+        self._stopped_step: Optional[int] = None
+        self._adv_schedule = faults_mod.apply_over_budget(
+            drng.adversary_schedule(cfg.seed, cfg.max_steps, cfg.num_workers,
+                                    cfg.num_adversaries),
+            self._fault_plan, cfg.worker_fail,
         )
         self._straggle_schedule = (
             drng.straggler_schedule(cfg.seed, cfg.max_steps, cfg.num_workers,
@@ -85,9 +100,10 @@ class Trainer:
         self._group_seeds = drng.group_seeds(cfg.seed, max(cfg.num_groups, 1))
         # both prefetchers are lazy: the chunked path never touches the
         # per-step one (and vice versa), so neither thread pool should
-        # exist until its loop actually runs
-        self._prefetch: Optional[BatchPrefetcher] = None
-        self._chunk_prefetch: Optional[ChunkPrefetcher] = None
+        # exist until its loop actually runs (each may be wrapped in a
+        # SupervisedPrefetcher — same get/depth/close surface)
+        self._prefetch = None  # BatchPrefetcher | SupervisedPrefetcher
+        self._chunk_prefetch = None  # ChunkPrefetcher | SupervisedPrefetcher
         self._start_step = 1
         if cfg.checkpoint_step:
             self.restore(cfg.checkpoint_step)
@@ -107,12 +123,23 @@ class Trainer:
         return batching.indices_cyclic(n, step - 1, cfg.num_workers,
                                        cfg.batch_size, cfg.seed)
 
+    def _supervised(self, factory):
+        """Prefetcher restart supervision (resilience/supervisor.py):
+        transient worker faults are retried with backoff up to
+        cfg.prefetch_restarts times; 0 disables the wrapper entirely."""
+        if self.cfg.prefetch_restarts <= 0:
+            return factory()
+        return SupervisedPrefetcher(factory,
+                                    restarts=self.cfg.prefetch_restarts,
+                                    tracer=self.tracer)
+
     def _host_batch(self, step: int):
         if self._prefetch is None:
-            self._prefetch = BatchPrefetcher(
-                self.ds, self._batch_indices, self.cfg.num_workers,
+            indices_fn = self._injector.wrap_step_fn(self._batch_indices)
+            self._prefetch = self._supervised(lambda: BatchPrefetcher(
+                self.ds, indices_fn, self.cfg.num_workers,
                 self.cfg.batch_size, tracer=self.tracer
-            )
+            ))
         return self._prefetch.get(step)
 
     def _device_batch(self, step: int):
@@ -135,8 +162,10 @@ class Trainer:
         if n_steps <= self._sched_steps:
             return
         cfg = self.cfg
-        self._adv_schedule = drng.adversary_schedule(
-            cfg.seed, n_steps, cfg.num_workers, cfg.num_adversaries
+        self._adv_schedule = faults_mod.apply_over_budget(
+            drng.adversary_schedule(cfg.seed, n_steps, cfg.num_workers,
+                                    cfg.num_adversaries),
+            self._fault_plan, cfg.worker_fail,
         )
         if self._straggle_schedule is not None:
             self._straggle_schedule = drng.straggler_schedule(
@@ -199,15 +228,69 @@ class Trainer:
         the chunks containing profile_steps."""
         n_steps = max_steps if max_steps is not None else self.cfg.max_steps
         self._ensure_schedules(n_steps)
-        if self.cfg.steps_per_call > 1:
-            last = self._run_chunked(n_steps, profile_dir, profile_steps)
+        # resilience envelope (ISSUE 6): SIGTERM/SIGINT become a
+        # cooperative stop honored at step/chunk boundaries (boundary
+        # checkpoint + "preempted" terminal state), and any unhandled
+        # exception stamps a "crashed" terminal status.json with a one-line
+        # cause before re-raising — operators and tools/trace_report.py can
+        # distinguish crash / preempted / done without parsing stdout
+        self._stopped_step = None
+        try:
+            with GracefulStop() as stop:
+                self._stop = stop
+                if self.cfg.steps_per_call > 1:
+                    last = self._run_chunked(n_steps, profile_dir,
+                                             profile_steps)
+                else:
+                    last = self._run_eager(n_steps, profile_dir,
+                                           profile_steps)
+        except BaseException as e:
+            self.heartbeat.terminal("crashed",
+                                    cause=f"{type(e).__name__}: {e}")
+            raise
+        finally:
+            self._stop = None
+        if self._stopped_step is not None:
+            self.heartbeat.terminal(
+                "preempted",
+                cause=f"graceful stop on {stop.signame}",
+                resumable_step=(self._stopped_step
+                                if self.cfg.train_dir else None),
+            )
         else:
-            last = self._run_eager(n_steps, profile_dir, profile_steps)
+            self.heartbeat.terminal("done")
         # advance the cursor so a subsequent run(max_steps=...) continues
         # instead of retraining from step 1 (block-wise callers:
-        # tools/time_to_acc.py)
-        self._start_step = max(self._start_step, n_steps + 1)
+        # tools/time_to_acc.py); a preempted run's cursor stays at its
+        # stop point (set by _snap_stop)
+        if self._stopped_step is None:
+            self._start_step = max(self._start_step, n_steps + 1)
         return last
+
+    def _check_stop(self, step: int) -> bool:
+        """True when the run should stop after ``step``: a SIGTERM/SIGINT
+        arrived (or the fault plan injects one here — delivered through
+        the real handler path, supervisor.stop_requested)."""
+        from draco_tpu.resilience.supervisor import stop_requested
+
+        return stop_requested(self._stop, self._injector, step)
+
+    def _snap_stop(self, step: int, already_saved: bool = False) -> None:
+        """Honor a graceful stop at a step/chunk boundary: snap a resumable
+        checkpoint there (the preemption/elasticity mechanism — resume with
+        checkpoint_step=step or -1) and record where we stopped for the
+        terminal heartbeat. ``already_saved``: the boundary path just
+        checkpointed this exact step — don't pay the device_get + write
+        twice."""
+        if self.cfg.train_dir and not already_saved:
+            with self.tracer.span("ckpt", at_step=step):
+                ckpt.save(self.cfg.train_dir, step, self.state,
+                          compress=self.cfg.compress_ckpt,
+                          keep=self.cfg.keep_checkpoints)
+        self._stopped_step = step
+        if self._stop is not None:
+            self._stop.stopped_step = step
+        self._start_step = step + 1
 
     def _run_eager(self, n_steps: int, profile_dir, profile_steps) -> dict:
         cfg = self.cfg
@@ -270,7 +353,13 @@ class Trainer:
                 if cfg.train_dir:
                     with self.tracer.span("ckpt", at_step=step):
                         ckpt.save(cfg.train_dir, step, self.state,
-                                  compress=cfg.compress_ckpt)
+                                  compress=cfg.compress_ckpt,
+                                  keep=cfg.keep_checkpoints)
+            if self._check_stop(step):
+                with self.tracer.span("flush", at_step=step):
+                    self.writer.flush()
+                self._snap_stop(step, already_saved=bool(boundary))
+                break
         if profiling:  # loop ended before profile_steps[1]
             jax.profiler.stop_trace()
         return last
@@ -286,10 +375,11 @@ class Trainer:
         if not ranges:
             return {}
         if self._chunk_prefetch is None:
-            self._chunk_prefetch = ChunkPrefetcher(
-                self.ds, self._chunk_indices, cfg.num_workers, cfg.batch_size,
+            range_fn = self._injector.wrap_range_fn(self._chunk_indices)
+            self._chunk_prefetch = self._supervised(lambda: ChunkPrefetcher(
+                self.ds, range_fn, cfg.num_workers, cfg.batch_size,
                 tracer=self.tracer
-            )
+            ))
         deferred = DeferredMetricWriter(self.writer,
                                         observer=self.heartbeat.observe)
 
@@ -369,10 +459,21 @@ class Trainer:
                 if cfg.train_dir:
                     with self.tracer.span("ckpt", at_step=end):
                         ckpt.save(cfg.train_dir, end, self.state,
-                                  compress=cfg.compress_ckpt)
+                                  compress=cfg.compress_ckpt,
+                                  keep=cfg.keep_checkpoints)
                 # eval/checkpoint wall must not leak into the next window's
                 # t_comp (the eager loop's Segments exclude them too)
                 window_t0 = time.perf_counter()
+            if self._check_stop(end):
+                # a chunk boundary is a legal stop point mid-window: drain
+                # the pending metric blocks first, then snap the resumable
+                # checkpoint exactly here
+                with self.tracer.span("sync", at_step=end):
+                    deferred.sync()
+                with self.tracer.span("flush", at_step=end):
+                    deferred.flush(should_log)
+                self._snap_stop(end, already_saved=bool(boundary))
+                break
         if profiling:
             jax.block_until_ready(self.state.params)
             jax.profiler.stop_trace()
@@ -419,6 +520,11 @@ class Trainer:
 
     # ---- checkpoint ------------------------------------------------------
     def restore(self, step: int):
+        """Resume from ``step`` (or the newest checkpoint when ``step ==
+        -1``), walking back past corrupt checkpoints
+        (resilience/supervisor.restore_with_walkback) — a torn newest
+        checkpoint costs the steps since the previous good one, never the
+        run."""
         # abstract tree must carry each leaf's sharding: on multi-host, save()
         # writes global jax.Arrays collectively, and a sharding-less restore
         # would fail (or come back host-local) exactly there
@@ -426,5 +532,17 @@ class Trainer:
             lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding),
             self.state,
         )
-        self.state = ckpt.load(self.cfg.train_dir, step, abstract)
-        self._start_step = step + 1
+        try:
+            self.state, loaded, _skipped = restore_with_walkback(
+                self.cfg.train_dir, step, abstract
+            )
+        except FileNotFoundError:
+            if step != -1:
+                raise
+            # -1 is the restart-controller flag ("resume from whatever is
+            # there"): an empty train_dir means a fresh start, not a crash
+            # loop for jobs that died before their first checkpoint
+            print(f"checkpoint_step=-1: no checkpoints in "
+                  f"{self.cfg.train_dir!r}; starting fresh", flush=True)
+            return
+        self._start_step = loaded + 1

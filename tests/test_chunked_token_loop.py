@@ -39,6 +39,10 @@ def make_cfg(**kw):
         # of a labelled route program raises at the dispatch site, so every
         # run in this suite doubles as a 0-retrace assertion
         compile_guard="raise",
+        # in-graph step guard enabled suite-wide (ISSUE 6): the guard must
+        # be bitwise-transparent on clean runs — the equivalence tests
+        # additionally pin guard_trips == 0 per record
+        step_guard="on",
     )
     base.update(kw)
     return TrainConfig(**base)
@@ -119,6 +123,14 @@ def _assert_route_telemetry(route, kw, run_dir):
     recs = [json.loads(l)
             for l in open(os.path.join(run_dir, "metrics.jsonl"))]
     train = [r for r in recs if r.get("split") != "eval" and "loss" in r]
+    # guards enabled suite-wide: a clean run (live adversary + stragglers
+    # all inside budget) must never trip — and never skip an update
+    for r in train:
+        assert r["guard_trips"] == 0.0, r
+        assert r["skipped_steps"] == 0.0, r
+    status_guard = json.load(
+        open(os.path.join(run_dir, "status.json"))).get("guard")
+    assert status_guard == {"trips": 0.0, "skipped_steps": 0.0}
     if kw.get("approach") == "cyclic":
         n = kw["num_workers"]
         adv = drng.adversary_schedule(428, 8, n, kw["adversary_count"])

@@ -53,6 +53,10 @@ def make_cfg(**kw):
         # of a labelled program raises at the dispatch site, so every test
         # in this suite doubles as a 0-retrace assertion
         compile_guard="raise",
+        # in-graph step guard enabled suite-wide (ISSUE 6): the guard must
+        # be bitwise-transparent on clean runs — the equivalence tests
+        # additionally pin guard_trips == 0 per record
+        step_guard="on",
     )
     base.update(kw)
     return TrainConfig(**base)
@@ -144,6 +148,10 @@ def _assert_decode_health(approach, stream, kw):
     strag = drng.straggler_schedule(428, 6, n, kw["straggle_count"])
     flag_col = {"cyclic": "located_errors", "maj_vote": "det_flagged"}
     for step, vals in stream:
+        # guards enabled suite-wide: a clean run (adversary + stragglers
+        # inside budget) never trips and never skips an update
+        assert vals["guard_trips"] == 0.0, (step, vals)
+        assert vals["skipped_steps"] == 0.0, (step, vals)
         if approach == "baseline":
             assert "det_tp" not in vals and "decode_residual" not in vals
             continue

@@ -32,6 +32,12 @@ Folded sources (all optional — a missing artifact folds nothing):
                                 per-program module bytes (constant_bloat
                                 rule) and the memory/cost ledger columns
                                 (memory_budget rule: peak_bytes, flops)
+  baselines_out/chaos_matrix.json
+                                the resilience fault × loop matrix
+                                (tools/chaos_run.py): per-cell ok flags —
+                                a fault class silently flipping from
+                                masked/guarded to FAILED gates nonzero
+                                (kind "ok", tolerance 0)
 
 Tolerances are per metric KIND (relative change vs baseline): time metrics
 default 10% (ms/step, a 20% regression trips loudly), bytes 10%, flops 2%
@@ -204,12 +210,33 @@ def fold_program_lint(root: str, metrics: dict) -> None:
                 "value": float(flops), "kind": "flops", "source": src}
 
 
+def fold_chaos(root: str, metrics: dict) -> None:
+    """Resilience chaos matrix: one ok-flag per (loop, fault) cell plus the
+    roll-up — masked→crashed is a 1→0 flip on a 0-tolerance "ok" metric."""
+    path = os.path.join(root, "baselines_out", "chaos_matrix.json")
+    data = _read_json(path)
+    if not isinstance(data, dict):
+        return
+    src = "baselines_out/chaos_matrix.json"
+    if "all_ok" in data:
+        metrics["chaos.all_ok"] = {"value": float(bool(data["all_ok"])),
+                                   "kind": "ok", "source": src}
+    for row in data.get("rows", []):
+        loop, fault = row.get("loop"), row.get("fault")
+        if not loop or not fault:
+            continue
+        metrics[f"chaos.{loop}.{fault}.ok"] = {
+            "value": float(bool(row.get("ok"))), "kind": "ok",
+            "source": src}
+
+
 def fold_all(root: str) -> dict:
     metrics: dict = {}
     fold_bench(root, metrics)
     fold_multichip(root, metrics)
     fold_host_loop(root, metrics)
     fold_program_lint(root, metrics)
+    fold_chaos(root, metrics)
     return metrics
 
 

@@ -123,6 +123,25 @@ def fold_metrics(path: str) -> dict:
     return out
 
 
+def fold_status(path: str) -> dict:
+    """The run's heartbeat terminal state (obs/heartbeat.py): state
+    done/preempted/crashed/running (+ cause / resumable_step) — how an
+    operator tells a crash from a preemption from a finished run without a
+    traceback. {} when no status.json exists."""
+    try:
+        with open(path) as fh:
+            status = json.load(fh)
+    except (OSError, ValueError):
+        return {}
+    if not isinstance(status, dict):
+        return {}
+    out = {}
+    for key in ("state", "cause", "resumable_step", "step", "updated_at"):
+        if key in status:
+            out[key] = status[key]
+    return out
+
+
 def make_report(trace_path: str, metrics_path=None) -> dict:
     events, dropped = load_trace(trace_path)
     phases, wall_ms = fold_spans(events)
@@ -137,6 +156,18 @@ def make_report(trace_path: str, metrics_path=None) -> dict:
         },
         "counters": fold_counters(events),
     }
+    # status.json lives in train_dir, which may differ from trace_dir (the
+    # CLI flags are independent) — probe both the trace's and the metrics
+    # file's directory
+    candidates = [os.path.join(os.path.dirname(trace_path), "status.json")]
+    if metrics_path:
+        candidates.append(os.path.join(os.path.dirname(metrics_path),
+                                       "status.json"))
+    for cand in candidates:
+        status = fold_status(cand)
+        if status:
+            report["run_status"] = status
+            break
     # a missing or empty metrics.jsonl is a normal state (no train_dir, or
     # a run killed before its first flush) — the trace half still folds
     if metrics_path and os.path.exists(metrics_path):
@@ -158,6 +189,14 @@ def print_table(report: dict, out=None) -> None:
           f"{report['traced_wall_ms']:.1f} ms"
           + (f"   DROPPED EVENTS: {dropped} (sliding window — totals "
              f"undercount the run)" if dropped else ""), file=out)
+    status = report.get("run_status")
+    if status:
+        line = f"run state: {status.get('state', '?')}"
+        if status.get("cause"):
+            line += f"   cause: {status['cause']}"
+        if status.get("resumable_step") is not None:
+            line += f"   resumable from step {status['resumable_step']}"
+        print(line, file=out)
     hdr = f"{'phase':<22}{'count':>7}{'total ms':>12}{'mean ms':>10}" \
           f"{'max ms':>10}{'share':>8}"
     print(hdr, file=out)
