@@ -61,6 +61,22 @@ LOCATOR_RCOND = 1e-5
 # ADVERSARY=-100) — five orders of margin either side.
 HEALTH_REL_TOL = 1e-3
 
+# Loud-row forensics threshold (relative ENERGY vs the median present row):
+# a present row whose projected energy exceeds LOUD_REL_TOL × the median is
+# "loud". A forensic-only accusation signal (obs/forensics.py) — it feeds
+# the per-worker accusation columns, never the decode, the located_errors
+# count, or the step guard. Rationale: beyond the locator budget (> s
+# corrupt rows) exact location is information-theoretically impossible and
+# the fitted-codeword deviations above say nothing (any n−2s rows define an
+# exact codeword), but the in-scope attack payloads are magnitude outliers
+# (O(100×) amplitude ⇒ O(1e4×) energy) while honest encoded rows sit within
+# ~6× of their median energy (measured, PERF.md §10) — 30× energy splits the
+# two with more than an order of margin either side. The median (not the
+# mean) keeps the baseline honest with up to s+1 corrupt rows present, and
+# absent rows are excluded from both sides (a zero-filled erasure is
+# known-missing, not quiet).
+LOUD_REL_TOL = 30.0
+
 
 # --------------------------------------------------------------------------
 # Construction (host-side numpy, run identically by every participant at
@@ -351,7 +367,17 @@ def _locate_v(code: CyclicCode, e_re: jnp.ndarray, e_im: jnp.ndarray,
     flagged = (dev > (HEALTH_REL_TOL**2) * msq) & (pres_f > 0)
     resid_sq = jnp.sum(jnp.where(flagged, 0.0, dev) * pres_f) / jnp.maximum(
         jnp.sum(energy * pres_f), 1e-30)
-    health = {"residual": jnp.sqrt(resid_sq), "flagged": flagged}
+    # loud-row outlier mask (LOUD_REL_TOL docstring): forensic-only — the
+    # accusation signal that survives the beyond-budget regime, where the
+    # fitted-codeword deviations above are blind (the chosen-row fit is a
+    # square solve, exact on whatever rows it picked). NaN energies (a
+    # non-finite wire) compare False on both sides, so a NaN-poisoned
+    # column accuses nobody here — the ingest-row check
+    # (obs/forensics.nonfinite_rows) owns that attribution.
+    med = jnp.nanmedian(jnp.where(pres_f > 0, energy, jnp.nan))
+    loud = (energy > LOUD_REL_TOL * med) & (pres_f > 0)
+    health = {"residual": jnp.sqrt(resid_sq), "flagged": flagged,
+              "loud": loud}
     return v_full_re, v_full_im, honest, health
 
 
@@ -376,8 +402,10 @@ def decode(code: CyclicCode, r_re: jnp.ndarray, r_im: jnp.ndarray, rand_factor: 
     absent row is False). ``with_health=True`` appends the decode-health
     dict (``_locate_v`` docstring: scalar ``residual`` ≈ 0 iff the decode is
     self-consistent, (n,) bool ``flagged`` marking present rows whose
-    received value deviates from the fitted codeword) — in-graph values for
-    the telemetry metric columns, backward-compatible 2-tuple otherwise.
+    received value deviates from the fitted codeword, (n,) bool ``loud``
+    marking magnitude-outlier present rows — the forensic-only accusation
+    signal, LOUD_REL_TOL) — in-graph values for the telemetry metric
+    columns, backward-compatible 2-tuple otherwise.
     """
     n = code.n
     # 1. project to one column: e = R @ f  (the only O(n·d) work besides the
@@ -437,6 +465,7 @@ def decode_layers(code: CyclicCode, r_re: jnp.ndarray, r_im: jnp.ndarray,
     decoded = jnp.concatenate(parts) / n
     if with_health:
         health = {"residual": jnp.max(health_l["residual"]),
-                  "flagged": jnp.any(health_l["flagged"], axis=0)}
+                  "flagged": jnp.any(health_l["flagged"], axis=0),
+                  "loud": jnp.any(health_l["loud"], axis=0)}
         return decoded, honest_l, health
     return decoded, honest_l

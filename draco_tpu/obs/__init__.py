@@ -24,6 +24,13 @@ device fetches in steady state** and zero overhead when disabled.
                 jax.monitoring, and a steady-state guard (warn by default,
                 raise in tests) trips on any recompilation of a labelled
                 registered program after its warmup build.
+  forensics.py  Per-worker Byzantine forensics (ISSUE 7): the coded steps'
+                (n,) accusation/present/seeded-adversary masks packed into
+                f32-carried uint32 bitmask columns riding the (K, m) metric
+                block, and the host ``AccusationLedger`` folding them (via
+                the heartbeat's observer hook) into per-worker counters,
+                trust scores, and attack episodes — the ``forensics`` block
+                of status.json and the input to tools/forensics_report.py.
 
 The in-graph half of the telemetry (decode-health metric columns) lives
 where the math lives: coding/cyclic.py + coding/repetition.py produce the
@@ -39,8 +46,10 @@ from draco_tpu.obs.compile_watch import (
     RetraceWarning,
     make_compile_watch,
 )
-from draco_tpu.obs.heartbeat import RunHeartbeat
+from draco_tpu.obs.forensics import AccusationLedger
+from draco_tpu.obs.heartbeat import STATUS_SCHEMA, RunHeartbeat
 from draco_tpu.obs.tracer import NULL_TRACER, SpanTracer, make_tracer
 
-__all__ = ["NULL_TRACER", "CompileWatch", "RetraceError", "RetraceWarning",
-           "RunHeartbeat", "SpanTracer", "make_compile_watch", "make_tracer"]
+__all__ = ["NULL_TRACER", "STATUS_SCHEMA", "AccusationLedger",
+           "CompileWatch", "RetraceError", "RetraceWarning", "RunHeartbeat",
+           "SpanTracer", "make_compile_watch", "make_tracer"]

@@ -42,6 +42,17 @@ import os
 import time
 from typing import Optional
 
+from draco_tpu.obs.forensics import AccusationLedger
+
+# status.json payload schema version. The payload grew organically across
+# PRs 4-6 with no versioning; consumers (tools/trace_report.py,
+# tools/chaos_run.py) tolerate files with no ``schema`` field (pre-version
+# runs) and assert it when present. Bump when a field changes meaning or
+# moves — additive fields do not need a bump.
+#   2: first versioned schema (adds ``schema`` itself, the ``forensics``
+#      block, and ``num_workers``)
+STATUS_SCHEMA = 2
+
 # per-step detection-count columns (in-graph, coding/cyclic.py +
 # coding/repetition.py): tp = flagged ∧ adversarial ∧ present,
 # adv = adversarial ∧ present, flagged = located_errors | det_flagged
@@ -59,7 +70,8 @@ class RunHeartbeat:
     the metrics-emitting process) it is a cheap no-op — both methods
     return immediately."""
 
-    def __init__(self, train_dir: Optional[str], enabled: bool = True):
+    def __init__(self, train_dir: Optional[str], enabled: bool = True,
+                 num_workers: Optional[int] = None):
         self.path = (os.path.join(train_dir, "status.json")
                      if (train_dir and enabled) else None)
         if self.path:
@@ -71,15 +83,30 @@ class RunHeartbeat:
         self._flagged = 0.0
         self._guard_trips = 0.0
         self._skipped_steps = 0.0
+        self._guard_seen = False  # any record carried guard columns
         self._last: dict = {}
+        # newest record that actually carried detection columns — kept
+        # separately from _last so a mixed-route train_dir (a trailing
+        # record WITHOUT the optional health family, e.g. a baseline run
+        # sharing the dir) cannot hide the cumulative health block
+        self._last_health_rec: dict = {}
         self._last_payload: dict = {}
         self.beats = 0
+        # per-worker accusation ledger (obs/forensics.py), fed by the same
+        # observer hook; needs the worker count to unpack the bitmask
+        # columns — loops pass cfg.num_workers, bare constructions skip
+        # forensics entirely
+        self.ledger = (AccusationLedger(num_workers)
+                       if (self.path and num_workers) else None)
 
     # ---- accumulation ----------------------------------------------------
     def observe(self, record: dict) -> None:
         """One materialized train record (every step, logged or not) —
         wired as the DeferredMetricWriter observer in the chunked loops,
-        called inline per step by the eager loops."""
+        called inline per step by the eager loops. Every column family is
+        optional (baseline routes emit no health/guard/forensics columns;
+        eval records carry none): a record only advances the accumulators
+        for the families it carries."""
         if self.path is None:
             return
         step = record.get("step")
@@ -92,16 +119,20 @@ class RunHeartbeat:
                 if k in record:
                     self._flagged += float(record[k])
                     break
+            self._last_health_rec = record
         if "guard_trips" in record:
             self._guard_trips += float(record["guard_trips"])
             self._skipped_steps += float(record.get("skipped_steps", 0.0))
+            self._guard_seen = True
+        if self.ledger is not None:
+            self.ledger.observe(record)
         self._last = record
 
     def decode_health(self) -> Optional[dict]:
         """Cumulative detection precision/recall (1.0 denominators-empty:
         nothing flagged / no live adversary is a healthy state) + the
         newest per-step health values."""
-        if not self._last or _TP_KEY not in self._last:
+        if not self._last_health_rec:
             return None
         health = {
             "precision": (self._tp / self._flagged) if self._flagged else 1.0,
@@ -110,8 +141,8 @@ class RunHeartbeat:
             "adv_total": self._adv,
         }
         for k in _LAST_KEYS:
-            if k in self._last:
-                health[k] = float(self._last[k])
+            if k in self._last_health_rec:
+                health[k] = float(self._last_health_rec[k])
         return health
 
     # ---- emission --------------------------------------------------------
@@ -127,6 +158,7 @@ class RunHeartbeat:
         dt = max(now - self._t0, 1e-9)
         rate = done / dt
         payload = {
+            "schema": STATUS_SCHEMA,
             "state": "running",
             "step": int(step),
             "total_steps": int(total_steps) if total_steps else None,
@@ -141,10 +173,16 @@ class RunHeartbeat:
         health = self.decode_health()
         if health is not None:
             payload["decode_health"] = health
-        if self._guard_trips or self._skipped_steps or \
-                "guard_trips" in self._last:
+        # keyed off "ever seen", not the newest record: a mixed-route
+        # train_dir whose trailing record carries no guard columns must not
+        # hide the cumulative totals
+        if self._guard_seen:
             payload["guard"] = {"trips": self._guard_trips,
                                 "skipped_steps": self._skipped_steps}
+        if self.ledger is not None and self.ledger.active:
+            # per-worker forensics (obs/forensics.AccusationLedger):
+            # top suspects, trust vector, episode counts
+            payload["forensics"] = self.ledger.summary()
         if extra:
             payload.update(extra)
         self._write(payload)
@@ -166,6 +204,7 @@ class RunHeartbeat:
         # a stale cause or resumable_step into a different final state
         payload = {k: v for k, v in self._last_payload.items()
                    if k not in ("state", "cause", "resumable_step")}
+        payload["schema"] = STATUS_SCHEMA  # present even with no prior beat
         payload["state"] = state
         payload["updated_at"] = time.time()
         if cause is not None:

@@ -49,10 +49,17 @@ def aggregate_flat_grads(grads: jnp.ndarray, adv_mask, cfg, code, rand_factor,
     traces group ops by Draco's reference phase names (the device-side
     counterpart of the host SpanTracer, draco_tpu/obs).
     """
+    from draco_tpu.obs import forensics as forensics_mod
     from draco_tpu.resilience import faults as faults_mod
 
     grads = faults_mod.corrupt_grads(grads, cfg, step)
     if cfg.approach == "cyclic":
+        # ingest-row health, BEFORE encode: a non-finite per-worker gradient
+        # row attributes to its worker here, where row k still means worker
+        # k — the shared-redundancy encode below smears any NaN across every
+        # codeword (0·NaN = NaN in the masked matmul), so the wire rows
+        # cannot (obs/forensics.nonfinite_rows docstring)
+        bad_rows = forensics_mod.nonfinite_rows(grads)
         with jax.named_scope("draco_encode"):
             if grads.ndim == 3:
                 # (n, hat_s, d): true per-worker redundant lanes
@@ -84,6 +91,7 @@ def aggregate_flat_grads(grads: jnp.ndarray, adv_mask, cfg, code, rand_factor,
                 agg, _honest, health = cyclic_mod.decode(
                     code, enc_re, enc_im, rand_factor, present=present,
                     with_health=True)
+        health["bad_rows"] = bad_rows
         return agg, health
     with jax.named_scope("draco_decode"):
         grads = attacks.inject_plain(grads, adv_mask, cfg.err_mode,
@@ -176,34 +184,65 @@ DECODE_HEALTH_NAMES = ("decode_residual", "located_errors", "det_tp",
 def token_metric_names(cfg) -> tuple:
     """Column order of the (K, m) metric block for an LM route at ``cfg``
     — every route builder stores this on its setup so the shared token
-    loop flushes the right schema."""
+    loop flushes the right schema. Coded routes additionally carry the
+    packed per-worker forensics masks (obs/forensics.mask_metric_names:
+    accused / present / seeded-adversary bitmask words riding the same
+    block); baseline routes emit neither health nor forensics columns."""
     names = TOKEN_METRIC_NAMES
     if cfg.approach == "cyclic":
-        names = names + DECODE_HEALTH_NAMES
+        from draco_tpu.obs.forensics import mask_metric_names
+
+        names = names + DECODE_HEALTH_NAMES \
+            + mask_metric_names(cfg.num_workers)
     if cfg.step_guard == "on":
         names = names + GUARD_METRIC_NAMES
     return names
 
 
+def accusation_mask(health, present=None):
+    """The step's per-worker accusation set from a coded health dict: the
+    code's own flag set ∪ the forensic-only signals — magnitude-outlier
+    ``loud`` rows (cyclic LOUD_REL_TOL: the attribution that survives the
+    beyond-budget regime) and non-finite ingest ``bad_rows``. Present-gated
+    at pack time too (forensics.pack_mask_columns): an absent worker is
+    never an accused worker."""
+    import jax.numpy as jnp
+
+    accused = jnp.asarray(health["flagged"], bool)
+    for key in ("loud", "bad_rows"):
+        if key in health:
+            accused = accused | jnp.asarray(health[key], bool)
+    if present is not None:
+        accused = accused & present
+    return accused
+
+
 def decode_health_metrics(health, adv_mask, present) -> dict:
-    """The DECODE_HEALTH_NAMES columns from a decode-health dict + the
-    step's seeded schedules ({} when the route has no exactness
-    certificate, i.e. health is None). The present-gated counting is the
-    one shared implementation (training/step._detection_metrics — a
-    straggling adversary's row never arrives, so it is neither detectable
-    nor ground truth); only the column name differs: the cyclic flag count
-    ships as ``located_errors``."""
+    """The DECODE_HEALTH_NAMES columns + the packed per-worker forensics
+    masks from a decode-health dict + the step's seeded schedules ({} when
+    the route has no exactness certificate, i.e. health is None). The
+    present-gated counting is the one shared implementation
+    (training/step._detection_metrics — a straggling adversary's row never
+    arrives, so it is neither detectable nor ground truth); only the column
+    name differs: the cyclic flag count ships as ``located_errors``. The
+    scalar detection counts keep their historical meaning (the decode's own
+    flag set, feeding the guard and the P/R fold); the packed ``accused``
+    mask is the wider forensic union (accusation_mask)."""
+    from draco_tpu.obs import forensics as forensics_mod
     from draco_tpu.training.step import _detection_metrics
 
     if health is None:
         return {}
     det = _detection_metrics(health["flagged"], adv_mask, present)
-    return {
+    out = {
         "decode_residual": health["residual"],
         "located_errors": det["det_flagged"],
         "det_tp": det["det_tp"],
         "det_adv": det["det_adv"],
     }
+    out.update(forensics_mod.pack_mask_columns(
+        accusation_mask(health, present), present, adv_mask))
+    return out
 
 
 def make_token_train_many(step_body, token_fn=None,

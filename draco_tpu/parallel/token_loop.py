@@ -52,6 +52,7 @@ from draco_tpu import rng as drng
 from draco_tpu.config import TrainConfig
 from draco_tpu.data.batching import chunk_ranges
 from draco_tpu.obs import NULL_TRACER, CompileWatch, RunHeartbeat
+from draco_tpu.obs.forensics import record_value
 from draco_tpu.resilience import faults as faults_mod
 from draco_tpu.resilience.supervisor import (
     GracefulStop,
@@ -167,7 +168,10 @@ def run_token_loop(setup, cfg: TrainConfig, steps: Optional[int] = None,
     is_main = jax.process_index() == 0
     writer = MetricWriter(cfg.train_dir or None, quiet=quiet)
     tracer = make_tracer(cfg.trace_dir, is_main)
-    heartbeat = RunHeartbeat(cfg.train_dir or None, enabled=is_main)
+    # num_workers keys the heartbeat's per-worker accusation ledger
+    # (obs/forensics.AccusationLedger), fed by the same observer hook
+    heartbeat = RunHeartbeat(cfg.train_dir or None, enabled=is_main,
+                             num_workers=cfg.num_workers)
     compile_watch = make_compile_watch(cfg, tracer, is_main)
     eval_toks = None
     if cfg.eval_freq:
@@ -286,8 +290,11 @@ def _run_eager(setup, cfg, state, start, last_step, adv, straggle, writer,
         # (the chunked driver observes every step for free at its flush)
         if step % cfg.log_every == 0:
             with tracer.span("sync"):
+                # record_value: forensics bitmask columns materialize as
+                # exact integer words (obs/forensics docstring)
                 record = {"step": step}
-                record.update({k: float(v) for k, v in metrics.items()})
+                record.update({k: record_value(k, v)
+                               for k, v in metrics.items()})
             heartbeat.observe(record)
             writer.write(record)
         boundary = cfg.eval_freq and step % cfg.eval_freq == 0

@@ -41,6 +41,7 @@ from draco_tpu.coding import cyclic as cyclic_mod
 from draco_tpu.coding import repetition as rep_mod
 from draco_tpu.data import augment as augment_mod
 from draco_tpu.models import build_model, input_shape
+from draco_tpu.obs import forensics as forensics_mod
 from draco_tpu.resilience import faults as faults_mod
 from draco_tpu.runtime import WORKER_AXIS
 
@@ -305,6 +306,12 @@ def build_train_setup(cfg: TrainConfig, mesh, dataset_name: Optional[str] = None
             out["flagged_groups"] = vhealth["flagged_groups"]
             out.update(_detection_metrics(vhealth["flagged"], adv_mask,
                                           present))
+            # per-worker forensics columns (obs/forensics): the vote's own
+            # out-voted set ∪ non-finite ingest rows, packed with the
+            # present + seeded-adversary masks to ride the metric block
+            out.update(forensics_mod.pack_mask_columns(
+                vhealth["flagged"] | forensics_mod.nonfinite_rows(grads),
+                present, adv_mask))
             # guard signals: finite vote + out-voted rows (vote
             # disagreement) within the s budget
             new_state = _maybe_guard(cfg, state, new_state, voted,
@@ -343,9 +350,12 @@ def build_train_setup(cfg: TrainConfig, mesh, dataset_name: Optional[str] = None
                 )(state.params, state.batch_stats, x, y, dkeys)
                 grads = jax.lax.with_sharding_constraint(grads, shard_w)
                 grads = faults_mod.corrupt_grads(grads, cfg, state.step)
+                # ingest-row forensics: attribute non-finite rows BEFORE the
+                # algebraic encode smears them (forensics.nonfinite_rows)
+                bad_rows = forensics_mod.nonfinite_rows(grads)
                 with jax.named_scope("draco_encode"):
                     enc_re, enc_im = cyclic_mod.encode_shared(code, grads)
-                return enc_re, enc_im, new_stats, losses, precs
+                return enc_re, enc_im, new_stats, losses, precs, bad_rows
 
         else:  # "simulate": the reference's true r× redundant compute
 
@@ -375,6 +385,9 @@ def build_train_setup(cfg: TrainConfig, mesh, dataset_name: Optional[str] = None
                     grads, NamedSharding(mesh, P(WORKER_AXIS, None, None))
                 )
                 grads = faults_mod.corrupt_grads(grads, cfg, state.step)
+                # ingest-row forensics: any non-finite value in worker i's
+                # hat_s redundant lanes attributes to worker i
+                bad_rows = forensics_mod.nonfinite_rows(grads)
                 with jax.named_scope("draco_encode"):
                     enc_re, enc_im = cyclic_mod.encode(code, grads)
                 # fold the per-sub-batch stats back to one per worker
@@ -383,10 +396,12 @@ def build_train_setup(cfg: TrainConfig, mesh, dataset_name: Optional[str] = None
                     if has_bn
                     else None
                 )
-                return enc_re, enc_im, new_stats, jnp.mean(losses, 1), jnp.mean(precs, 1)
+                return (enc_re, enc_im, new_stats, jnp.mean(losses, 1),
+                        jnp.mean(precs, 1), bad_rows)
 
         def step_body(state: TrainState, x, y, adv_mask, present=None):
-            enc_re, enc_im, new_stats, losses, precs = compute_encoded(state, x, y)
+            (enc_re, enc_im, new_stats, losses, precs,
+             bad_rows) = compute_encoded(state, x, y)
             with jax.named_scope("draco_encode"):
                 enc_re, enc_im = attacks.inject_cyclic(enc_re, enc_im, adv_mask,
                                                        cfg.err_mode, adv_mag)
@@ -424,9 +439,11 @@ def build_train_setup(cfg: TrainConfig, mesh, dataset_name: Optional[str] = None
             # made observable, the flag set scores against the seeded
             # schedules — all in-graph, no host traffic. One schema with
             # the LM routes (common.decode_health_metrics; imported lazily,
-            # parallel/__init__ imports this module)
+            # parallel/__init__ imports this module). The packed forensics
+            # masks ride along (accused = flagged ∪ loud ∪ bad_rows)
             from draco_tpu.parallel.common import decode_health_metrics
 
+            health["bad_rows"] = bad_rows
             out.update(decode_health_metrics(health, adv_mask, present))
             # guard signals: finite decode + loud residual + located rows
             # beyond the locator budget (the beyond-budget fault class)
@@ -472,11 +489,16 @@ def build_train_setup(cfg: TrainConfig, mesh, dataset_name: Optional[str] = None
     from draco_tpu.parallel.common import DECODE_HEALTH_NAMES
 
     metric_names = ("loss", "prec1")
+    # coded approaches append the packed per-worker forensics masks
+    # (obs/forensics.mask_metric_names); baseline emits no columns at all —
+    # no exactness certificate, no accusation set
     if cfg.approach == "cyclic":
-        metric_names += ("honest_located",) + DECODE_HEALTH_NAMES
+        metric_names += (("honest_located",) + DECODE_HEALTH_NAMES
+                         + forensics_mod.mask_metric_names(n))
     elif cfg.approach == "maj_vote":
-        metric_names += ("vote_agree", "flagged_groups", "det_flagged",
-                         "det_tp", "det_adv")
+        metric_names += (("vote_agree", "flagged_groups", "det_flagged",
+                          "det_tp", "det_adv")
+                         + forensics_mod.mask_metric_names(n))
     if cfg.step_guard == "on":
         # guard columns ride the same (K, m) block (resilience/guards.py)
         from draco_tpu.resilience.guards import GUARD_METRIC_NAMES

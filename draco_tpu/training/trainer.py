@@ -38,6 +38,7 @@ from draco_tpu.data import batching
 from draco_tpu.data.datasets import Dataset, load_dataset
 from draco_tpu.data.prefetch import BatchPrefetcher, ChunkPrefetcher
 from draco_tpu.obs import RunHeartbeat, make_compile_watch, make_tracer
+from draco_tpu.obs.forensics import record_value
 from draco_tpu.resilience import faults as faults_mod
 from draco_tpu.resilience.supervisor import (
     GracefulStop,
@@ -68,8 +69,12 @@ class Trainer:
         # no-ops off the metrics-emitting process, and the tracer is the
         # allocation-free NULL_TRACER when disabled
         self.tracer = make_tracer(cfg.trace_dir, self._is_main)
+        # num_workers keys the heartbeat's per-worker accusation ledger
+        # (obs/forensics.AccusationLedger) — it folds the packed forensics
+        # mask columns at the same observer hook, zero extra fetches
         self.heartbeat = RunHeartbeat(cfg.train_dir or None,
-                                      enabled=self._is_main)
+                                      enabled=self._is_main,
+                                      num_workers=cfg.num_workers)
         # compile/retrace sentinel (obs/compile_watch.py): every XLA
         # executable build lands in compiles.jsonl + the trace's compile
         # lane, and a steady-state recompile of a labelled program trips
@@ -328,7 +333,9 @@ class Trainer:
                                                                 y, mask,
                                                                 present)
             with self.tracer.span("sync", step=step):
-                metrics = {k: float(v) for k, v in metrics.items()}
+                # record_value: forensics bitmask columns materialize as
+                # exact integer words, everything else as float
+                metrics = {k: record_value(k, v) for k, v in metrics.items()}
                 if present is not None:
                     metrics["present"] = float(present.sum())
                 jax.block_until_ready(self.state.params)

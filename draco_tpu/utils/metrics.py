@@ -14,6 +14,8 @@ from typing import Optional
 
 import numpy as np
 
+from draco_tpu.obs.forensics import record_value
+
 
 class MetricWriter:
     """JSONL metrics to ``train_dir/metrics.jsonl`` + human lines to stdout.
@@ -122,8 +124,12 @@ class DeferredMetricWriter:
             vals = np.asarray(block)  # blocks until the chunk has executed
             for i, step in enumerate(steps):
                 rec = {"step": step}
+                # record_value: packed forensics bitmask columns become
+                # exact integer words (a float()/JSON round trip would
+                # destroy NaN-pattern payloads — obs/forensics docstring)
                 rec.update(
-                    {k: float(vals[i, j]) for j, k in enumerate(names)}
+                    {k: record_value(k, vals[i, j])
+                     for j, k in enumerate(names)}
                 )
                 for k, v in extras.items():
                     rec[k] = float(v[i]) if np.ndim(v) else float(v)

@@ -132,6 +132,8 @@ def _assert_route_telemetry(route, kw, run_dir):
         open(os.path.join(run_dir, "status.json"))).get("guard")
     assert status_guard == {"trips": 0.0, "skipped_steps": 0.0}
     if kw.get("approach") == "cyclic":
+        from draco_tpu.obs import forensics as fx
+
         n = kw["num_workers"]
         adv = drng.adversary_schedule(428, 8, n, kw["adversary_count"])
         strag = drng.straggler_schedule(428, 8, n, kw["straggle_count"])
@@ -141,12 +143,27 @@ def _assert_route_telemetry(route, kw, run_dir):
             assert r["det_tp"] == want  # recall = 1.0
             assert r["located_errors"] == want  # precision = 1.0
             assert r["decode_residual"] < 1e-3
-        health = json.load(open(os.path.join(run_dir,
-                                             "status.json")))["decode_health"]
+            # per-worker attribution exact (packed forensics masks, ISSUE
+            # 7): accused == adversarial ∧ present, bit for bit — an
+            # absent worker is never an accused worker
+            masks = fx.record_masks(r, n)
+            assert masks is not None, r
+            assert masks["adv"] == tuple(adv[r["step"]])
+            assert masks["present"] == tuple(~strag[r["step"]])
+            assert masks["accused"] == tuple(
+                adv[r["step"]] & ~strag[r["step"]]), (r["step"], masks)
+        status = json.load(open(os.path.join(run_dir, "status.json")))
+        health = status["decode_health"]
         assert health["precision"] == 1.0 and health["recall"] == 1.0
         assert health["adv_total"] > 0
+        # the per-worker ledger block + versioned schema (ISSUE 7)
+        fxb = status["forensics"]
+        assert fxb["num_workers"] == n and fxb["accused_total"] > 0
+        assert fxb["top_suspects"]
+        assert status["schema"] == 2
     else:
         assert all("det_tp" not in r for r in train)
+        assert all("wmask_accused0" not in r for r in train)
     trace = json.load(open(os.path.join(run_dir, "trace.json")))
     events = trace["traceEvents"]
     spans = [e for e in events if e["ph"] == "X"]

@@ -140,8 +140,13 @@ def _assert_decode_health(approach, stream, kw):
     detection precision AND recall are 1.0 against the seeded adversary +
     straggler schedules — flagged set == live adversary set, step by step —
     and the cyclic residual sits at float noise (the exactness guarantee
-    observable). The baseline approach has no exactness certificate and
-    must emit no health columns."""
+    observable). The packed per-worker forensics masks (obs/forensics,
+    ISSUE 7) pin the attribution EXACTLY: accused == adversarial ∧ present
+    bit for bit (per-worker precision/recall 1.0 — an absent worker is
+    never an accused worker). The baseline approach has no exactness
+    certificate and must emit neither health nor forensics columns."""
+    from draco_tpu.obs import forensics as fx
+
     n = kw.get("num_workers", 8)
     adv = drng.adversary_schedule(428, 6, n, kw.get("adversary_count",
                                                     kw["worker_fail"]))
@@ -154,11 +159,19 @@ def _assert_decode_health(approach, stream, kw):
         assert vals["skipped_steps"] == 0.0, (step, vals)
         if approach == "baseline":
             assert "det_tp" not in vals and "decode_residual" not in vals
+            assert "wmask_accused0" not in vals
             continue
         want = int((adv[step] & ~strag[step]).sum())  # detectable truth
         assert vals["det_adv"] == want, (step, vals)
         assert vals["det_tp"] == want  # recall = 1.0
         assert vals[flag_col[approach]] == want  # precision = 1.0
+        masks = fx.record_masks(vals, n)
+        assert masks is not None, (step, vals)
+        assert masks["adv"] == tuple(adv[step]), step
+        assert masks["present"] == tuple(~strag[step]), step
+        # per-worker attribution exact: accused == adversarial ∧ present
+        assert masks["accused"] == tuple(adv[step] & ~strag[step]), (
+            step, masks)
         if approach == "cyclic":
             assert vals["decode_residual"] < 1e-3
         else:
@@ -206,10 +219,19 @@ def _assert_telemetry_artifacts(run_dir, approach):
     assert len(compile_events) == len(ledger) == status["compiles"]
     if approach == "baseline":
         assert "decode_health" not in status
+        assert "forensics" not in status
     else:
         health = status["decode_health"]
         assert health["precision"] == 1.0 and health["recall"] == 1.0
         assert health["adv_total"] > 0  # the adversary was really live
+        # the per-worker ledger (ISSUE 7): accusations exist, every accused
+        # worker was truly adversarial (per-worker precision/recall 1.0),
+        # and status carries the versioned schema
+        fxb = status["forensics"]
+        assert fxb["accused_total"] > 0 and fxb["episodes_total"] > 0
+        assert fxb["top_suspects"] and all(
+            t["trust"] < 1.0 for t in fxb["top_suspects"])
+        assert status["schema"] == 2
 
 
 @pytest.mark.core

@@ -467,6 +467,97 @@ def test_perf_watch_snapshot_and_injected_regression(tmp_path):
     assert {"lint.p1.peak_bytes", "host_loop.cnn.k8_timed_builds"} <= regs
 
 
+def test_forensics_report_smoke(tmp_path, capsys):
+    """tools/forensics_report.py (jax-free): folds a metrics.jsonl with
+    packed mask columns into the per-worker table + episode list and
+    writes forensics.json; tolerates a torn tail line, an empty file, and
+    a missing file exactly like trace_report."""
+    import json
+
+    from tools import forensics_report
+
+    def rec(step, accused, present, adv):
+        words = lambda bits: sum(1 << i for i, b in enumerate(bits) if b)
+        return {"step": step, "loss": 1.0,
+                "wmask_accused0": words(accused),
+                "wmask_present0": words(present),
+                "wmask_adv0": words(adv)}
+
+    d = tmp_path / "run"
+    d.mkdir()
+    ones = [1] * 4
+    with open(d / "metrics.jsonl", "w") as fh:
+        # worker 2 adversarial for steps 1-2 (one episode), clean step 3;
+        # worker 0 absent at step 2; an eval record and a torn tail ride
+        fh.write(json.dumps(rec(1, [0, 0, 1, 0], ones, [0, 0, 1, 0])) + "\n")
+        fh.write(json.dumps(rec(2, [0, 0, 1, 0], [0, 1, 1, 1],
+                                [0, 0, 1, 0])) + "\n")
+        fh.write(json.dumps(rec(3, [0, 0, 0, 0], ones, [0, 0, 0, 0])) + "\n")
+        fh.write(json.dumps({"step": 3, "split": "eval", "loss": 0.9})
+                 + "\n\n")
+        fh.write('{"step": 4, "los')  # torn tail of a killed run
+
+    rc = forensics_report.main([str(d), "--num-workers", "4"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "3/3 records carried masks" in out
+    assert "worker 2: steps 1-2 (2 accused)" in out
+    assert "top suspects: w2" in out
+    rep = json.loads((d / "forensics.json").read_text())
+    w2 = rep["workers"][2]
+    assert w2["accused"] == 2 and w2["tp"] == 2 and w2["precision"] == 1.0
+    assert rep["workers"][0]["present"] == 2  # absent step not counted
+    assert len(rep["episodes"]) == 1 and not rep["episodes"][0]["open"]
+    # worker count can come from the present masks when the flag is absent
+    rep2 = forensics_report.make_report(str(d / "metrics.jsonl"))
+    assert rep2["num_workers"] == 4
+
+    # empty + missing files fold to an empty report, not a crash
+    e = tmp_path / "empty"
+    e.mkdir()
+    (e / "metrics.jsonl").write_text("")
+    assert forensics_report.main([str(e)]) == 0
+    assert "no forensics columns" in capsys.readouterr().out
+    m = tmp_path / "missing"
+    m.mkdir()
+    assert forensics_report.main([str(m)]) == 0
+
+
+def test_perf_watch_gates_on_flipped_chaos_attribution(tmp_path):
+    """A worker-targeted chaos cell whose forensics attribution flips to
+    false must gate perf_watch nonzero (tolerance 0) and name the cell."""
+    import json
+
+    from tools import perf_watch
+
+    root = tmp_path
+    (root / "baselines_out").mkdir()
+    matrix = {"all_ok": True, "rows": [
+        {"loop": "cnn_k4", "fault": "nan_grad", "ok": True,
+         "outcome": "guarded", "injected": [3], "accused": [3],
+         "attributed": True},
+        {"loop": "cnn_k4", "fault": "sigterm", "ok": True,
+         "outcome": "preempted_resumed"},
+    ]}
+    (root / "baselines_out" / "chaos_matrix.json").write_text(
+        json.dumps(matrix))
+    assert perf_watch.main(["--root", str(root), "--snapshot"]) == 0
+    snap = json.loads(
+        (root / "baselines_out" / "perf_watch.json").read_text())
+    assert "chaos.cnn_k4.nan_grad.attributed" in snap["metrics"]
+    assert "chaos.cnn_k4.sigterm.attributed" not in snap["metrics"]
+    assert perf_watch.main(["--root", str(root)]) == 0  # clean
+
+    matrix["rows"][0]["attributed"] = False  # the forensics regression
+    matrix["rows"][0]["accused"] = [0, 7]
+    (root / "baselines_out" / "chaos_matrix.json").write_text(
+        json.dumps(matrix))
+    out = root / "report.json"
+    assert perf_watch.main(["--root", str(root), "--json", str(out)]) == 1
+    regs = [r["metric"] for r in json.loads(out.read_text())["regressions"]]
+    assert "chaos.cnn_k4.nan_grad.attributed" in regs
+
+
 def test_perf_watch_passes_on_committed_artifacts():
     """The committed baselines_out/perf_watch.json snapshot must match the
     committed round artifacts — the same gate a future round runs."""

@@ -1,5 +1,6 @@
-"""Telemetry spine (draco_tpu/obs + in-graph decode health, ISSUE 4) and
-the compile/retrace sentinel (obs/compile_watch.py, ISSUE 5).
+"""Telemetry spine (draco_tpu/obs + in-graph decode health, ISSUE 4), the
+compile/retrace sentinel (obs/compile_watch.py, ISSUE 5), and per-worker
+Byzantine forensics (obs/forensics.py, ISSUE 7).
 
 Unit layer: the span tracer emits valid Chrome trace events and is a strict
 no-op when disabled; the heartbeat folds per-step detection counts into
@@ -172,6 +173,324 @@ def test_heartbeat_disabled_is_noop(tmp_path):
     hb3 = RunHeartbeat(str(tmp_path))
     hb3.observe({"step": 1, "loss": 1.0})
     assert "decode_health" not in hb3.beat(1, 2)
+
+
+@pytest.mark.core
+def test_heartbeat_schema_version(tmp_path):
+    """Every status.json payload — beats AND terminals, including a
+    terminal written before any beat — carries the schema version
+    (consumers assert it when present, tolerate its absence)."""
+    from draco_tpu.obs import STATUS_SCHEMA
+
+    hb = RunHeartbeat(str(tmp_path))
+    payload = hb.beat(1, 2)
+    assert payload["schema"] == STATUS_SCHEMA
+    assert json.load(open(tmp_path / "status.json"))["schema"] == \
+        STATUS_SCHEMA
+    hb2 = RunHeartbeat(str(tmp_path / "crash_early"))
+    term = hb2.terminal("crashed", cause="boom")  # no beat ever happened
+    assert term["schema"] == STATUS_SCHEMA and term["state"] == "crashed"
+
+
+@pytest.mark.core
+def test_heartbeat_tolerates_missing_column_families(tmp_path):
+    """Optional column families (health / guard / forensics) may be absent
+    per record — a baseline route emits none, eval records carry none, and
+    a mixed-route train_dir interleaves both. Records without a family
+    must not advance or poison its accumulators, and a TRAILING record
+    without the health family must not hide the cumulative health block
+    (regression: decode_health() used to key off the newest record)."""
+    hb = RunHeartbeat(str(tmp_path), num_workers=4)
+    hb.observe({"step": 1, "loss": 2.0, "located_errors": 1.0,
+                "det_tp": 1.0, "det_adv": 1.0, "guard_trips": 0.0,
+                "skipped_steps": 0.0, "decode_residual": 1e-7})
+    # baseline-route record: no health, no guard, no forensics columns
+    hb.observe({"step": 2, "loss": 1.9})
+    payload = hb.beat(2, 4)
+    h = payload["decode_health"]
+    assert h["precision"] == 1.0 and h["recall"] == 1.0
+    assert h["flagged_total"] == 1.0 and h["adv_total"] == 1.0
+    assert h["decode_residual"] == pytest.approx(1e-7)
+    assert payload["guard"] == {"trips": 0.0, "skipped_steps": 0.0}
+    assert payload["loss"] == pytest.approx(1.9)  # progress still newest
+    # an eval-shaped record (no step-metrics at all) is equally harmless
+    hb.observe({"step": 2, "split": "eval"})
+    assert hb.beat(2, 4)["decode_health"]["adv_total"] == 1.0
+
+
+# --------------------------------------------------------------------------
+# obs/forensics.py — packed masks, record round trip, the ledger
+# --------------------------------------------------------------------------
+
+@pytest.mark.core
+def test_forensics_mask_pack_roundtrip():
+    """pack -> f32 block -> host record int -> JSON -> unpack is exact for
+    every n in the supported range, INCLUDING masks whose packed word is a
+    float32 NaN bit pattern (workers 23..30 all accused) — the case a
+    float()/JSON path would silently destroy. n > 64 raises the named
+    bound."""
+    from draco_tpu.obs import forensics as fx
+
+    rng = np.random.RandomState(7)
+    for n in (1, 7, 24, 31, 32, 33, 64):
+        for _ in range(10):
+            m = rng.rand(n) < 0.5
+            packed = np.asarray(jax.jit(fx.pack_bits)(jnp.asarray(m)))
+            assert packed.dtype == np.float32
+            assert packed.shape == (fx.num_mask_words(n),)
+            words = [fx.record_value(f"{fx.MASK_PREFIX}accused0", w)
+                     for w in packed]
+            words = json.loads(json.dumps(words))  # the JSONL round trip
+            assert all(isinstance(w, int) for w in words)
+            assert fx.unpack_bits(words, n) == tuple(bool(b) for b in m)
+    # adversarial patterns: packed word is an f32 NaN / Inf bit pattern
+    for n, idx in ((32, range(23, 32)), (32, range(0, 32)),
+                   (31, range(23, 31))):
+        m = np.array([i in idx for i in range(n)])
+        packed = np.asarray(fx.pack_bits(jnp.asarray(m)))
+        words = json.loads(json.dumps(
+            [fx.record_value(f"{fx.MASK_PREFIX}adv0", w) for w in packed]))
+        assert fx.unpack_bits(words, n) == tuple(m)
+    with pytest.raises(ValueError, match="num_workers <= 64"):
+        fx.num_mask_words(65)
+    assert fx.mask_metric_names(8) == (
+        "wmask_accused0", "wmask_present0", "wmask_adv0")
+    assert len(fx.mask_metric_names(33)) == 6  # two words per kind
+
+
+@pytest.mark.core
+def test_forensics_pack_bits_sharded_mask_matches_replicated():
+    """Regression (caught by the chaos tp cell): packing a mesh-SHARDED
+    mask must agree bit-for-bit with packing the same mask replicated. The
+    original pad-concat+reshape formulation shifted every bit by one under
+    the GSPMD partitioner on the w×tp mesh — worker 3's accusation landed
+    on bit 4 — while the fetched mask itself was correct."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from draco_tpu.obs import forensics as fx
+    from draco_tpu.parallel.mesh import make_mesh_wtp
+    from draco_tpu.runtime import WORKER_AXIS
+
+    mesh = make_mesh_wtp(4, 2)
+    rng = np.random.RandomState(11)
+    for _ in range(8):
+        mask = rng.rand(8) < 0.4
+        md = jax.device_put(jnp.asarray(mask),
+                            NamedSharding(mesh, P(WORKER_AXIS)))
+        with mesh:
+            sharded = np.asarray(jax.jit(fx.pack_bits)(md))
+        replicated = np.asarray(fx.pack_bits(jnp.asarray(mask)))
+        np.testing.assert_array_equal(sharded.view(np.uint32),
+                                      replicated.view(np.uint32))
+        assert fx.unpack_bits(
+            [int(w) for w in sharded.view(np.uint32)], 8
+        ) == tuple(bool(b) for b in mask)
+
+
+@pytest.mark.core
+def test_forensics_pack_gates_absent_workers():
+    """An absent worker is never an accused worker: pack_mask_columns
+    re-gates the accusation set by presence, whatever the caller passed."""
+    from draco_tpu.obs import forensics as fx
+
+    accused = jnp.asarray([True, True, False, False])
+    present = jnp.asarray([True, False, True, False])
+    cols = fx.pack_mask_columns(accused, present, jnp.zeros(4, bool))
+    masks = fx.record_masks(
+        {k: fx.record_value(k, v) for k, v in cols.items()}, 4)
+    assert masks["accused"] == (True, False, False, False)
+    assert masks["present"] == (True, False, True, False)
+    # present=None means everyone present
+    cols = fx.pack_mask_columns(accused, None, jnp.zeros(4, bool))
+    masks = fx.record_masks(
+        {k: fx.record_value(k, v) for k, v in cols.items()}, 4)
+    assert masks["present"] == (True,) * 4
+    assert masks["accused"] == (True, True, False, False)
+
+
+def _mask_record(step, accused, present, adv):
+    """A materialized record with packed forensics columns (host ints)."""
+    from draco_tpu.obs import forensics as fx
+
+    cols = fx.pack_mask_columns(jnp.asarray(accused, bool),
+                                jnp.asarray(present, bool),
+                                jnp.asarray(adv, bool))
+    rec = {"step": step, "loss": 1.0}
+    rec.update({k: fx.record_value(k, v) for k, v in cols.items()})
+    return rec
+
+
+@pytest.mark.core
+def test_accusation_ledger_counters_trust_episodes():
+    """The ledger folds per-step masks into per-worker counters, an EW
+    trust score, and attack EPISODES: consecutive accusations are one
+    episode; a present-and-clean step closes it; an ABSENT step neither
+    accuses nor exonerates (the episode stays open across the gap)."""
+    from draco_tpu.obs.forensics import AccusationLedger
+
+    lg = AccusationLedger(4)
+    ones = [True] * 4
+    # steps 1-3: worker 1 accused (and truly adversarial)
+    for step in (1, 2, 3):
+        assert lg.observe(_mask_record(step, [0, 1, 0, 0], ones,
+                                       [0, 1, 0, 0]))
+    # step 4: worker 1 ABSENT — not accused, episode must stay open
+    assert lg.observe(_mask_record(4, [0, 0, 0, 0], [1, 0, 1, 1],
+                                   [0, 0, 0, 0]))
+    # step 5: worker 1 back and accused again — SAME episode, extended;
+    # worker 2 falsely accused (honest) — a new 1-step episode
+    assert lg.observe(_mask_record(5, [0, 1, 1, 0], ones, [0, 1, 0, 0]))
+    # step 6: everyone clean — both episodes close
+    assert lg.observe(_mask_record(6, [0, 0, 0, 0], ones, [0, 0, 0, 0]))
+    # a record with no forensics columns is ignored, not an error
+    assert not lg.observe({"step": 7, "loss": 0.5})
+
+    rows = {r["worker"]: r for r in lg.worker_rows()}
+    assert rows[1]["accused"] == 4 and rows[1]["tp"] == 4
+    assert rows[1]["present"] == 5  # absent step 4 not counted
+    assert rows[1]["precision"] == 1.0 and rows[1]["recall"] == 1.0
+    assert rows[2]["accused"] == 1 and rows[2]["fp"] == 1
+    assert rows[2]["precision"] == 0.0  # falsely accused once, never adv
+    assert rows[0]["accused"] == 0 and rows[0]["trust"] == 1.0
+    assert rows[1]["trust"] < rows[2]["trust"] < 1.0
+    eps = lg.all_episodes()
+    assert len(eps) == 2 and not lg.open_episodes()
+    w1 = next(e for e in eps if e["worker"] == 1)
+    assert (w1["start"], w1["end"], w1["steps"]) == (1, 5, 4)
+    w2 = next(e for e in eps if e["worker"] == 2)
+    assert (w2["start"], w2["end"], w2["steps"]) == (5, 5, 1)
+    summary = lg.summary()
+    assert summary["top_suspects"][0]["worker"] == 1
+    assert summary["open_episodes"] == 0 and summary["episodes_total"] == 2
+
+    # an episode still running at the last step reports as open
+    lg2 = AccusationLedger(2)
+    lg2.observe(_mask_record(1, [1, 0], [1, 1], [1, 0]))
+    lg2.observe(_mask_record(2, [1, 0], [1, 1], [1, 0]))
+    (ep,) = lg2.open_episodes()
+    assert ep["open"] and ep["steps"] == 2
+    assert lg2.summary()["open_episodes"] == 1
+
+
+@pytest.mark.core
+def test_heartbeat_forensics_block(tmp_path):
+    """status.json grows the forensics block when the route ships mask
+    columns and num_workers is wired; stays absent otherwise."""
+    hb = RunHeartbeat(str(tmp_path), num_workers=4)
+    hb.observe(_mask_record(1, [0, 0, 1, 0], [1, 1, 1, 1], [0, 0, 1, 0]))
+    payload = hb.beat(1, 2)
+    fx_block = payload["forensics"]
+    assert fx_block["num_workers"] == 4
+    assert fx_block["top_suspects"] == [
+        {"worker": 2, "accused": 1, "trust": fx_block["trust"][2]}]
+    assert fx_block["open_episodes"] == 1
+    # no num_workers -> no ledger -> no block (backward compatible)
+    hb2 = RunHeartbeat(str(tmp_path / "plain"))
+    hb2.observe(_mask_record(1, [0, 1], [1, 1], [0, 1]))
+    assert "forensics" not in hb2.beat(1, 2)
+
+
+@pytest.mark.core
+def test_forensics_straggler_never_accused_both_codes():
+    """End of the in-graph chain for both codes under straggler drops: the
+    packed accusation set never contains an absent worker — an erasure is
+    known-missing, not evidence (cyclic flags present rows only; the vote
+    neither counts nor flags absent members; pack re-gates by presence)."""
+    from draco_tpu.coding import cyclic, repetition
+    from draco_tpu.obs import forensics as fx
+    from draco_tpu.parallel.common import accusation_mask
+
+    rng = np.random.RandomState(5)
+    code = cyclic.build_cyclic_code(8, 1)
+    g = rng.randn(8, 64).astype(np.float32)
+    rf = jnp.asarray(1.0 + rng.randn(64).astype(np.float32))
+    er, ei = cyclic.encode_shared(code, jnp.asarray(g))
+    # worker 6 is an adversary AND worker 2 straggles (t+e <= s... s=1:
+    # use an erasure-only step and an adversary-only step)
+    pres = jnp.asarray(np.arange(8) != 2)
+    er_d = er * pres[:, None]
+    ei_d = ei * pres[:, None]
+    _, _, h = cyclic.decode(code, er_d, ei_d, rf, present=pres,
+                            with_health=True)
+    h["bad_rows"] = fx.nonfinite_rows(jnp.asarray(g))
+    accused = np.asarray(accusation_mask(h, pres))
+    assert not accused[2]  # absent != accused
+    assert accused.sum() == 0  # erasure-only: nobody accused
+
+    rep = repetition.build_repetition_code(8, 4)
+    rows = np.tile(rng.randn(2, 1, 16).astype(np.float32),
+                   (1, 4, 1)).reshape(8, 16)
+    bad = rows.copy()
+    bad[5] *= -100.0  # adversary... who also straggles
+    pres = jnp.asarray(np.arange(8) != 5)
+    _, vh = repetition.majority_vote(rep, jnp.asarray(bad), present=pres,
+                                     with_health=True)
+    cols = fx.pack_mask_columns(
+        vh["flagged"] | fx.nonfinite_rows(jnp.asarray(bad)), pres,
+        jnp.asarray(np.arange(8) == 5))
+    masks = fx.record_masks(
+        {k: fx.record_value(k, v) for k, v in cols.items()}, 8)
+    assert not any(masks["accused"])  # its row never arrived
+
+
+@pytest.mark.core
+def test_cyclic_loud_rows_attribute_beyond_budget():
+    """The forensic-only loud-row mask: beyond the locator budget (2
+    corrupt rows, s=1) the fitted-codeword flag set is blind to rows the
+    fit absorbed, but the magnitude outliers ARE the corrupt rows — the
+    accusation union must name both. In budget, loud adds nothing beyond
+    the exact flag set (precision stays 1.0)."""
+    from draco_tpu.coding import cyclic
+    from draco_tpu.parallel.common import accusation_mask
+
+    code = cyclic.build_cyclic_code(8, 1)
+    rng = np.random.RandomState(0)
+    g = rng.randn(8, 64).astype(np.float32)
+    rf = jnp.asarray(1.0 + rng.randn(64).astype(np.float32))
+    er, ei = cyclic.encode_shared(code, jnp.asarray(g))
+    for rows in ([2, 5], [0, 4], [1, 6], [3, 7]):
+        er2, ei2 = er, ei
+        for r in rows:
+            er2, ei2 = er2.at[r].mul(-100.0), ei2.at[r].mul(-100.0)
+        _, _, h = cyclic.decode(code, er2, ei2, rf, with_health=True)
+        accused = np.asarray(accusation_mask(h))
+        assert set(rows) <= set(np.nonzero(accused)[0].tolist()), (
+            rows, np.nonzero(accused)[0])
+    # in budget: accusation == the exact flag set (no honest loud rows)
+    er1, ei1 = er.at[3].mul(-100.0), ei.at[3].mul(-100.0)
+    _, _, h1 = cyclic.decode(code, er1, ei1, rf, with_health=True)
+    np.testing.assert_array_equal(np.asarray(accusation_mask(h1)),
+                                  np.arange(8) == 3)
+    # clean: nobody accused
+    _, _, h0 = cyclic.decode(code, er, ei, rf, with_health=True)
+    assert np.asarray(accusation_mask(h0)).sum() == 0
+
+
+@pytest.mark.core
+def test_nonfinite_rows_attribute_through_shared_encode():
+    """A NaN gradient row smears across EVERY codeword under the shared
+    algebraic encode (0·NaN = NaN), so the wire can't attribute it — the
+    ingest check (nonfinite_rows on the raw rows) must, exactly."""
+    from draco_tpu.coding import cyclic
+    from draco_tpu.obs import forensics as fx
+    from draco_tpu.parallel.common import accusation_mask
+
+    code = cyclic.build_cyclic_code(8, 1)
+    rng = np.random.RandomState(1)
+    g = rng.randn(8, 64).astype(np.float32)
+    g[3, 17] = np.nan
+    rf = jnp.asarray(1.0 + rng.randn(64).astype(np.float32))
+    er, ei = cyclic.encode_shared(code, jnp.asarray(g))
+    assert not np.isfinite(np.asarray(er)).all(axis=1).any()  # all smeared
+    _, _, h = cyclic.decode(code, er, ei, rf, with_health=True)
+    h["bad_rows"] = fx.nonfinite_rows(jnp.asarray(g))
+    np.testing.assert_array_equal(np.asarray(accusation_mask(h)),
+                                  np.arange(8) == 3)
+    # the (n, hat_s, d) simulate-mode stack reduces over the lane axes too
+    g3 = rng.randn(4, 3, 8).astype(np.float32)
+    g3[2, 1, 0] = np.inf
+    np.testing.assert_array_equal(np.asarray(fx.nonfinite_rows(
+        jnp.asarray(g3))), np.arange(4) == 2)
 
 
 # --------------------------------------------------------------------------
@@ -534,6 +853,49 @@ def test_trace_report_folds_trace_and_metrics(tmp_path, capsys):
     table = capsys.readouterr().out
     assert "dispatch" in table and "80.0%" in table
     assert json.load(open(out_json))["phases"]["gather"]["count"] == 1
+
+
+@pytest.mark.core
+def test_trace_report_surfaces_guard_and_decode_health(tmp_path, capsys):
+    """The jax-free report header folds the PR 6 guard columns (cumulative
+    trips/skips) and the run's decode-health precision/recall from the
+    per-step counts — previously invisible to this path — and validates
+    the status.json schema version when one is present."""
+    from draco_tpu.obs import STATUS_SCHEMA
+    from tools.trace_report import fold_status, main, make_report
+
+    events = [{"name": "dispatch", "ph": "X", "ts": 0.0, "dur": 1000.0,
+               "pid": 1, "tid": 1}]
+    (tmp_path / "trace.json").write_text(json.dumps(
+        {"traceEvents": events}))
+    with open(tmp_path / "metrics.jsonl", "w") as fh:
+        fh.write(json.dumps({"step": 1, "loss": 2.0, "guard_trips": 0.0,
+                             "skipped_steps": 0.0, "located_errors": 1.0,
+                             "det_tp": 1.0, "det_adv": 1.0}) + "\n")
+        fh.write(json.dumps({"step": 2, "loss": 9.0, "guard_trips": 2.0,
+                             "skipped_steps": 1.0, "located_errors": 2.0,
+                             "det_tp": 1.0, "det_adv": 1.0}) + "\n")
+    (tmp_path / "status.json").write_text(json.dumps(
+        {"schema": STATUS_SCHEMA, "state": "done", "step": 2}))
+
+    report = make_report(str(tmp_path / "trace.json"),
+                         str(tmp_path / "metrics.jsonl"))
+    m = report["metrics"]
+    assert m["guard_trips"] == 2.0 and m["skipped_steps"] == 1.0
+    assert m["det_precision"] == round(2 / 3, 4)  # rounded in the fold
+    assert m["det_recall"] == 1.0
+    assert report["run_status"]["schema"] == STATUS_SCHEMA
+    rc = main([str(tmp_path)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "guard: trips=2 skipped_steps=1" in out
+    assert "decode health: precision=0.6667 recall=1.0000" in out
+
+    # an unknown schema version is a loud failure, not a silent misfold
+    (tmp_path / "status.json").write_text(json.dumps(
+        {"schema": 99, "state": "done"}))
+    with pytest.raises(SystemExit, match="schema 99"):
+        fold_status(str(tmp_path / "status.json"))
 
 
 @pytest.mark.core

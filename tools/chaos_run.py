@@ -25,10 +25,21 @@ eyeballed:
                       says "crashed" with a cause (graceful: diagnosable,
                       no hang, no raw traceback class)
   FAILED              anything else — an unnamed error, a wrong terminal
-                      state, or a divergent resume. ``all_ok`` goes false.
+                      state, a divergent resume, or (worker-targeted
+                      faults) an unattributed survival. ``all_ok`` goes
+                      false.
+
+Worker-targeted faults (``nan_grad:w<k>``, ``over_budget``) additionally
+must ATTRIBUTE: the per-worker forensics columns (obs/forensics.py, ISSUE
+7) at the fault step have to accuse every injected worker — the cell
+records ``injected`` / ``accused`` / ``attributed`` and an unattributed
+survival is a FAILED cell, because "the guard saved the run but nobody
+knows whose fault it was" is exactly the observability gap this layer
+closes.
 
 ``tools/perf_watch.py`` folds the committed matrix, so a fault class
-silently flipping from masked/guarded to FAILED gates nonzero.
+silently flipping from masked/guarded to FAILED — or an ``attributed``
+flag flipping false — gates nonzero.
 
 Usage (CPU, ~10 min):
   python tools/chaos_run.py --cpu-mesh 8
@@ -61,12 +72,19 @@ FAULT_STEP = 5  # mid-run, between the two eval/ckpt boundaries (4 and 8)
 SIGTERM_STEP = 4
 MAX_STEPS = 8
 EVAL_FREQ = 4
+NUM_WORKERS = 8
+# worker-targeted in-graph faults name their victim explicitly so the cell
+# can assert the forensics columns (obs/forensics.py) attribute the fault
+# to exactly this worker; faults that attribute are checked against the
+# run's own metrics.jsonl at the fault step (ISSUE 7)
+NAN_WORKER = 3
+ATTRIBUTED_FAULTS = ("nan_grad", "over_budget")
 
 
 def _base_cfg_kw():
     return dict(
         approach="cyclic", worker_fail=1, redundancy="shared",
-        batch_size=4, num_workers=8, max_steps=MAX_STEPS,
+        batch_size=4, num_workers=NUM_WORKERS, max_steps=MAX_STEPS,
         eval_freq=EVAL_FREQ, log_every=1, lr=0.05, compress_ckpt=True,
         step_guard="on", prefetch_timeout_s=2.0, prefetch_restarts=2,
     )
@@ -141,9 +159,58 @@ def _loops():
 def _status(train_dir):
     try:
         with open(os.path.join(train_dir, "status.json")) as fh:
-            return json.load(fh)
+            status = json.load(fh)
     except Exception:
         return {}
+    # versioned payloads (obs/heartbeat.STATUS_SCHEMA) must be a schema this
+    # harness understands; pre-versioning files carry no field (tolerated).
+    # A real exception (not assert: survives -O) — an unknown schema means
+    # the harness and the loops disagree on the payload shape, and folding
+    # it silently would misclassify every cell
+    from draco_tpu.obs.heartbeat import STATUS_SCHEMA
+
+    schema = status.get("schema")
+    if schema is not None and schema != STATUS_SCHEMA:
+        raise SystemExit(
+            f"{train_dir}/status.json schema {schema!r} != known "
+            f"{STATUS_SCHEMA} — update tools/chaos_run.py alongside "
+            f"obs/heartbeat.STATUS_SCHEMA")
+    return status
+
+
+def _accusation(train_dir, fault, step):
+    """(injected, accused, attributed) at the fault step, from the run's
+    own metrics.jsonl forensics columns (obs/forensics.py; log_every=1, so
+    every step's record is on disk). ``injected``: the worker(s) the fault
+    plan targeted — the named :w victim for nan_grad, the over-budget
+    step's live adversary row (packed in-graph as the seeded ground truth)
+    for over_budget. ``attributed``: every injected worker is in the
+    step's accused set."""
+    from draco_tpu.obs.forensics import record_masks
+
+    rec = None
+    try:
+        with open(os.path.join(train_dir, "metrics.jsonl")) as fh:
+            for line in fh:
+                try:
+                    r = json.loads(line)
+                except ValueError:
+                    continue
+                if r.get("step") == step and r.get("split") != "eval" \
+                        and "loss" in r:
+                    rec = r
+    except OSError:
+        pass
+    masks = record_masks(rec, NUM_WORKERS) if rec else None
+    if masks is None:
+        return None, None, False
+    accused = sorted(i for i, b in enumerate(masks["accused"]) if b)
+    if fault == "nan_grad":
+        injected = [NAN_WORKER]
+    else:  # over_budget: the mutated schedule row IS the injected set
+        injected = sorted(i for i, b in enumerate(masks["adv"]) if b)
+    attributed = bool(injected) and set(injected) <= set(accused)
+    return injected, accused, attributed
 
 
 def _attempt(run, cfg, steps=None):
@@ -221,6 +288,8 @@ def run_case(loop: str, fault: str, make_cfg, run, clean_vec, workdir):
     # loop simply rides out (4 s keeps the matrix quick)
     step = SIGTERM_STEP if fault == "sigterm" else FAULT_STEP
     spec = f"{fault}@{step}"
+    if fault == "nan_grad":
+        spec += f":w{NAN_WORKER}"  # named victim — the attribution target
     if fault == "prefetch_hang":
         spec += ":d20" if loop.startswith("lm") else ":d4"
     vec, err = _attempt(run, make_cfg(train_dir=d, fault_spec=spec))
@@ -228,6 +297,13 @@ def run_case(loop: str, fault: str, make_cfg, run, clean_vec, workdir):
     row["terminal_state"] = status.get("state")
     guard = status.get("guard") or {}
     row["guard_trips"] = guard.get("trips", 0.0)
+    if fault in ATTRIBUTED_FAULTS:
+        # per-worker forensics must point at the injected worker(s) —
+        # degrading boundedly is not enough, the ledger has to NAME them
+        injected, accused, attributed = _accusation(d, fault, step)
+        row["injected"] = injected
+        row["accused"] = accused
+        row["attributed"] = attributed
 
     if err is not None:
         name = type(err).__name__
@@ -267,6 +343,12 @@ def run_case(loop: str, fault: str, make_cfg, run, clean_vec, workdir):
     else:
         row["detail"] = ("completed but neither masked nor guarded "
                          "(silent divergence)")
+    if row["ok"] and fault in ATTRIBUTED_FAULTS and not row["attributed"]:
+        # survived the fault but could not NAME the culprit — that is a
+        # forensics regression, not an ok cell
+        row.update(ok=False, outcome="FAILED",
+                   detail=f"fault survived but unattributed: injected "
+                          f"{row['injected']} vs accused {row['accused']}")
     return row
 
 

@@ -212,7 +212,10 @@ def fold_program_lint(root: str, metrics: dict) -> None:
 
 def fold_chaos(root: str, metrics: dict) -> None:
     """Resilience chaos matrix: one ok-flag per (loop, fault) cell plus the
-    roll-up — masked→crashed is a 1→0 flip on a 0-tolerance "ok" metric."""
+    roll-up — masked→crashed is a 1→0 flip on a 0-tolerance "ok" metric.
+    Worker-targeted cells additionally carry a forensics ``attributed``
+    flag (the accused set named every injected worker, tools/chaos_run.py):
+    an attribution silently flipping false gates at tolerance 0 too."""
     path = os.path.join(root, "baselines_out", "chaos_matrix.json")
     data = _read_json(path)
     if not isinstance(data, dict):
@@ -228,6 +231,10 @@ def fold_chaos(root: str, metrics: dict) -> None:
         metrics[f"chaos.{loop}.{fault}.ok"] = {
             "value": float(bool(row.get("ok"))), "kind": "ok",
             "source": src}
+        if "attributed" in row:
+            metrics[f"chaos.{loop}.{fault}.attributed"] = {
+                "value": float(bool(row["attributed"])), "kind": "ok",
+                "source": src}
 
 
 def fold_all(root: str) -> dict:

@@ -89,12 +89,16 @@ def fold_counters(events: list) -> dict:
 def fold_metrics(path: str) -> dict:
     """Step count + summed per-step segment seconds from metrics.jsonl
     (t_fetch/t_comp are per-step amortized values, so their sums are the
-    regime's host-gather and device-execution wall respectively). Blank or
-    torn lines are skipped — a run killed mid-write must not take the
-    report down with it."""
+    regime's host-gather and device-execution wall respectively), plus the
+    cumulative guard totals and the run's final decode-health detection
+    precision/recall folded from the per-step columns (the PR 6 guard
+    columns and PR 4 health counts used to be invisible to this jax-free
+    path). Blank or torn lines are skipped — a run killed mid-write must
+    not take the report down with it."""
     steps = 0
     sums = collections.defaultdict(float)
     first = last = None
+    guard_seen = health_seen = False
     with open(path) as fh:
         for line in fh:
             line = line.strip()
@@ -115,19 +119,50 @@ def fold_metrics(path: str) -> dict:
             for key in ("t_fetch", "t_comp"):
                 if key in rec:
                     sums[key] += float(rec[key])
+            if "guard_trips" in rec:
+                guard_seen = True
+                sums["guard_trips"] += float(rec["guard_trips"])
+                sums["skipped_steps"] += float(rec.get("skipped_steps", 0.0))
+            if "det_tp" in rec:
+                health_seen = True
+                sums["det_tp"] += float(rec["det_tp"])
+                sums["det_adv"] += float(rec.get("det_adv", 0.0))
+                for k in ("located_errors", "det_flagged"):
+                    if k in rec:
+                        sums["det_flagged"] += float(rec[k])
+                        break
     out = {"train_records": steps}
-    out.update({f"{k}_total_s": round(v, 4) for k, v in sums.items()})
+    out.update({f"{k}_total_s": round(v, 4) for k, v in sums.items()
+                if k in ("t_fetch", "t_comp")})
+    if guard_seen:
+        out["guard_trips"] = sums["guard_trips"]
+        out["skipped_steps"] = sums["skipped_steps"]
+    if health_seen:
+        # same empty-denominator convention as obs/heartbeat.decode_health:
+        # nothing flagged / no live adversary is a healthy 1.0
+        tp, fl, adv = sums["det_tp"], sums["det_flagged"], sums["det_adv"]
+        out["det_precision"] = round(tp / fl, 4) if fl else 1.0
+        out["det_recall"] = round(tp / adv, 4) if adv else 1.0
     if first is not None:
         out["first_loss"] = first.get("loss")
         out["last_loss"] = last.get("loss")
     return out
 
 
+# status.json schema versions this report knows how to read — mirrors
+# obs/heartbeat.STATUS_SCHEMA (hardcoded: this tool is jax-free AND
+# draco_tpu-free, usable from a bare checkout of tools/). Pre-versioning
+# files carry no field and are accepted.
+KNOWN_STATUS_SCHEMAS = (2,)
+
+
 def fold_status(path: str) -> dict:
     """The run's heartbeat terminal state (obs/heartbeat.py): state
     done/preempted/crashed/running (+ cause / resumable_step) — how an
     operator tells a crash from a preemption from a finished run without a
-    traceback. {} when no status.json exists."""
+    traceback. {} when no status.json exists. A ``schema`` field, when
+    present, must be one this report understands — silently folding an
+    unknown payload shape would misreport the run."""
     try:
         with open(path) as fh:
             status = json.load(fh)
@@ -135,8 +170,15 @@ def fold_status(path: str) -> dict:
         return {}
     if not isinstance(status, dict):
         return {}
+    schema = status.get("schema")
+    if schema is not None and schema not in KNOWN_STATUS_SCHEMAS:
+        raise SystemExit(
+            f"{path}: status.json schema {schema!r} not in known "
+            f"{KNOWN_STATUS_SCHEMAS} — update tools/trace_report.py "
+            f"alongside obs/heartbeat.STATUS_SCHEMA")
     out = {}
-    for key in ("state", "cause", "resumable_step", "step", "updated_at"):
+    for key in ("schema", "state", "cause", "resumable_step", "step",
+                "updated_at"):
         if key in status:
             out[key] = status[key]
     return out
@@ -197,6 +239,15 @@ def print_table(report: dict, out=None) -> None:
         if status.get("resumable_step") is not None:
             line += f"   resumable from step {status['resumable_step']}"
         print(line, file=out)
+    # guard + decode-health header (folded from the per-step columns —
+    # previously invisible to this jax-free path)
+    m = report.get("metrics") or {}
+    if "guard_trips" in m:
+        print(f"guard: trips={m['guard_trips']:g} "
+              f"skipped_steps={m['skipped_steps']:g}", file=out)
+    if "det_precision" in m:
+        print(f"decode health: precision={m['det_precision']:.4f} "
+              f"recall={m['det_recall']:.4f}", file=out)
     hdr = f"{'phase':<22}{'count':>7}{'total ms':>12}{'mean ms':>10}" \
           f"{'max ms':>10}{'share':>8}"
     print(hdr, file=out)
