@@ -41,7 +41,7 @@ def add_fit_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     p.add_argument("--dataset", type=str, default="MNIST")
     p.add_argument("--data-dir", type=str, default="./data")
     p.add_argument("--approach", type=str, default="baseline",
-                   choices=["baseline", "maj_vote", "cyclic"])
+                   choices=["baseline", "maj_vote", "cyclic", "approx"])
     p.add_argument("--mode", type=str, default="normal",
                    choices=list(AGG_MODES),
                    help="aggregation for --approach baseline (first three "
@@ -57,6 +57,24 @@ def add_fit_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                         "fingerprints vs collision-free O(r^2*d) exact "
                         "bit-equality (for mutually-untrusting deployments)")
     p.add_argument("--worker-fail", type=int, default=0, help="s Byzantine workers")
+    # approximate code family (--approach approx; coding/approx.py, ISSUE 8)
+    p.add_argument("--code-redundancy", type=float, default=1.5,
+                   help="approx family: computational redundancy r in "
+                        "[1, n] — each worker computes ~r batches (exact "
+                        "codes pay r = 2s+1); decode error under drops is "
+                        "bounded by the optimal-decoding least squares and "
+                        "measured per step (decode_residual vs "
+                        "decode_residual_bound metric columns)")
+    p.add_argument("--straggler-alpha", type=float, default=0.25,
+                   help="approx family design point: the decode is "
+                        "dimensioned for up to ceil(alpha*n) absent workers "
+                        "per step (--straggle-count is validated against it)")
+    p.add_argument("--assignment-scheme", type=str, default="pairwise",
+                   choices=["pairwise", "clustered"],
+                   help="approx batch-to-worker assignment: pair-wise "
+                        "balanced cyclic windows (any r) or clustered "
+                        "fractional repetition (integer r dividing n; any "
+                        "one survivor per cluster keeps the decode exact)")
     p.add_argument("--err-mode", type=str, default="rev_grad",
                    choices=["rev_grad", "constant", "random", "alie", "ipm"],
                    help="reference modes + colluding attacks on approximate "
@@ -71,10 +89,12 @@ def add_fit_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                    help="drop: straggle-count workers miss each step's "
                         "deadline and are decoded around as erasures")
     p.add_argument("--straggle-count", type=int, default=0)
-    p.add_argument("--redundancy", type=str, default="simulate",
+    p.add_argument("--redundancy", type=str, default=None,
                    choices=["simulate", "shared"],
                    help="simulate: r-times redundant compute like the reference; "
-                        "shared: algebraically identical compute-once fast path")
+                        "shared: algebraically identical compute-once fast path "
+                        "(default: simulate, except approach=approx which only "
+                        "has the shared path)")
     p.add_argument("--decode-granularity", type=str, default="global",
                    choices=["global", "layer"],
                    help="cyclic decode: one locator on the flat gradient, or "
@@ -262,12 +282,19 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         group_size=args.group_size,
         vote_check=args.vote_check,
         worker_fail=args.worker_fail,
+        code_redundancy=args.code_redundancy,
+        straggler_alpha=args.straggler_alpha,
+        assignment_scheme=args.assignment_scheme,
         err_mode=args.err_mode,
         adversarial=args.adversarial,
         adversary_count=args.adversary_count,
         straggle_mode=args.straggle_mode,
         straggle_count=args.straggle_count,
-        redundancy=args.redundancy,
+        # approx only has the shared (compute-once) encode path; resolve the
+        # unset flag to it there so `--approach approx` works bare, while an
+        # explicit --redundancy simulate still errors loudly in validate()
+        redundancy=args.redundancy if args.redundancy is not None
+        else ("shared" if args.approach == "approx" else "simulate"),
         decode_granularity=args.decode_granularity,
         compute_dtype=args.compute_dtype,
         steps_per_call=args.steps_per_call,

@@ -1,2 +1,4 @@
 from draco_tpu.coding.cyclic import CyclicCode, build_cyclic_code, encode, decode  # noqa: F401
 from draco_tpu.coding.repetition import RepetitionCode, build_repetition_code, majority_vote  # noqa: F401
+from draco_tpu.coding.approx import ApproxCode, build_approx_code  # noqa: F401
+from draco_tpu.coding.assignment import build_assignment  # noqa: F401

@@ -50,6 +50,9 @@ class TrainConfig:
     #   baseline : plain data parallel + robust aggregation per `mode`
     #   maj_vote : repetition code, groups of size `group_size`, majority vote
     #   cyclic   : cyclic (DFT) code, tolerates s Byzantine workers
+    #   approx   : approximate gradient code (coding/approx.py) — straggler
+    #              tolerance at fractional redundancy `code_redundancy`
+    #              close to 1, bounded decode error instead of exactness
     approach: str = "baseline"
     # Aggregation mode for approach=baseline. Reference parity
     # (baseline_master.py:118-129): normal | geometric_median | krum.
@@ -65,6 +68,21 @@ class TrainConfig:
     # (coding/repetition.py module docstring, threat-model ladder).
     vote_check: str = "fingerprint"
     worker_fail: int = 0  # s, number of Byzantine workers (distributed_nn.py:68)
+
+    # --- approximate code family (approach="approx"; ISSUE 8) ---
+    # Computational redundancy r ∈ [1, n]: each worker computes ~r batches
+    # (exact codes pay r = 2s+1). Fractional r mixes ⌊r⌋/⌊r⌋+1 loads
+    # (coding/assignment.py); the decode error under drops is bounded by
+    # the optimal-decoding least squares (coding/approx.py docstring).
+    code_redundancy: float = 1.5
+    # Straggler design point: the decode is dimensioned for up to
+    # ⌈straggler_alpha · n⌉ absent workers per step — validate() holds
+    # straggle_count to it, and tools/straggler_study.py sweeps it.
+    straggler_alpha: float = 0.25
+    # Batch-to-worker assignment: "pairwise" (pair-wise balanced cyclic
+    # windows, any r) or "clustered" (fractional repetition, integer r
+    # dividing n — any one survivor per cluster keeps the decode exact).
+    assignment_scheme: str = "pairwise"
 
     # --- adversary simulation (reference: distributed_nn.py:64-67) ---
     err_mode: str = "rev_grad"  # rev_grad | constant | random | alie | ipm
@@ -274,7 +292,7 @@ class TrainConfig:
         return self.worker_fail if self.adversary_count is None else self.adversary_count
 
     def validate(self) -> "TrainConfig":
-        if self.approach not in ("baseline", "maj_vote", "cyclic"):
+        if self.approach not in ("baseline", "maj_vote", "cyclic", "approx"):
             raise ValueError(f"unknown approach: {self.approach}")
         if self.approach == "baseline" and self.mode not in AGG_MODES:
             raise ValueError(
@@ -337,6 +355,43 @@ class TrainConfig:
                 raise ValueError(
                     f"cyclic code needs n > 4s (got n={self.num_workers}, s={self.worker_fail})"
                 )
+        if self.approach == "approx":
+            if self.num_adversaries > 0:
+                # the optimal-decoding weights average whatever arrives —
+                # there is no error locator, so a single live Byzantine row
+                # poisons the decode undetectably. Stragglers are this
+                # family's fault model (coding/approx.py docstring).
+                raise ValueError(
+                    "approach=approx carries no Byzantine certificate: set "
+                    "worker_fail=0 (or adversary_count=0 to keep worker_fail "
+                    "as a nominal code parameter) — use cyclic/maj_vote for "
+                    "live adversaries"
+                )
+            if self.redundancy != "shared":
+                # fractional loads make the r×-redundant lanes ragged; the
+                # shared encode is algebraically identical and is the whole
+                # point of redundancy near 1
+                raise ValueError(
+                    "approach=approx requires redundancy='shared' (the "
+                    "assignment's fractional loads have no fixed-lane "
+                    "simulate shape)"
+                )
+            if not (1.0 <= self.code_redundancy <= self.num_workers):
+                raise ValueError(
+                    f"code_redundancy must lie in [1, num_workers], got "
+                    f"{self.code_redundancy} at n={self.num_workers}"
+                )
+            if not (0.0 <= self.straggler_alpha < 1.0):
+                raise ValueError(
+                    f"straggler_alpha must lie in [0, 1), got "
+                    f"{self.straggler_alpha}"
+                )
+            # construction-time errors (scheme name, clustered divisibility/
+            # integrality) surface at config time, not mid-run
+            from draco_tpu.coding.assignment import build_assignment
+
+            build_assignment(self.num_workers, self.code_redundancy,
+                             self.assignment_scheme)
         if self.worker_fail > self.num_workers:
             raise ValueError("worker_fail cannot exceed num_workers")
         if self.compute_dtype not in ("float32", "bfloat16"):
@@ -400,7 +455,19 @@ class TrainConfig:
             # parsed plan itself is rebuilt (cached) where it is consumed
             from draco_tpu.resilience.faults import FaultPlan
 
-            FaultPlan.parse(self.fault_spec, self.seed, self.num_workers)
+            plan = FaultPlan.parse(self.fault_spec, self.seed,
+                                   self.num_workers)
+            if self.approach == "approx" and plan.of_kind("over_budget"):
+                # over_budget marks schedule rows as live adversaries, but
+                # the approx family injects no attacks (no Byzantine
+                # certificate) — the event would be silently inert while
+                # still flipping the packed adversary-mask telemetry
+                raise ValueError(
+                    "fault kind over_budget is not expressible under "
+                    "approach=approx (the family injects no adversaries); "
+                    "use straggle/nan_grad/host kinds, or cyclic/maj_vote "
+                    "for Byzantine-budget faults"
+                )
         if self.straggle_mode not in ("none", "drop"):
             raise ValueError(f"unknown straggle_mode: {self.straggle_mode}")
         if self.decode_granularity not in ("global", "layer"):
@@ -428,6 +495,17 @@ class TrainConfig:
                         f"adversary_count + straggle_count <= worker_fail "
                         f"({t}+{e} <= {s}), or adversary_count == 0 with "
                         f"straggle_count <= 2*worker_fail ({e} <= {2 * s})"
+                    )
+            if self.approach == "approx":
+                import math
+
+                budget = math.ceil(self.straggler_alpha * n)
+                if e > budget:
+                    raise ValueError(
+                        f"approx straggler budget exceeded: straggle_count "
+                        f"{e} > ceil(straggler_alpha * n) = {budget} — raise "
+                        f"--straggler-alpha (and code_redundancy with it) or "
+                        f"drop fewer workers"
                     )
             if self.approach == "maj_vote":
                 if e >= self.group_size:

@@ -59,9 +59,12 @@ STATUS_SCHEMA = 2
 _TP_KEY = "det_tp"
 _ADV_KEY = "det_adv"
 _FLAGGED_KEYS = ("located_errors", "det_flagged")
-# last-value health fields copied verbatim from the newest record
+# last-value health fields copied verbatim from the newest record (the
+# approx family's residual-vs-bound certificate rides the last three:
+# parallel/common.APPROX_HEALTH_NAMES)
 _LAST_KEYS = ("decode_residual", "vote_agree", "flagged_groups",
-              "honest_located")
+              "honest_located", "decode_residual_bound",
+              "recovered_fraction")
 
 
 class RunHeartbeat:
@@ -119,6 +122,11 @@ class RunHeartbeat:
                 if k in record:
                     self._flagged += float(record[k])
                     break
+            self._last_health_rec = record
+        elif "decode_residual_bound" in record:
+            # approx family (ISSUE 8): no detection columns — the health
+            # block carries the last residual/bound/coverage instead, and
+            # the empty detection denominators read as the healthy 1.0
             self._last_health_rec = record
         if "guard_trips" in record:
             self._guard_trips += float(record["guard_trips"])

@@ -13,7 +13,50 @@ import jax
 import jax.numpy as jnp
 
 from draco_tpu import aggregation, attacks
+from draco_tpu.coding import approx as approx_mod
 from draco_tpu.coding import cyclic as cyclic_mod
+
+
+def build_code_from_cfg(cfg):
+    """The route-shared code constructor: CyclicCode for approach="cyclic",
+    ApproxCode for "approx", None otherwise — one place so the CNN path and
+    every LM route build the identical code from a config."""
+    if cfg.approach == "cyclic":
+        return cyclic_mod.build_cyclic_code(cfg.num_workers, cfg.worker_fail)
+    if cfg.approach == "approx":
+        return approx_mod.build_approx_code(
+            cfg.num_workers, cfg.code_redundancy, cfg.assignment_scheme)
+    return None
+
+
+def approx_aggregate(code, grads: jnp.ndarray, present=None, constrain=None):
+    """The approx family's whole aggregation sequence — ingest forensics →
+    weighted-partial-sum encode → present mask → optimal-decoding partial
+    recovery → residual-vs-bound health — in ONE place, shared by the CNN
+    step body (training/step.py) and the LM routes' flat-gradient tail
+    below, so the accusation/masking semantics cannot drift between loops.
+
+    No adversary injection: config.validate rejects live adversaries under
+    this family (no Byzantine certificate); stragglers are the fault model
+    and the only per-worker accusation signal is the non-finite ingest
+    check. ``constrain``: optional sharding-constraint hook applied to the
+    encoded (n, d) rows (the CNN path pins them to the worker axis)."""
+    from draco_tpu.obs import forensics as forensics_mod
+
+    bad_rows = forensics_mod.nonfinite_rows(grads)
+    with jax.named_scope("draco_encode"):
+        rows = approx_mod.encode_shared(code, grads)
+        if present is not None:
+            rows = jnp.where(jnp.asarray(present).astype(bool)[:, None],
+                             rows, jnp.zeros_like(rows))
+        if constrain is not None:
+            rows = constrain(rows)
+    with jax.named_scope("draco_decode"):
+        agg, _v, health = approx_mod.decode(
+            code, rows, present=present, with_health=True,
+            batch_grads=grads)
+    health["bad_rows"] = bad_rows
+    return agg, health
 
 
 def aggregate_flat_grads(grads: jnp.ndarray, adv_mask, cfg, code, rand_factor,
@@ -53,6 +96,10 @@ def aggregate_flat_grads(grads: jnp.ndarray, adv_mask, cfg, code, rand_factor,
     from draco_tpu.resilience import faults as faults_mod
 
     grads = faults_mod.corrupt_grads(grads, cfg, step)
+    if cfg.approach == "approx":
+        # approximate family (coding/approx.py; ISSUE 8): the shared
+        # sequence above — health is the residual-vs-bound certificate
+        return approx_aggregate(code, grads, present=present)
     if cfg.approach == "cyclic":
         # ingest-row health, BEFORE encode: a non-finite per-worker gradient
         # row attributes to its worker here, where row k still means worker
@@ -180,6 +227,18 @@ from draco_tpu.resilience.guards import GUARD_METRIC_NAMES  # noqa: E402
 DECODE_HEALTH_NAMES = ("decode_residual", "located_errors", "det_tp",
                        "det_adv")
 
+# per-step health columns of the approx family (coding/approx.py; ISSUE 8):
+#   decode_residual        measured relative decode error vs the TRUE batch-
+#                          gradient sum (available in-graph — the fleet is
+#                          simulated in one SPMD program), dimensionless
+#   decode_residual_bound  the arrived support's analytic optimal-decoding
+#                          bound ‖u − 1‖₂ (arXiv:2006.09638); residual ≤
+#                          bound is algebra, so any violation is a fault
+#   recovered_fraction     fraction of batches with ≥ 1 present worker —
+#                          1.0 is full coverage, the redundancy payoff
+APPROX_HEALTH_NAMES = ("decode_residual", "decode_residual_bound",
+                       "recovered_fraction")
+
 
 def token_metric_names(cfg) -> tuple:
     """Column order of the (K, m) metric block for an LM route at ``cfg``
@@ -194,6 +253,11 @@ def token_metric_names(cfg) -> tuple:
 
         names = names + DECODE_HEALTH_NAMES \
             + mask_metric_names(cfg.num_workers)
+    elif cfg.approach == "approx":
+        from draco_tpu.obs.forensics import mask_metric_names
+
+        names = names + APPROX_HEALTH_NAMES \
+            + mask_metric_names(cfg.num_workers)
     if cfg.step_guard == "on":
         names = names + GUARD_METRIC_NAMES
     return names
@@ -203,15 +267,22 @@ def accusation_mask(health, present=None):
     """The step's per-worker accusation set from a coded health dict: the
     code's own flag set ∪ the forensic-only signals — magnitude-outlier
     ``loud`` rows (cyclic LOUD_REL_TOL: the attribution that survives the
-    beyond-budget regime) and non-finite ingest ``bad_rows``. Present-gated
-    at pack time too (forensics.pack_mask_columns): an absent worker is
-    never an accused worker."""
+    beyond-budget regime) and non-finite ingest ``bad_rows``. The approx
+    family carries no ``flagged`` set at all (no Byzantine certificate —
+    its only signal is the non-finite ingest check), so the union starts
+    empty there; a *scheduled* straggler is in particular never accused.
+    Present-gated at pack time too (forensics.pack_mask_columns): an absent
+    worker is never an accused worker."""
     import jax.numpy as jnp
 
-    accused = jnp.asarray(health["flagged"], bool)
-    for key in ("loud", "bad_rows"):
+    accused = None
+    for key in ("flagged", "loud", "bad_rows"):
         if key in health:
-            accused = accused | jnp.asarray(health[key], bool)
+            m = jnp.asarray(health[key], bool)
+            accused = m if accused is None else accused | m
+    if accused is None:
+        raise ValueError("health dict carries no per-worker accusation "
+                         "signal (flagged/loud/bad_rows)")
     if present is not None:
         accused = accused & present
     return accused
@@ -233,6 +304,20 @@ def decode_health_metrics(health, adv_mask, present) -> dict:
 
     if health is None:
         return {}
+    if "bound" in health:
+        # approx family (APPROX_HEALTH_NAMES docstring): the certificate is
+        # residual ≤ bound, there is no located-error set — the packed
+        # accused mask is the non-finite ingest rows only, and the present/
+        # adv masks ride along so the AccusationLedger folds this family
+        # with the same absent≠accused semantics as the exact codes
+        out = {
+            "decode_residual": health["residual"],
+            "decode_residual_bound": health["bound"],
+            "recovered_fraction": health["recovered_fraction"],
+        }
+        out.update(forensics_mod.pack_mask_columns(
+            accusation_mask(health, present), present, adv_mask))
+        return out
     det = _detection_metrics(health["flagged"], adv_mask, present)
     out = {
         "decode_residual": health["residual"],

@@ -45,6 +45,7 @@ from draco_tpu.models.transformer import Block
 from draco_tpu.parallel.common import (
     TOKEN_METRIC_NAMES,
     aggregate_flat_grads,
+    build_code_from_cfg,
     finish_flat_step,
     decode_health_metrics,
     make_token_train_many,
@@ -134,8 +135,9 @@ def _flatten_rows(tree) -> jnp.ndarray:
 def build_pp_train_setup(cfg: TrainConfig, mesh) -> PPTrainSetup:
     """mesh must have axes (w, pp) — see make_mesh_wpp."""
     cfg.validate()
-    if cfg.approach not in ("baseline", "cyclic"):
-        raise ValueError(f"PP path supports baseline|cyclic, got {cfg.approach}")
+    if cfg.approach not in ("baseline", "cyclic", "approx"):
+        raise ValueError(
+            f"PP path supports baseline|cyclic|approx, got {cfg.approach}")
     n = cfg.num_workers
     S = mesh.shape[PP_AXIS]
     # logical workers fold onto the available w-axis devices in equal
@@ -316,16 +318,16 @@ def build_pp_train_setup(cfg: TrainConfig, mesh) -> PPTrainSetup:
             flat, NamedSharding(mesh, P(WORKER_AXIS))
         ), losses
 
-    code = (cyclic_mod.build_cyclic_code(n, cfg.worker_fail)
-            if cfg.approach == "cyclic" else None)
+    code = build_code_from_cfg(cfg)
 
     def step_body(state: TrainState, tokens, adv_mask, present=None):
         with jax.named_scope("draco_comp"):
             grads, losses = per_worker_grads(state.params, tokens)
         # in-graph decode projection — no d-length program constant
-        # (rng.random_projection_factors_in_graph docstring)
+        # (rng.random_projection_factors_in_graph docstring); the approx
+        # decode is projection-free
         rand_factor = (drng.random_projection_factors_in_graph(cfg.seed, dim)
-                       if code is not None else None)
+                       if cfg.approach == "cyclic" else None)
         agg, health = aggregate_flat_grads(grads, adv_mask, cfg, code,
                                            rand_factor, present=present,
                                            leaf_offsets=leaf_offsets,

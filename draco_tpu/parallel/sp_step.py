@@ -36,6 +36,7 @@ from draco_tpu.parallel.a2a_attention import a2a_attention
 from draco_tpu.parallel.common import (
     TOKEN_METRIC_NAMES,
     aggregate_flat_grads,
+    build_code_from_cfg,
     decode_health_metrics,
     finish_flat_step,
     make_token_train_many,
@@ -108,8 +109,9 @@ def token_fn_from_cfg(cfg: TrainConfig):
 def build_sp_train_setup(cfg: TrainConfig, mesh) -> SPTrainSetup:
     """mesh must have axes (w, sp) — see make_mesh_2d."""
     cfg.validate()
-    if cfg.approach not in ("baseline", "cyclic"):
-        raise ValueError(f"SP path supports baseline|cyclic, got {cfg.approach}")
+    if cfg.approach not in ("baseline", "cyclic", "approx"):
+        raise ValueError(
+            f"SP path supports baseline|cyclic|approx, got {cfg.approach}")
     n = cfg.num_workers
     sp = mesh.shape[SEQ_AXIS]
     # logical workers fold onto the available w-axis devices in equal
@@ -259,8 +261,7 @@ def build_sp_train_setup(cfg: TrainConfig, mesh) -> SPTrainSetup:
     )
 
     # ---- aggregation over w (identical machinery to the CNN path) ---------
-    code = (cyclic_mod.build_cyclic_code(n, cfg.worker_fail)
-            if cfg.approach == "cyclic" else None)
+    code = build_code_from_cfg(cfg)
     simulate = cfg.approach == "cyclic" and cfg.redundancy == "simulate"
     batch_ids = jnp.asarray(code.batch_ids) if simulate else None
     shard_w3 = NamedSharding(mesh, P(WORKER_AXIS, None, None))
@@ -278,9 +279,10 @@ def build_sp_train_setup(cfg: TrainConfig, mesh) -> SPTrainSetup:
                 grads, losses = grads_fn(state.params, tokens)
                 grads = lax.with_sharding_constraint(grads, shard_w)
         # in-graph decode projection — no d-length program constant
-        # (rng.random_projection_factors_in_graph docstring)
+        # (rng.random_projection_factors_in_graph docstring); the approx
+        # decode is projection-free (real least squares, no syndrome)
         rand_factor = (drng.random_projection_factors_in_graph(cfg.seed, dim)
-                       if code is not None else None)
+                       if cfg.approach == "cyclic" else None)
         agg, health = aggregate_flat_grads(grads, adv_mask, cfg, code,
                                            rand_factor, present=present,
                                            leaf_offsets=leaf_offsets,
@@ -361,6 +363,16 @@ def lint_programs():
         # change the ring's explicit-collective budget or donation
         LintProgram("lm_sp_ring_many_guard_k2", route="sp",
                     build=lambda: _build("lm_sp_ring_many_guard_k2", True,
+                                         step_guard="on")),
+        # the approx family on the ring (ISSUE 8): swapping the cyclic
+        # decode for the optimal-decoding least squares must leave the
+        # ring's explicit-collective budget untouched — the coding tail is
+        # pure GSPMD either way, so extra collectives here would mean the
+        # (n, n) solve started resharding
+        LintProgram("lm_sp_ring_approx_many_k2", route="sp",
+                    build=lambda: _build("lm_sp_ring_approx_many_k2", True,
+                                         approach="approx", worker_fail=0,
+                                         code_redundancy=1.5,
                                          step_guard="on")),
     ]
 

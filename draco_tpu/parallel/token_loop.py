@@ -159,11 +159,14 @@ def run_token_loop(setup, cfg: TrainConfig, steps: Optional[int] = None,
                                 cfg.num_workers, cfg.num_adversaries),
         fault_plan, cfg.worker_fail,
     )
-    straggle = (
+    # straggle events (sustained per-worker drops, faults.apply_straggle)
+    # overlay the seeded schedule — or materialize one from scratch
+    straggle = faults_mod.apply_straggle(
         drng.straggler_schedule(cfg.seed, start + total + 1, cfg.num_workers,
                                 cfg.straggle_count)
         if cfg.straggle_mode == "drop" and cfg.straggle_count > 0
-        else None
+        else None,
+        fault_plan, cfg.num_workers, start + total + 1,
     )
     is_main = jax.process_index() == 0
     writer = MetricWriter(cfg.train_dir or None, quiet=quiet)

@@ -36,6 +36,7 @@ from draco_tpu.models.transformer import TransformerLM
 from draco_tpu.parallel.common import (
     TOKEN_METRIC_NAMES,
     aggregate_flat_grads,
+    build_code_from_cfg,
     finish_flat_step,
     decode_health_metrics,
     make_token_train_many,
@@ -140,8 +141,9 @@ def _build_gspmd_train_setup(cfg: TrainConfig, mesh, *, mp_axis: str,
     differ only in the mesh axis, the parameter partition rules, and the
     model's expert count."""
     cfg.validate()
-    if cfg.approach not in ("baseline", "cyclic"):
-        raise ValueError(f"MP path supports baseline|cyclic, got {cfg.approach}")
+    if cfg.approach not in ("baseline", "cyclic", "approx"):
+        raise ValueError(
+            f"MP path supports baseline|cyclic|approx, got {cfg.approach}")
     n = cfg.num_workers
     # logical workers fold onto the available w-axis devices in equal blocks
     # (same discipline as runtime.make_mesh for the CNN path) — a single
@@ -235,8 +237,7 @@ def _build_gspmd_train_setup(cfg: TrainConfig, mesh, *, mp_axis: str,
         nll = -jnp.take_along_axis(logp, toks[:, 1:, None], axis=-1)[..., 0]
         return jnp.mean(nll)
 
-    code = (cyclic_mod.build_cyclic_code(n, cfg.worker_fail)
-            if cfg.approach == "cyclic" else None)
+    code = build_code_from_cfg(cfg)
     # reference-parity r× redundant compute: each worker really evaluates
     # its hat_s = 2s+1 assigned batch rows (cyclic_worker.py:122-146); the
     # "shared" fast path computes each row once and forms encoded rows
@@ -262,9 +263,10 @@ def _build_gspmd_train_setup(cfg: TrainConfig, mesh, *, mp_axis: str,
                 grads = jax.lax.with_sharding_constraint(grads, shard_w)
         # decode projection generated in-graph from the scalar seed — a
         # closed-over (d,) constant serializes into the program (638 MB at
-        # d~159M: the remote-compile ceiling, rng.py docstring)
+        # d~159M: the remote-compile ceiling, rng.py docstring); the approx
+        # decode is projection-free
         rand_factor = (drng.random_projection_factors_in_graph(cfg.seed, dim)
-                       if code is not None else None)
+                       if cfg.approach == "cyclic" else None)
         agg, health = aggregate_flat_grads(grads, adv_mask, cfg, code,
                                            rand_factor, present=present,
                                            leaf_offsets=leaf_offsets,

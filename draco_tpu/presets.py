@@ -50,6 +50,19 @@ PRESETS: dict[str, TrainConfig] = {
         mode="krum", num_workers=9, worker_fail=1, err_mode="rev_grad",
         batch_size=32, lr=0.01, momentum=0.9,
     ),
+    # 6. beyond-reference (ISSUE 8): the straggler-dominated scenario —
+    # ResNet-18/CIFAR-10 on the approximate code at r=1.5 (vs the exact
+    # codes' r=3 above), dimensioned for up to ⌈0.25·9⌉ = 3 drops per step
+    # with the residual-vs-bound certificate riding the metric block. No
+    # live adversary: this family trades the Byzantine certificate for
+    # redundancy near 1 (coding/approx.py).
+    "approx-resnet18": TrainConfig(
+        network="ResNet18", dataset="Cifar10", approach="approx",
+        num_workers=9, worker_fail=0, redundancy="shared",
+        code_redundancy=1.5, straggler_alpha=0.25,
+        straggle_mode="drop", straggle_count=2, batch_size=32,
+        lr=0.01, momentum=0.9,
+    ),
 }
 
 

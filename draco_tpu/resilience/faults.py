@@ -20,6 +20,12 @@ Event grammar (``FaultPlan.parse``)::
     inf_grad@5:w2       worker 2 emits an Inf gradient at step 5
     over_budget@7       step 7's adversary row is pushed to s+1 live
                         adversaries (beyond the code's locator budget)
+    straggle@5:w3       worker 3 drops (sustained) from step 5 to the end
+                        of the run — the heterogeneous-fleet / preempted-
+                        worker fault the approx code family (ISSUE 8)
+                        absorbs as scheduled erasures, NOT a one-shot
+                        crash: the worker's rows simply stop arriving
+    straggle@5:w3:d4    ... and recovers after 4 steps (absent 5..8)
     prefetch_crash@5    the prefetcher host fn raises InjectedFaultError
                         the first time step 5's data is requested
     prefetch_hang@5:d6  ... sleeps 6 s instead (a stalled worker thread)
@@ -45,12 +51,15 @@ from typing import Optional, Tuple
 
 import numpy as np
 
-# in-graph kinds corrupt the step's compiled inputs; host kinds fire in the
-# host loop / prefetcher; ckpt kinds are consumed by tools/chaos_run.py
+# in-graph kinds corrupt the step's compiled inputs; schedule kinds mutate
+# the seeded host schedules before upload (over_budget → adversary rows,
+# straggle → straggler/present rows); host kinds fire in the host loop /
+# prefetcher; ckpt kinds are consumed by tools/chaos_run.py
 INGRAPH_KINDS = ("nan_grad", "inf_grad")
+SCHEDULE_KINDS = ("over_budget", "straggle")
 HOST_KINDS = ("prefetch_crash", "prefetch_hang", "sigterm")
 CKPT_KINDS = ("ckpt_corrupt", "ckpt_truncate")
-FAULT_KINDS = INGRAPH_KINDS + ("over_budget",) + HOST_KINDS + CKPT_KINDS
+FAULT_KINDS = INGRAPH_KINDS + SCHEDULE_KINDS + HOST_KINDS + CKPT_KINDS
 
 _EVENT_RE = re.compile(r"^(?P<kind>[a-z_]+)@(?P<step>\d+)"
                        r"(?::w(?P<worker>\d+))?(?::d(?P<dur>[\d.]+))?$")
@@ -66,8 +75,11 @@ class InjectedFaultError(RuntimeError):
 class FaultEvent:
     kind: str
     step: int  # 1-based training step the event targets
-    worker: Optional[int] = None  # in-graph kinds: the corrupted row
-    duration_s: float = 30.0  # prefetch_hang: how long the worker sleeps
+    worker: Optional[int] = None  # in-graph/straggle kinds: the target row
+    # ``:d<n>`` payload. prefetch_hang: seconds the worker thread sleeps
+    # (None → 30 s). straggle: dwell in STEPS before the worker recovers
+    # (None → sustained to the end of the run — the spot-instance shape).
+    duration_s: Optional[float] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -106,15 +118,23 @@ class FaultPlan:
                         f"fault worker {worker} out of range "
                         f"(num_workers={num_workers}) in {tok!r}"
                     )
-            elif kind in INGRAPH_KINDS:
+            elif kind in INGRAPH_KINDS + ("straggle",):
                 # seeded per-event draw — the same "every participant can
                 # recompute it" property as rng.adversary_schedule
                 r = np.random.RandomState((seed ^ 0x4641554C) + 7919 * i)
                 worker = int(r.randint(num_workers))
             dur = m.group("dur")
+            if dur is not None and kind == "straggle" \
+                    and float(dur) != int(float(dur)):
+                # :d is float SECONDS for host kinds but integer STEPS for
+                # straggle — reject here rather than silently flooring
+                raise ValueError(
+                    f"straggle dwell is a whole number of steps, got "
+                    f"d{dur} in {tok!r}"
+                )
             events.append(FaultEvent(
                 kind=kind, step=step, worker=worker,
-                duration_s=float(dur) if dur is not None else 30.0,
+                duration_s=float(dur) if dur is not None else None,
             ))
         return cls(events=tuple(events), seed=seed, num_workers=num_workers)
 
@@ -200,6 +220,42 @@ def apply_over_budget(adv_schedule: np.ndarray, plan: Optional[FaultPlan],
     return adv
 
 
+def apply_straggle(straggle_schedule: Optional[np.ndarray],
+                   plan: Optional[FaultPlan], num_workers: int,
+                   n_steps: int) -> Optional[np.ndarray]:
+    """Host-side schedule mutation for ``straggle`` events: a SUSTAINED
+    per-worker drop — the targeted worker's rows stop arriving from the
+    event step until recovery (``:d<dwell>`` steps later; without it, the
+    end of the run — the spot/preemptible-instance shape). Unlike the
+    one-shot crash kinds this rides the existing seeded straggler/present
+    machinery: the drop is an *erasure at a known position* every step it
+    lasts, which is exactly the fault surface the approx code family
+    (coding/approx.py, ISSUE 8) decodes around with a bounded residual,
+    and a scheduled straggler is never an accused worker (obs/forensics).
+
+    ``straggle_schedule``: the seeded (rows, n) drop mask (True = absent)
+    or None when cfg configured no stragglers — the mutation materializes
+    a fresh all-False table then, sized ``n_steps + 1`` rows like
+    rng.straggler_schedule. Passthrough (input returned untouched) when
+    the plan has no straggle events."""
+    if plan is None:
+        return straggle_schedule
+    events = plan.of_kind("straggle")
+    if not events:
+        return straggle_schedule
+    if straggle_schedule is None:
+        out = np.zeros((n_steps + 1, num_workers), dtype=bool)
+    else:
+        out = np.array(straggle_schedule, copy=True)
+    for ev in events:
+        if ev.step >= out.shape[0]:
+            continue  # beyond the run's schedule table — inert
+        hi = (out.shape[0] if ev.duration_s is None
+              else min(out.shape[0], ev.step + int(ev.duration_s)))
+        out[ev.step:hi, ev.worker] = True
+    return out
+
+
 # ---- host-side one-shot triggering ----------------------------------------
 
 
@@ -267,7 +323,7 @@ class HostFaultInjector:
             )
         import time
 
-        time.sleep(ev.duration_s)
+        time.sleep(30.0 if ev.duration_s is None else ev.duration_s)
 
     def sigterm_due(self, end_step: int) -> bool:
         """True once, when a sigterm event's step has been reached — the

@@ -17,7 +17,15 @@ arXiv:1905.05383):
   residual_loud                  cyclic decode_residual > cfg.guard_residual_tol
                                  (clean decodes sit at f32 solve noise ~1e-6;
                                  a mislocated beyond-budget decode is O(1));
-                                 NaN residual counts as loud
+                                 NaN residual counts as loud. Under the
+                                 approx family the certificate is partial
+                                 recovery, not exactness: the trip condition
+                                 becomes residual > bound + tol — a step
+                                 whose measured decode error exceeds its own
+                                 analytic optimal-decoding bound
+                                 (coding/approx.py) is the fault, while any
+                                 within-bound residual is the family's
+                                 normal operating state
   over_budget                    located/flagged present rows > s — more
                                  corruption than the code can certify
                                  (cyclic locator roots; maj_vote out-voted
@@ -65,7 +73,14 @@ def assess(cfg, agg: jnp.ndarray, health: Optional[dict] = None,
     finite = jnp.all(jnp.isfinite(agg))
     trips.append(~finite)
     if health is not None:
-        if "residual" in health:
+        if "bound" in health:
+            # approx partial-recovery certificate (docstring table): the
+            # residual is allowed up to its analytic bound; exceeding it
+            # (or a NaN on either side) is the trip
+            loud = ~(health["residual"] <= health["bound"]
+                     + cfg.guard_residual_tol)
+            trips.append(loud)
+        elif "residual" in health:
             loud = ~(health["residual"] <= cfg.guard_residual_tol)
             trips.append(loud)
         if "flagged" in health:

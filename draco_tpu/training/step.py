@@ -228,6 +228,21 @@ def build_train_setup(cfg: TrainConfig, mesh, dataset_name: Optional[str] = None
 
     adv_mag = cfg.adversarial
 
+    def prep_rows(state, x, y):
+        """Augment + dropout keys per *global batch row* k — any worker
+        computing batch k sees identical data and rng. The per-batch-row
+        discipline both algebraic code families (cyclic, approx) share:
+        it is what makes the shared-redundancy encode exact."""
+        if use_aug:
+            keys = jax.vmap(
+                lambda k: drng.fold(jax.random.key(cfg.seed + 2), state.step, k)
+            )(jnp.arange(n))
+            x = jax.vmap(augment_mod.augment_batch)(x, keys)
+        dkeys = jax.vmap(
+            lambda k: drng.fold(jax.random.key(cfg.seed + 3), state.step, k)
+        )(jnp.arange(n))
+        return x, y, dkeys
+
     # ---- approach-specific step bodies -----------------------------------
     if cfg.approach == "baseline":
         code = None
@@ -319,24 +334,52 @@ def build_train_setup(cfg: TrainConfig, mesh, dataset_name: Optional[str] = None
                                      present, out)
             return new_state, out
 
+    elif cfg.approach == "approx":
+        # approximate gradient code (coding/approx.py; ISSUE 8): per-batch
+        # rows computed once (shared redundancy — validate() pins it),
+        # replication-weighted partial sums, optimal-decoding partial
+        # recovery. No adversary injection: validate() rejects live
+        # adversaries (no Byzantine certificate) — the straggler `present`
+        # mask is this family's whole fault surface.
+        from draco_tpu.parallel.common import (approx_aggregate,
+                                               build_code_from_cfg)
+
+        code = build_code_from_cfg(cfg)
+        rep_code = None
+
+        def step_body(state: TrainState, x, y, adv_mask, present=None):
+            x, y, dkeys = prep_rows(state, x, y)
+            grads, new_stats, losses, precs = jax.vmap(
+                lane, in_axes=(None, 0, 0, 0, 0)
+            )(state.params, state.batch_stats, x, y, dkeys)
+            grads = jax.lax.with_sharding_constraint(grads, shard_w)
+            grads = faults_mod.corrupt_grads(grads, cfg, state.step)
+            # the ONE shared encode→mask→decode→forensics sequence
+            # (parallel/common.approx_aggregate — identical semantics with
+            # the LM routes by construction)
+            decoded, health = approx_aggregate(
+                code, grads, present=present,
+                constrain=lambda r: jax.lax.with_sharding_constraint(
+                    r, shard_w))
+            new_state = apply_update(state, decoded, new_stats)
+            out = _metrics(losses, precs, present)
+            # residual-vs-bound health + packed forensics masks (accused =
+            # non-finite ingest rows only — a scheduled straggler is never
+            # accused); one schema with the LM routes
+            from draco_tpu.parallel.common import decode_health_metrics
+
+            out.update(decode_health_metrics(health, adv_mask, present))
+            # guard signals: finite decode + residual within its analytic
+            # bound (guards.assess's approx branch)
+            new_state = _maybe_guard(cfg, state, new_state, decoded, health,
+                                     present, out)
+            return new_state, out
+
     elif cfg.approach == "cyclic":
         code = cyclic_mod.build_cyclic_code(n, cfg.worker_fail)
         rep_code = None
         batch_ids = jnp.asarray(code.batch_ids)  # (n, hat_s)
         hat_s = code.hat_s
-
-        def prep_rows(state, x, y):
-            """Augment + dropout keys per *global batch row* k — any worker
-            computing batch k sees identical data and rng (decode exactness)."""
-            if use_aug:
-                keys = jax.vmap(
-                    lambda k: drng.fold(jax.random.key(cfg.seed + 2), state.step, k)
-                )(jnp.arange(n))
-                x = jax.vmap(augment_mod.augment_batch)(x, keys)
-            dkeys = jax.vmap(
-                lambda k: drng.fold(jax.random.key(cfg.seed + 3), state.step, k)
-            )(jnp.arange(n))
-            return x, y, dkeys
 
         if cfg.redundancy == "shared":
 
@@ -486,7 +529,8 @@ def build_train_setup(cfg: TrainConfig, mesh, dataset_name: Optional[str] = None
     # per-step values are in-graph scalars, so the chunked regime ships
     # them for free in the one existing per-flush fetch. The cyclic column
     # set is the LM routes' (one schema source: common.DECODE_HEALTH_NAMES)
-    from draco_tpu.parallel.common import DECODE_HEALTH_NAMES
+    from draco_tpu.parallel.common import (APPROX_HEALTH_NAMES,
+                                           DECODE_HEALTH_NAMES)
 
     metric_names = ("loss", "prec1")
     # coded approaches append the packed per-worker forensics masks
@@ -494,6 +538,9 @@ def build_train_setup(cfg: TrainConfig, mesh, dataset_name: Optional[str] = None
     # no exactness certificate, no accusation set
     if cfg.approach == "cyclic":
         metric_names += (("honest_located",) + DECODE_HEALTH_NAMES
+                         + forensics_mod.mask_metric_names(n))
+    elif cfg.approach == "approx":
+        metric_names += (APPROX_HEALTH_NAMES
                          + forensics_mod.mask_metric_names(n))
     elif cfg.approach == "maj_vote":
         metric_names += (("vote_agree", "flagged_groups", "det_flagged",
@@ -528,7 +575,7 @@ def build_train_setup(cfg: TrainConfig, mesh, dataset_name: Optional[str] = None
         state=state,
         train_step=train_step,
         eval_step=eval_step,
-        code=code if cfg.approach == "cyclic" else rep_code,
+        code=code if cfg.approach in ("cyclic", "approx") else rep_code,
         unravel=unravel,
         dim=dim,
         train_many=train_many,
@@ -599,5 +646,17 @@ def lint_programs():
         # full state donation, no host traffic (the guard is selects +
         # reductions, never a callback)
         mk("cnn_cyclic_many_guard_k2", cfg=_cfg(step_guard="on"),
+           many=True),
+        # the approximate family (coding/approx.py; ISSUE 8): same manifest
+        # discipline — the optimal-decoding least squares and the
+        # residual-vs-bound health columns must compile to pure GSPMD
+        # (zero explicit collectives), keep full state donation and emit
+        # zero host traffic, like every other chip-bound program
+        mk("cnn_approx_step",
+           cfg=_cfg(approach="approx", worker_fail=0, redundancy="shared",
+                    code_redundancy=1.5)),
+        mk("cnn_approx_many_guard_k2",
+           cfg=_cfg(approach="approx", worker_fail=0, redundancy="shared",
+                    code_redundancy=1.5, step_guard="on"),
            many=True),
     ]

@@ -95,11 +95,15 @@ class Trainer:
                                     cfg.num_adversaries),
             self._fault_plan, cfg.worker_fail,
         )
-        self._straggle_schedule = (
+        # the fault plan's straggle events (sustained per-worker drops)
+        # overlay the seeded straggler schedule — or materialize one when
+        # the config ran with none (faults.apply_straggle)
+        self._straggle_schedule = faults_mod.apply_straggle(
             drng.straggler_schedule(cfg.seed, cfg.max_steps, cfg.num_workers,
                                     cfg.straggle_count)
             if cfg.straggle_mode == "drop" and cfg.straggle_count > 0
-            else None
+            else None,
+            self._fault_plan, cfg.num_workers, cfg.max_steps,
         )
         self._sched_steps = cfg.max_steps  # rows precomputed in the schedules
         self._group_seeds = drng.group_seeds(cfg.seed, max(cfg.num_groups, 1))
@@ -173,8 +177,12 @@ class Trainer:
             self._fault_plan, cfg.worker_fail,
         )
         if self._straggle_schedule is not None:
-            self._straggle_schedule = drng.straggler_schedule(
-                cfg.seed, n_steps, cfg.num_workers, cfg.straggle_count
+            self._straggle_schedule = faults_mod.apply_straggle(
+                drng.straggler_schedule(
+                    cfg.seed, n_steps, cfg.num_workers, cfg.straggle_count)
+                if cfg.straggle_mode == "drop" and cfg.straggle_count > 0
+                else None,
+                self._fault_plan, cfg.num_workers, n_steps,
             )
         self._sched_steps = n_steps
 

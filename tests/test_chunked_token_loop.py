@@ -85,6 +85,17 @@ ROUTES = {
                 straggle_mode="drop", straggle_count=1),
         train=lambda cfg: train_tp(cfg, make_folded_wtp_mesh(9), quiet=True),
     ),
+    # the approximate family on the single-shard fold (ISSUE 8): no live
+    # adversary (validate rejects one), two seeded drops per step inside
+    # the ⌈αn⌉ = 2 budget — the per-record residual-vs-bound certificate
+    # and absent≠accused are asserted in _assert_route_telemetry
+    "approx": dict(
+        kw=dict(num_workers=8, approach="approx", worker_fail=0,
+                redundancy="shared", code_redundancy=1.5,
+                straggler_alpha=0.25, straggle_mode="drop",
+                straggle_count=2),
+        train=lambda cfg: train_sp(cfg, make_mesh_2d(8, 1), quiet=True),
+    ),
 }
 
 
@@ -160,6 +171,34 @@ def _assert_route_telemetry(route, kw, run_dir):
         fxb = status["forensics"]
         assert fxb["num_workers"] == n and fxb["accused_total"] > 0
         assert fxb["top_suspects"]
+        assert status["schema"] == 2
+    elif kw.get("approach") == "approx":
+        from draco_tpu.obs import forensics as fx
+
+        n = kw["num_workers"]
+        strag = drng.straggler_schedule(428, 8, n, kw["straggle_count"])
+        for r in train:
+            # the residual-vs-bound certificate per record (ISSUE 8) + no
+            # located-error machinery on this family
+            assert r["decode_residual"] <= \
+                r["decode_residual_bound"] + 1e-5, r
+            assert 0.0 < r["recovered_fraction"] <= 1.0
+            assert "det_tp" not in r and "located_errors" not in r
+            masks = fx.record_masks(r, n)
+            assert masks is not None, r
+            assert masks["present"] == tuple(~strag[r["step"]])
+            assert masks["adv"] == (False,) * n
+            # a scheduled straggler is never an accused worker
+            assert masks["accused"] == (False,) * n, (r["step"], masks)
+        status = json.load(open(os.path.join(run_dir, "status.json")))
+        health = status["decode_health"]
+        assert health["decode_residual"] <= \
+            health["decode_residual_bound"] + 1e-5
+        # the ledger holds: absence decays nothing — no accusations, no
+        # episodes, full trust on every worker
+        fxb = status["forensics"]
+        assert fxb["accused_total"] == 0 and fxb["episodes_total"] == 0
+        assert fxb["trust"] == [1.0] * n
         assert status["schema"] == 2
     else:
         assert all("det_tp" not in r for r in train)

@@ -100,6 +100,13 @@ APPROACHES = {
     "baseline": dict(approach="baseline", mode="geometric_median",
                      worker_fail=1, err_mode="rev_grad",
                      straggle_mode="drop", straggle_count=1),
+    # the approximate family (ISSUE 8): no live adversary (config.validate
+    # rejects one — no Byzantine certificate), two seeded drops per step
+    # inside the ⌈αn⌉ = 2 design budget — the residual-vs-bound certificate
+    # is asserted per record in _assert_decode_health
+    "approx": dict(approach="approx", worker_fail=0, redundancy="shared",
+                   code_redundancy=1.5, straggler_alpha=0.25,
+                   straggle_mode="drop", straggle_count=2),
 }
 
 
@@ -135,6 +142,34 @@ def test_chunked_equals_eager_bitwise(ds, approach, tmp_path):
     _assert_telemetry_artifacts(tmp_path / f"{approach}_k4", approach)
 
 
+def test_approx_full_participation_matches_uncoded_mean(mesh):
+    """With every worker present the approx decode IS the uncoded mean
+    (v = 1 feasible ⇒ u = 1 ⇒ exact, coding/approx.py): one jitted
+    train_step of approach='approx' from the shared seeded init lands
+    allclose (f32 solve noise) to one step of the plain baseline mean on
+    the SAME batch — the acceptance pin of ISSUE 8."""
+    from draco_tpu.training.step import build_train_setup
+
+    kw = dict(APPROACHES["approx"], straggle_mode="none", straggle_count=0)
+    x = np.asarray(np.random.RandomState(5).rand(8, 4, 28, 28, 1),
+                   np.float32)
+    y = np.asarray(np.random.RandomState(6).randint(0, 10, (8, 4)),
+                   np.int32)
+    mask = np.zeros(8, dtype=bool)
+    vecs = {}
+    for name, akw in (("approx", kw),
+                      ("baseline", dict(approach="baseline", mode="normal"))):
+        setup = build_train_setup(make_cfg(**akw), mesh,
+                                  dataset_name="synthetic-mnist")
+        state, _ = setup.train_step(setup.state, jnp.asarray(x),
+                                    jnp.asarray(y), jnp.asarray(mask))
+        vecs[name] = np.concatenate([
+            np.ravel(v) for v in jax.tree.leaves(jax.device_get(state.params))
+        ])
+    np.testing.assert_allclose(vecs["approx"], vecs["baseline"],
+                               rtol=1e-5, atol=1e-6)
+
+
 def _assert_decode_health(approach, stream, kw):
     """Decode-health columns (in-graph, ISSUE 4) on every train record:
     detection precision AND recall are 1.0 against the seeded adversary +
@@ -160,6 +195,28 @@ def _assert_decode_health(approach, stream, kw):
         if approach == "baseline":
             assert "det_tp" not in vals and "decode_residual" not in vals
             assert "wmask_accused0" not in vals
+            continue
+        if approach == "approx":
+            # the residual-vs-bound certificate per record (ISSUE 8): the
+            # measured decode error never exceeds the arrived support's
+            # analytic optimal-decoding bound, and a full-participation
+            # step decodes exactly (both sit at f32 noise)
+            assert vals["decode_residual"] <= \
+                vals["decode_residual_bound"] + 1e-5, (step, vals)
+            if not strag[step].any():
+                assert vals["decode_residual"] < 1e-4
+                assert vals["decode_residual_bound"] < 1e-4
+            assert 0.0 < vals["recovered_fraction"] <= 1.0
+            # no located-error machinery at all on this family
+            assert "det_tp" not in vals and "located_errors" not in vals
+            masks = fx.record_masks(vals, n)
+            assert masks is not None, (step, vals)
+            assert masks["present"] == tuple(~strag[step]), step
+            assert masks["adv"] == (False,) * n  # no live adversary
+            # a scheduled straggler is NEVER an accused worker — the
+            # family's whole accusation surface is the non-finite ingest
+            # check, silent on clean runs
+            assert masks["accused"] == (False,) * n, (step, masks)
             continue
         want = int((adv[step] & ~strag[step]).sum())  # detectable truth
         assert vals["det_adv"] == want, (step, vals)
@@ -220,6 +277,20 @@ def _assert_telemetry_artifacts(run_dir, approach):
     if approach == "baseline":
         assert "decode_health" not in status
         assert "forensics" not in status
+    elif approach == "approx":
+        # residual-vs-bound certificate in the heartbeat (ISSUE 8) — and
+        # the forensics interplay pin: scheduled stragglers are erasures,
+        # so NO accusations, NO episodes, and the trust vector never
+        # decays (absence is not evidence; obs/forensics docstring)
+        health = status["decode_health"]
+        assert health["decode_residual"] <= \
+            health["decode_residual_bound"] + 1e-5
+        assert 0.0 < health["recovered_fraction"] <= 1.0
+        fxb = status["forensics"]
+        assert fxb["accused_total"] == 0 and fxb["episodes_total"] == 0
+        assert fxb["top_suspects"] == []
+        assert fxb["trust"] == [1.0] * 8
+        assert status["schema"] == 2
     else:
         health = status["decode_health"]
         assert health["precision"] == 1.0 and health["recall"] == 1.0

@@ -558,6 +558,95 @@ def test_perf_watch_gates_on_flipped_chaos_attribution(tmp_path):
     assert "chaos.cnn_k4.nan_grad.attributed" in regs
 
 
+def test_straggler_study_tool(tmp_path):
+    """tools/straggler_study.py smoke (ISSUE 8): approx cells at e ∈ {0, 2}
+    train on the chunked production loop, carry the residual-vs-bound
+    certificate, and the compute-to-target column scales by the family's
+    redundancy."""
+    import json
+
+    from tools import straggler_study
+
+    out = tmp_path / "study.json"
+    rc = straggler_study.main([
+        "--out", str(out), "--cpu-mesh", "8", "--families", "approx",
+        "--drops", "0,2", "--max-steps", "14", "--target-loss", "1.9",
+    ])
+    rep = json.loads(out.read_text())
+    assert rc == 0 and rep["all_ok"]
+    assert len(rep["rows"]) == 2
+    for row in rep["rows"]:
+        assert row["family"] == "approx" and row["feasible"]
+        assert row["reached_target"] and row["residual_within_bound"]
+        assert row["guard_trips_total"] == 0.0
+        # compute axis = steps x round(r*n) = steps x 12 at r=1.5, n=8
+        assert row["compute_to_target"] == row["steps_to_target"] * 12
+        assert 0.0 < row["recovered_fraction_min"] <= 1.0
+        assert row["ms_per_step"] > 0
+    # full participation decodes exactly; two drops pay a real residual
+    e0, e2 = rep["rows"]
+    assert e0["residual_max"] < 1e-4 <= e2["residual_max"]
+    # a partial sweep (--families approx) must NOT claim the unswept
+    # exact family was infeasible
+    assert rep["crossover"]["0"] == "approx (only family swept)"
+
+
+def test_perf_watch_gates_on_flipped_straggler_bound(tmp_path):
+    """A straggler-study cell whose measured residual exceeds its analytic
+    bound (residual_within_bound flipping false) must gate perf_watch
+    nonzero at tolerance 0 and name the cell — same for a lost batch
+    coverage and an exact-code cell silently claiming feasibility it does
+    not have."""
+    import json
+
+    from tools import perf_watch
+
+    root = tmp_path
+    (root / "baselines_out").mkdir()
+    study = {"all_ok": True, "rows": [
+        {"family": "approx", "drop_count": 2, "feasible": True,
+         "reached_target": True, "residual_within_bound": True,
+         "recovered_fraction_min": 1.0, "ms_per_step": 50.0, "ok": True},
+        {"family": "cyclic", "drop_count": 3, "feasible": False},
+    ]}
+    (root / "baselines_out" / "straggler_study.json").write_text(
+        json.dumps(study))
+    assert perf_watch.main(["--root", str(root), "--snapshot"]) == 0
+    snap = json.loads(
+        (root / "baselines_out" / "perf_watch.json").read_text())
+    assert "straggler.approx.e2.residual_within_bound" in snap["metrics"]
+    # infeasible cells fold ONLY their feasibility flag
+    assert "straggler.cyclic.e3.feasible" in snap["metrics"]
+    assert "straggler.cyclic.e3.reached_target" not in snap["metrics"]
+    assert perf_watch.main(["--root", str(root)]) == 0  # clean
+
+    study["rows"][0]["residual_within_bound"] = False
+    study["rows"][0]["recovered_fraction_min"] = 0.875
+    study["all_ok"] = False
+    (root / "baselines_out" / "straggler_study.json").write_text(
+        json.dumps(study))
+    out = root / "report.json"
+    assert perf_watch.main(["--root", str(root), "--json", str(out)]) == 1
+    regs = {r["metric"] for r in json.loads(out.read_text())["regressions"]}
+    assert {"straggler.approx.e2.residual_within_bound",
+            "straggler.approx.e2.recovered_fraction_min",
+            "straggler.all_ok"} <= regs
+
+    # the feasibility flag is kind "pinned": the budget-infeasible cyclic
+    # cell silently claiming feasibility (0 -> 1, the "good" direction for
+    # an ok-kind bool) must ALSO gate — feasibility changes are semantic,
+    # never improvements
+    study["rows"][0]["residual_within_bound"] = True
+    study["rows"][0]["recovered_fraction_min"] = 1.0
+    study["all_ok"] = True
+    study["rows"][1]["feasible"] = True
+    (root / "baselines_out" / "straggler_study.json").write_text(
+        json.dumps(study))
+    assert perf_watch.main(["--root", str(root), "--json", str(out)]) == 1
+    regs = {r["metric"] for r in json.loads(out.read_text())["regressions"]}
+    assert "straggler.cyclic.e3.feasible" in regs
+
+
 def test_perf_watch_passes_on_committed_artifacts():
     """The committed baselines_out/perf_watch.json snapshot must match the
     committed round artifacts — the same gate a future round runs."""

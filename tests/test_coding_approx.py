@@ -1,0 +1,260 @@
+"""Approximate gradient code (coding/approx.py + coding/assignment.py):
+assignment algebra, full-participation exactness, and the partial-recovery
+residual-vs-bound certificate.
+
+The family's contract (ISSUE 8, arXiv:2006.09638): at redundancy r ∈ [1, n]
+the decode recovers the EXACT batch-gradient mean whenever every worker
+arrives (v = 1 is feasible because the encode weights have unit column
+sums), and under drops the optimal-decoding least squares bounds the error
+by ‖u − 1‖₂ · ‖G‖_F / n — an in-graph scalar the health dict ships next to
+the *measured* residual, so residual ≤ bound is checkable per decode.
+"""
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from draco_tpu.coding import approx, assignment
+from draco_tpu.config import TrainConfig
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(17)
+
+
+# --------------------------------------------------------------------------
+# assignment algebra
+# --------------------------------------------------------------------------
+
+@pytest.mark.parametrize("n,r", [(8, 1.0), (8, 1.5), (8, 2.0), (9, 2.5),
+                                 (6, 1.25), (9, 1.5)])
+def test_pairwise_assignment_properties(n, r):
+    a = assignment.pairwise_assignment(n, r)
+    assert a.shape == (n, n) and set(np.unique(a)) <= {0.0, 1.0}
+    loads = a.sum(axis=1)
+    # per-worker loads are ⌊r⌋ or ⌊r⌋+1 and total compute rounds half-UP
+    # to ⌊r·n + ½⌋ — never below the advertised redundancy (the (9, 1.5)
+    # preset case: 14 batch-gradients, not banker's-rounded 13)
+    assert set(loads) <= {math.floor(r), math.floor(r) + 1}
+    assert loads.sum() == math.floor(r * n + 0.5)
+    # every batch covered (encode_weights would raise otherwise) and
+    # replication counts are balanced within one unit
+    counts = a.sum(axis=0)
+    assert counts.min() >= 1
+    assert counts.max() - counts.min() <= 1
+    # cyclic windows: worker i's support is consecutive mod n from i
+    for i in range(n):
+        ks = np.where(a[i])[0]
+        want = (i + np.arange(len(ks))) % n
+        assert sorted(ks) == sorted(want)
+
+
+@pytest.mark.parametrize("n,c", [(8, 2), (9, 3), (8, 4), (6, 1)])
+def test_clustered_assignment_properties(n, c):
+    a = assignment.clustered_assignment(n, float(c))
+    # workers partition into n/c clusters; cluster j computes batch group j
+    for i in range(n):
+        ks = np.where(a[i])[0]
+        j = i // c
+        assert sorted(ks) == list(range(j * c, (j + 1) * c))
+    # every batch replicated exactly c times
+    np.testing.assert_array_equal(a.sum(axis=0), np.full(n, c))
+
+
+def test_assignment_rejects_bad_parameters():
+    with pytest.raises(ValueError, match="redundancy"):
+        assignment.build_assignment(8, 0.5, "pairwise")
+    with pytest.raises(ValueError, match="redundancy"):
+        assignment.build_assignment(8, 9.0, "pairwise")
+    with pytest.raises(ValueError, match="integer"):
+        assignment.build_assignment(8, 1.5, "clustered")
+    with pytest.raises(ValueError, match="divide"):
+        assignment.build_assignment(8, 3.0, "clustered")
+    with pytest.raises(ValueError, match="unknown assignment scheme"):
+        assignment.build_assignment(8, 2.0, "banana")
+    with pytest.raises(ValueError, match="uncovered"):
+        assignment.encode_weights(np.zeros((4, 4)))
+
+
+def test_encode_weights_unit_column_sums(rng):
+    for n, r, scheme in [(8, 1.5, "pairwise"), (8, 2.0, "clustered"),
+                         (9, 2.5, "pairwise")]:
+        a = assignment.build_assignment(n, r, scheme)
+        w = assignment.encode_weights(a)
+        # unit column sums: v = 1 decodes the exact sum at full
+        # participation, for ANY r including the mixed ⌊r⌋/⌊r⌋+1 case
+        np.testing.assert_allclose(w.sum(axis=0), np.ones(n), atol=1e-12)
+        # support preserved: weights live exactly where the assignment does
+        np.testing.assert_array_equal(w > 0, a > 0)
+
+
+def test_build_approx_code_lane_constants():
+    code = approx.build_approx_code(8, 1.5, "pairwise")
+    assert code.max_load == 2
+    # lane weights replay the dense weight matrix at batch_ids; padded
+    # lanes carry weight 0 (inert recompute, never out-of-range)
+    dense = np.zeros((8, 8), np.float32)
+    for i in range(8):
+        for j in range(code.max_load):
+            dense[i, code.batch_ids[i, j]] += code.lane_weights[i, j]
+    np.testing.assert_allclose(dense, code.weights, atol=1e-7)
+    # ragged encode == shared encode on per-lane gathered gradients
+    rng = np.random.RandomState(3)
+    G = rng.randn(8, 33).astype(np.float32)
+    shared = np.asarray(approx.encode_shared(code, jnp.asarray(G)))
+    ragged = np.asarray(approx.encode(code, jnp.asarray(G[code.batch_ids])))
+    np.testing.assert_allclose(ragged, shared, rtol=1e-6, atol=1e-6)
+
+
+# --------------------------------------------------------------------------
+# decode: exactness + the residual-vs-bound certificate
+# --------------------------------------------------------------------------
+
+@pytest.mark.parametrize("n,r,scheme", [
+    (8, 1.0, "pairwise"), (8, 1.5, "pairwise"), (8, 2.0, "pairwise"),
+    (9, 2.5, "pairwise"), (8, 2.0, "clustered"), (9, 3.0, "clustered"),
+])
+def test_full_participation_exact(n, r, scheme, rng):
+    code = approx.build_approx_code(n, r, scheme)
+    G = rng.randn(n, 128).astype(np.float32)
+    rows = approx.encode_shared(code, jnp.asarray(G))
+    dec, v, health = approx.decode(code, rows, with_health=True,
+                                   batch_grads=jnp.asarray(G))
+    want = G.sum(axis=0) / n
+    np.testing.assert_allclose(np.asarray(dec), want, rtol=1e-5, atol=1e-5)
+    # the certificate agrees: bound ≈ 0 (u = 1 feasible), residual at f32
+    # solve noise, full coverage
+    assert float(health["bound"]) < 1e-4
+    assert float(health["residual"]) < 1e-4
+    assert float(health["recovered_fraction"]) == 1.0
+
+
+@pytest.mark.parametrize("n,r,scheme,missing", [
+    (8, 1.5, "pairwise", (0,)), (8, 1.5, "pairwise", (1, 5)),
+    (8, 2.0, "pairwise", (0, 3)), (9, 2.5, "pairwise", (2, 4, 7)),
+    (8, 2.0, "clustered", (0, 2, 5)), (8, 1.0, "pairwise", (6,)),
+])
+def test_partial_recovery_residual_le_bound(n, r, scheme, missing, rng):
+    code = approx.build_approx_code(n, r, scheme)
+    G = rng.randn(n, 96).astype(np.float32)
+    present = np.ones(n, bool)
+    present[list(missing)] = False
+    rows = np.asarray(approx.encode_shared(code, jnp.asarray(G)))
+    rows = rows * present[:, None]  # absent rows arrive as zeros
+    dec, v, health = approx.decode(code, jnp.asarray(rows),
+                                   present=jnp.asarray(present),
+                                   with_health=True,
+                                   batch_grads=jnp.asarray(G))
+    # absent workers never carry decode weight
+    assert not np.asarray(v)[list(missing)].any()
+    # the measured residual is the TRUE relative error...
+    want = G.sum(axis=0) / n
+    scale = np.sqrt((G ** 2).sum()) / n
+    true_rel = np.sqrt(((np.asarray(dec) - want) ** 2).sum()) / scale
+    assert float(health["residual"]) == pytest.approx(true_rel, rel=1e-4,
+                                                      abs=1e-6)
+    # ...and it sits under the analytic optimal-decoding bound (algebra —
+    # Cauchy-Schwarz over the arrived support; f32 noise margin only)
+    assert float(health["residual"]) <= float(health["bound"]) + 1e-5
+
+
+def test_clustered_single_survivor_exact(rng):
+    """FRC's selling point (arXiv:1903.01974): any one survivor per cluster
+    keeps the decode exact — here all but one member of every cluster
+    drops."""
+    n, c = 8, 4
+    code = approx.build_approx_code(n, float(c), "clustered")
+    G = rng.randn(n, 64).astype(np.float32)
+    present = np.zeros(n, bool)
+    present[[1, 6]] = True  # one survivor in each of the two clusters
+    rows = np.asarray(approx.encode_shared(code, jnp.asarray(G)))
+    rows = rows * present[:, None]
+    dec, _v, health = approx.decode(code, jnp.asarray(rows),
+                                    present=jnp.asarray(present),
+                                    with_health=True,
+                                    batch_grads=jnp.asarray(G))
+    want = G.sum(axis=0) / n
+    np.testing.assert_allclose(np.asarray(dec), want, rtol=1e-4, atol=1e-4)
+    assert float(health["bound"]) < 1e-4
+    assert float(health["recovered_fraction"]) == 1.0
+
+
+def test_dead_cluster_loses_its_group_boundedly(rng):
+    """A fully-absent cluster loses its whole batch group: coverage drops,
+    the bound goes loud, and the residual still sits under it (the
+    rank-deficient solve stays finite via the SVD rcond truncation)."""
+    n, c = 8, 2
+    code = approx.build_approx_code(n, float(c), "clustered")
+    G = rng.randn(n, 64).astype(np.float32)
+    present = np.ones(n, bool)
+    present[[2, 3]] = False  # cluster 1 entirely gone
+    rows = np.asarray(approx.encode_shared(code, jnp.asarray(G)))
+    rows = rows * present[:, None]
+    dec, _v, health = approx.decode(code, jnp.asarray(rows),
+                                    present=jnp.asarray(present),
+                                    with_health=True,
+                                    batch_grads=jnp.asarray(G))
+    assert np.all(np.isfinite(np.asarray(dec)))
+    assert float(health["recovered_fraction"]) == pytest.approx(6 / 8)
+    # the two lost batches show up as √2 in the bound (u = 0 there)
+    assert float(health["bound"]) == pytest.approx(np.sqrt(2.0), rel=1e-4)
+    assert float(health["residual"]) <= float(health["bound"]) + 1e-5
+
+
+def test_recovered_fraction_counts_covered_batches():
+    code = approx.build_approx_code(8, 1.0, "pairwise")  # identity assignment
+    pres = np.ones(8, bool)
+    pres[[0, 4]] = False
+    assert float(approx.recovered_fraction(
+        code, jnp.asarray(pres))) == pytest.approx(6 / 8)
+    assert float(approx.recovered_fraction(code)) == 1.0
+
+
+def test_decode_with_health_requires_batch_grads():
+    code = approx.build_approx_code(8, 1.5, "pairwise")
+    rows = jnp.zeros((8, 4))
+    with pytest.raises(ValueError, match="batch_grads"):
+        approx.decode(code, rows, with_health=True)
+
+
+# --------------------------------------------------------------------------
+# config surface
+# --------------------------------------------------------------------------
+
+def _cfg(**kw):
+    base = dict(network="FC", dataset="synthetic-mnist", approach="approx",
+                num_workers=8, worker_fail=0, redundancy="shared",
+                batch_size=4, max_steps=4, eval_freq=0, train_dir="")
+    base.update(kw)
+    return TrainConfig(**base)
+
+
+def test_config_accepts_and_rejects_approx_parameters():
+    _cfg(code_redundancy=1.5, straggler_alpha=0.25).validate()
+    _cfg(code_redundancy=2.0, assignment_scheme="clustered").validate()
+    # worker_fail as a nominal parameter is fine with adversary_count=0
+    _cfg(worker_fail=1, adversary_count=0).validate()
+    with pytest.raises(ValueError, match="Byzantine certificate"):
+        _cfg(worker_fail=1).validate()
+    with pytest.raises(ValueError, match="shared"):
+        _cfg(redundancy="simulate").validate()
+    with pytest.raises(ValueError, match="code_redundancy"):
+        _cfg(code_redundancy=0.5).validate()
+    with pytest.raises(ValueError, match="straggler_alpha"):
+        _cfg(straggler_alpha=1.5).validate()
+    # construction-time errors surface at config time, not mid-run
+    with pytest.raises(ValueError, match="integer"):
+        _cfg(code_redundancy=1.5, assignment_scheme="clustered").validate()
+    with pytest.raises(ValueError, match="unknown assignment scheme"):
+        _cfg(assignment_scheme="banana").validate()
+
+
+def test_config_enforces_straggler_alpha_budget():
+    _cfg(straggler_alpha=0.25, straggle_mode="drop",
+         straggle_count=2).validate()  # ceil(0.25 * 8) = 2
+    with pytest.raises(ValueError, match="straggler budget"):
+        _cfg(straggler_alpha=0.25, straggle_mode="drop",
+             straggle_count=3).validate()

@@ -38,6 +38,19 @@ Folded sources (all optional — a missing artifact folds nothing):
                                 a fault class silently flipping from
                                 masked/guarded to FAILED gates nonzero
                                 (kind "ok", tolerance 0)
+  baselines_out/straggler_study.json
+                                the exact-vs-approx crossover sweep
+                                (tools/straggler_study.py, ISSUE 8):
+                                per-cell reached_target /
+                                residual_within_bound / full-recovery
+                                bools at tolerance 0 (a residual
+                                exceeding its analytic bound is never
+                                noise), feasibility flags pinned in BOTH
+                                directions (kind "pinned" — a budget-
+                                infeasible cell silently becoming
+                                feasible is a semantic change, not an
+                                improvement), wall ms/step at the time
+                                tolerance
 
 Tolerances are per metric KIND (relative change vs baseline): time metrics
 default 10% (ms/step, a 20% regression trips loudly), bytes 10%, flops 2%
@@ -71,6 +84,9 @@ KINDS = {
     "count": {"dir": "lower_better", "tol": 0.0},  # e.g. steady-state builds
     "ratio": {"dir": "higher_better", "tol": 0.10},
     "ok": {"dir": "higher_better", "tol": 0.0},
+    # semantic flags with no good direction: ANY flip is a regression
+    # (e.g. a budget-infeasible straggler cell silently becoming feasible)
+    "pinned": {"dir": "equal", "tol": 0.0},
 }
 
 
@@ -237,6 +253,50 @@ def fold_chaos(root: str, metrics: dict) -> None:
                 "source": src}
 
 
+def fold_straggler(root: str, metrics: dict) -> None:
+    """Straggler-study crossover artifact (tools/straggler_study.py): the
+    certificate bools gate at tolerance 0 — a cell whose measured residual
+    creeps past its analytic bound, stops reaching the target loss, or
+    loses full batch recovery is a correctness regression, never noise.
+    The wall column rides at the ordinary time tolerance. Infeasible cells
+    (exact-code budget exceeded) fold only their feasibility flag — a
+    budget-exceeded scenario silently becoming "feasible" (or vice versa)
+    is a semantic change worth tripping on too."""
+    path = os.path.join(root, "baselines_out", "straggler_study.json")
+    data = _read_json(path)
+    if not isinstance(data, dict):
+        return
+    src = "baselines_out/straggler_study.json"
+    if "all_ok" in data:
+        metrics["straggler.all_ok"] = {
+            "value": float(bool(data["all_ok"])), "kind": "ok",
+            "source": src}
+    for row in data.get("rows", []):
+        family, drops = row.get("family"), row.get("drop_count")
+        if family is None or drops is None:
+            continue
+        key = f"straggler.{family}.e{drops}"
+        metrics[f"{key}.feasible"] = {
+            "value": float(bool(row.get("feasible"))), "kind": "pinned",
+            "source": src}
+        if not row.get("feasible"):
+            continue
+        for flag in ("reached_target", "residual_within_bound"):
+            metrics[f"{key}.{flag}"] = {
+                "value": float(bool(row.get(flag))), "kind": "ok",
+                "source": src}
+        if isinstance(row.get("recovered_fraction_min"), (int, float)):
+            # ok-kind at its raw value: any coverage LOSS gates at 0
+            # tolerance, recoveries never do (higher_better)
+            metrics[f"{key}.recovered_fraction_min"] = {
+                "value": float(row["recovered_fraction_min"]),
+                "kind": "ok", "source": src}
+        if isinstance(row.get("ms_per_step"), (int, float)):
+            metrics[f"{key}.ms_per_step"] = {
+                "value": float(row["ms_per_step"]), "kind": "time_ms",
+                "source": src}
+
+
 def fold_all(root: str) -> dict:
     metrics: dict = {}
     fold_bench(root, metrics)
@@ -244,6 +304,7 @@ def fold_all(root: str) -> dict:
     fold_host_loop(root, metrics)
     fold_program_lint(root, metrics)
     fold_chaos(root, metrics)
+    fold_straggler(root, metrics)
     return metrics
 
 
@@ -267,8 +328,11 @@ def compare(baseline: dict, current: dict, tols: dict) -> dict:
             rel = 0.0 if c == 0.0 else float("inf") * (1 if c > 0 else -1)
         else:
             rel = (c - b) / abs(b)
-        bad = rel > tol if spec["dir"] == "lower_better" else rel < -tol
-        good = rel < -tol if spec["dir"] == "lower_better" else rel > tol
+        if spec["dir"] == "equal":
+            bad, good = abs(rel) > tol, False
+        else:
+            bad = rel > tol if spec["dir"] == "lower_better" else rel < -tol
+            good = rel < -tol if spec["dir"] == "lower_better" else rel > tol
         row = {"metric": name, "kind": kind, "baseline": b, "current": c,
                "rel_change": (round(rel, 4) if rel == rel
                               and abs(rel) != float("inf") else None),

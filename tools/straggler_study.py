@@ -1,0 +1,242 @@
+#!/usr/bin/env python
+"""Straggler study: the time-to-accuracy crossover between the exact
+cyclic code (r = 2s+1) and the approximate family (r ≈ 1.5) under 0-37.5%
+per-step worker drop rates — ISSUE 8's committed evidence that the
+speed/exactness dial (ROADMAP item 3) actually pays.
+
+Each cell trains the same FC/synthetic-mnist workload on the production
+chunked Trainer loop (steps_per_call=4, guards on) under e seeded drops
+per step and records, from the run's own metrics.jsonl:
+
+  steps_to_target     first step whose 5-step smoothed train loss reaches
+                      --target-loss (deterministic on a fixed backend: the
+                      schedules, data and decode are all seeded)
+  compute_to_target   steps_to_target x round(r*n) worker batch-gradients —
+                      the metric a REAL fleet pays. The simulated mesh
+                      computes shared-redundancy rows once either way
+                      (config.redundancy), so wall ms/step here does not
+                      show the r x compute gap; the per-worker load does:
+                      cyclic r = 2s+1 = 3 vs approx r = 1.5. This is the
+                      crossover axis.
+  residual_within_bound   every record's measured decode_residual sat
+                      under its analytic decode_residual_bound (approx
+                      rows; trivially true for the exact decode at f32
+                      noise) — the paper's guarantee refereed per step
+  recovered_fraction_min  worst-step batch coverage (approx rows)
+  ms_per_step         measured host wall per step (t_fetch + t_comp means)
+
+The exact code's cells go infeasible past its erasure budget (e > 2s,
+config.validate) — recorded as feasible=false rather than skipped,
+because "this scenario is CLOSED to exact codes" is the point of the
+study. ``tools/perf_watch.py`` folds the committed artifact: the bool
+columns (reached_target, residual_within_bound, full recovery) gate at
+tolerance 0, wall metrics at the time tolerance.
+
+Usage (CPU, ~1.5 min):
+  python tools/straggler_study.py --cpu-mesh 8
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from draco_tpu.cli import maybe_force_cpu_mesh  # noqa: E402
+
+NUM_WORKERS = 8
+# exact family: cyclic s=1 (r = 3), pure-straggler regime (no live
+# adversary) — erasure budget e <= 2s = 2; approx family at the ISSUE 8
+# design point r=1.5, dimensioned for ceil(0.4*8) = 4 drops
+FAMILIES = {
+    "cyclic": dict(approach="cyclic", worker_fail=1, adversary_count=0,
+                   redundancy="shared"),
+    "approx": dict(approach="approx", worker_fail=0, redundancy="shared",
+                   code_redundancy=1.5, straggler_alpha=0.4),
+}
+REDUNDANCY = {"cyclic": 3.0, "approx": 1.5}
+DROP_COUNTS = (0, 1, 2, 3)  # of n=8: 0% / 12.5% / 25% / 37.5% per step
+
+
+def _feasible(family: str, drops: int) -> bool:
+    # the cyclic erasure-only budget is e <= 2s (config.validate) —
+    # derived from the family's own s so the two stay in lockstep; the
+    # approx design point covers every swept drop count
+    return (family != "cyclic"
+            or drops <= 2 * FAMILIES["cyclic"]["worker_fail"])
+
+
+def run_cell(family: str, drops: int, args, mesh, ds) -> dict:
+    import numpy as np
+
+    from draco_tpu.config import TrainConfig
+    from draco_tpu.training.trainer import Trainer
+
+    row = {"family": family, "drop_count": drops,
+           "drop_rate": drops / NUM_WORKERS,
+           "code_redundancy": REDUNDANCY[family],
+           "feasible": _feasible(family, drops)}
+    if not row["feasible"]:
+        row["detail"] = (f"cyclic erasure budget exceeded: e={drops} > "
+                         f"2s=2 — the scenario the approx family opens")
+        return row
+    d = tempfile.mkdtemp(prefix=f"straggler_{family}_{drops}_")
+    cfg = TrainConfig(
+        network="FC", dataset="synthetic-mnist", batch_size=4, lr=0.05,
+        momentum=0.9, num_workers=NUM_WORKERS, max_steps=args.max_steps,
+        eval_freq=0, train_dir=d, log_every=1,
+        steps_per_call=args.steps_per_call, step_guard="on",
+        straggle_mode="drop" if drops else "none", straggle_count=drops,
+        **FAMILIES[family],
+    )
+    tr = Trainer(cfg, mesh=mesh, dataset=ds, quiet=True)
+    try:
+        t0 = time.perf_counter()
+        tr.run()
+        wall_s = time.perf_counter() - t0
+        ev = tr.evaluate(args.max_steps)
+    finally:
+        tr.close()
+    recs = []
+    with open(os.path.join(d, "metrics.jsonl")) as fh:
+        for line in fh:
+            r = json.loads(line)
+            if "loss" in r and r.get("split") != "eval":
+                recs.append(r)
+    shutil.rmtree(d, ignore_errors=True)
+
+    losses = [r["loss"] for r in recs]
+    smooth = [float(np.mean(losses[max(0, i - 4):i + 1]))
+              for i in range(len(losses))]
+    steps_to = next((i + 1 for i, v in enumerate(smooth)
+                     if v <= args.target_loss), None)
+    within = all(
+        r["decode_residual"] <= r["decode_residual_bound"] + 1e-5
+        for r in recs if "decode_residual_bound" in r
+    ) if family == "approx" else all(
+        r["decode_residual"] <= 1e-3 for r in recs  # exact decode: f32 noise
+    )
+    row.update({
+        "steps": len(recs),
+        "steps_to_target": steps_to,
+        "reached_target": steps_to is not None,
+        # the fleet-compute axis: worker batch-gradients spent to target
+        "compute_to_target": (steps_to * round(REDUNDANCY[family]
+                                               * NUM_WORKERS)
+                              if steps_to is not None else None),
+        "final_loss_smoothed": round(smooth[-1], 6),
+        "prec1_test": ev["prec1_test"],
+        "residual_within_bound": bool(within),
+        "guard_trips_total": sum(r.get("guard_trips", 0.0) for r in recs),
+        "wall_s": round(wall_s, 3),
+        "ms_per_step": round(1000.0 * np.mean(
+            [r.get("t_fetch", 0.0) + r.get("t_comp", 0.0) for r in recs]), 3),
+    })
+    if family == "approx":
+        row["recovered_fraction_min"] = min(
+            r["recovered_fraction"] for r in recs)
+        row["residual_max"] = round(max(r["decode_residual"]
+                                        for r in recs), 6)
+        row["bound_max"] = round(max(r["decode_residual_bound"]
+                                     for r in recs), 6)
+    row["ok"] = bool(row["reached_target"] and row["residual_within_bound"]
+                     and row["guard_trips_total"] == 0.0)
+    return row
+
+
+def crossover(rows) -> dict:
+    """Per drop count: which family reached the target loss on less fleet
+    compute (worker batch-gradients) — 'approx' winning under drops while
+    'cyclic' goes infeasible past its budget is the study's headline."""
+    out = {}
+    for drops in sorted({r["drop_count"] for r in rows}):
+        cell = {r["family"]: r for r in rows if r["drop_count"] == drops}
+        live = {f: r["compute_to_target"] for f, r in cell.items()
+                if r.get("compute_to_target") is not None}
+        if not live:
+            out[str(drops)] = None
+        elif len(live) == 1:
+            # name WHY the other families are out: budget-infeasible is
+            # the study's headline, merely-not-converged is not, and a
+            # partial sweep (--families) proves nothing about the rest
+            winner = next(iter(live))
+            others = [r for f, r in cell.items() if f != winner]
+            if not others:
+                out[str(drops)] = f"{winner} (only family swept)"
+            elif all(not r.get("feasible", True) for r in others):
+                out[str(drops)] = f"{winner} (only feasible)"
+            else:
+                out[str(drops)] = f"{winner} (only to reach target)"
+        else:
+            out[str(drops)] = min(live, key=live.get)
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", type=str,
+                    default=os.path.join("baselines_out",
+                                         "straggler_study.json"))
+    ap.add_argument("--max-steps", type=int, default=60)
+    ap.add_argument("--steps-per-call", type=int, default=4)
+    ap.add_argument("--target-loss", type=float, default=1.6,
+                    help="5-step smoothed train-loss target (calibrated "
+                         "for the 60-step FC/synthetic-mnist cell)")
+    ap.add_argument("--families", type=str, default="",
+                    help="comma-separated subset (default: all)")
+    ap.add_argument("--drops", type=str, default="",
+                    help="comma-separated drop counts (default: 0,1,2,3)")
+    ap.add_argument("--cpu-mesh", type=int, default=0, metavar="N",
+                    help="force an N-device virtual CPU mesh")
+    args = ap.parse_args(argv)
+    if args.cpu_mesh:
+        maybe_force_cpu_mesh(args)
+
+    from draco_tpu.data.datasets import load_dataset
+    from draco_tpu.runtime import make_mesh
+
+    families = [f for f in args.families.split(",") if f] or list(FAMILIES)
+    drops = ([int(x) for x in args.drops.split(",") if x != ""]
+             or list(DROP_COUNTS))
+    ds = load_dataset("synthetic-mnist", synthetic_train=512,
+                      synthetic_test=128)
+    mesh = make_mesh(NUM_WORKERS)
+    rows = []
+    for e in drops:
+        for family in families:
+            row = run_cell(family, e, args, mesh, ds)
+            rows.append(row)
+            tag = ("infeasible" if not row["feasible"] else
+                   f"steps_to_target={row['steps_to_target']} "
+                   f"compute={row['compute_to_target']} "
+                   f"ok={row['ok']}")
+            print(f"straggler_study: {family:6s} e={e} -> {tag}", flush=True)
+
+    payload = {
+        "schema": 1,
+        "tool": "tools/straggler_study.py",
+        "num_workers": NUM_WORKERS,
+        "max_steps": args.max_steps,
+        "steps_per_call": args.steps_per_call,
+        "target_loss": args.target_loss,
+        "rows": rows,
+        "crossover": crossover(rows),
+        "all_ok": all(r["ok"] for r in rows if r["feasible"])
+        and bool(rows),
+    }
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+    with open(args.out, "w") as fh:
+        json.dump(payload, fh, indent=1, sort_keys=True)
+    print(f"straggler_study: {len(rows)} cells -> {args.out} "
+          f"(crossover: {payload['crossover']})")
+    return 0 if payload["all_ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
