@@ -24,6 +24,18 @@ device fetches in steady state** and zero overhead when disabled.
                 jax.monitoring, and a steady-state guard (warn by default,
                 raise in tests) trips on any recompilation of a labelled
                 registered program after its warmup build.
+  profiling.py  profiler_window — the ONE jax.profiler start/stop window
+                both production loops run (drain-before-stop + the
+                wall-clock anchor the merged host+device timeline needs);
+                previously four copy-pasted blocks (ISSUE 9).
+  device_attr.py  The device-side half of the spine (ISSUE 9, jax-free):
+                parses a jax.profiler capture into the per-phase chip
+                ledger (draco_comp/encode/decode/update + explicit
+                residual, rows summing to the profiled window), the
+                collective comms ledger cross-checked against the PR 3
+                Manifest counts (mismatch = hard error), and the merged
+                host+device Perfetto timeline. Driven by
+                tools/device_profile.py; folded by tools/trace_report.py.
   forensics.py  Per-worker Byzantine forensics (ISSUE 7): the coded steps'
                 (n,) accusation/present/seeded-adversary masks packed into
                 f32-carried uint32 bitmask columns riding the (K, m) metric
@@ -48,8 +60,10 @@ from draco_tpu.obs.compile_watch import (
 )
 from draco_tpu.obs.forensics import AccusationLedger
 from draco_tpu.obs.heartbeat import STATUS_SCHEMA, RunHeartbeat
+from draco_tpu.obs.profiling import NULL_PROFILER_WINDOW, profiler_window
 from draco_tpu.obs.tracer import NULL_TRACER, SpanTracer, make_tracer
 
-__all__ = ["NULL_TRACER", "STATUS_SCHEMA", "AccusationLedger",
-           "CompileWatch", "RetraceError", "RetraceWarning", "RunHeartbeat",
-           "SpanTracer", "make_compile_watch", "make_tracer"]
+__all__ = ["NULL_PROFILER_WINDOW", "NULL_TRACER", "STATUS_SCHEMA",
+           "AccusationLedger", "CompileWatch", "RetraceError",
+           "RetraceWarning", "RunHeartbeat", "SpanTracer",
+           "make_compile_watch", "make_tracer", "profiler_window"]

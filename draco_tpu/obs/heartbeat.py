@@ -50,7 +50,9 @@ from draco_tpu.obs.forensics import AccusationLedger
 # runs) and assert it when present. Bump when a field changes meaning or
 # moves — additive fields do not need a bump.
 #   2: first versioned schema (adds ``schema`` itself, the ``forensics``
-#      block, and ``num_workers``)
+#      block, and ``num_workers``). The ``device`` block (ISSUE 9 — last
+#      profiled window's phase fractions / decode share) is ADDITIVE under
+#      schema 2: consumers tolerate it missing, assert it when present.
 STATUS_SCHEMA = 2
 
 # per-step detection-count columns (in-graph, coding/cyclic.py +
@@ -88,6 +90,10 @@ class RunHeartbeat:
         self._skipped_steps = 0.0
         self._guard_seen = False  # any record carried guard columns
         self._last: dict = {}
+        # last profiled window's device block (obs/device_attr.py, ISSUE 9)
+        # — set by observe_device, wired as the profiler window's on_stop
+        # hook; rides every subsequent beat
+        self._device: Optional[dict] = None
         # newest record that actually carried detection columns — kept
         # separately from _last so a mixed-route train_dir (a trailing
         # record WITHOUT the optional health family, e.g. a baseline run
@@ -135,6 +141,27 @@ class RunHeartbeat:
         if self.ledger is not None:
             self.ledger.observe(record)
         self._last = record
+
+    def observe_device(self, profile_dir: str) -> None:
+        """Fold the just-stopped profiler capture into the ``device`` status
+        block (phase fractions, decode share, attribution coverage — ISSUE
+        9). Wired as ``obs.profiling.profiler_window``'s ``on_stop`` hook by
+        both production loops, so the block lands on the first beat after
+        the capture window closes. Best-effort: a torn capture (or a run
+        with no capture at all) folds nothing, and observation must never
+        take the run down."""
+        if self.path is None:
+            return
+        try:
+            from draco_tpu.obs import device_attr
+
+            fold = device_attr.fold_capture(profile_dir)
+            block = device_attr.device_status_block(fold) if fold else None
+        except Exception:
+            return
+        if block is not None:
+            block["profile_dir"] = profile_dir
+            self._device = block
 
     def decode_health(self) -> Optional[dict]:
         """Cumulative detection precision/recall (1.0 denominators-empty:
@@ -191,6 +218,10 @@ class RunHeartbeat:
             # per-worker forensics (obs/forensics.AccusationLedger):
             # top suspects, trust vector, episode counts
             payload["forensics"] = self.ledger.summary()
+        if self._device is not None:
+            # last profiled window's device-time attribution (ISSUE 9);
+            # consumers tolerate the key missing, assert it when present
+            payload["device"] = self._device
         if extra:
             payload.update(extra)
         self._write(payload)
@@ -215,6 +246,10 @@ class RunHeartbeat:
         payload["schema"] = STATUS_SCHEMA  # present even with no prior beat
         payload["state"] = state
         payload["updated_at"] = time.time()
+        if self._device is not None:
+            # a capture window that stops on the run's LAST work unit has
+            # no later beat — the terminal write is the block's only ride
+            payload["device"] = self._device
         if cause is not None:
             payload["cause"] = str(cause)[:500]
         if resumable_step is not None:

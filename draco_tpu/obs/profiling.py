@@ -1,0 +1,223 @@
+"""The one profiler-window implementation both production loops share.
+
+PR 4 wired ``--profile-dir`` into four loop bodies (Trainer eager/chunked,
+token_loop eager/chunked) as four copy-pasted ``jax.profiler.start_trace`` /
+``stop_trace`` blocks — and the drain-before-stop fix (stop during async
+dispatch truncates the still-executing profiled steps) was re-implemented
+per site, incompletely (the CNN eager loop never drained). ISSUE 9
+deduplicates them into :func:`profiler_window`, which also stamps the
+**wall-clock anchor** (``profile_dir/host_anchor.json``) that the merged
+host+device timeline needs: the host tracer's relative timestamp at the
+moment ``start_trace`` returned, pairing with the capture's own start-time
+origin (obs/device_attr.device_time_origin) to put both event streams on
+one clock.
+
+Window semantics (unchanged from the per-site logic):
+
+* ``maybe_start(step_end)`` before a work unit whose last step is
+  ``step_end`` — starts the capture at the first unit reaching
+  ``profile_steps[0]`` (chunk-snapped under K>1), at most once per run.
+* ``maybe_stop(step_end, drain)`` after the unit — stops once
+  ``step_end >= profile_steps[1] - 1``, draining ``drain`` (the state
+  carry) through ``jax.block_until_ready`` first so the capture contains
+  the full device execution, not the dispatch tail.
+* ``stop(drain)`` in the loop's exit path — the safety stop when the run
+  ends inside the window.
+
+The disabled path is a shared no-op singleton (``NULL_PROFILER_WINDOW``):
+loops hold a window unconditionally and never branch on enablement, the
+same contract as the tracer (obs/tracer.py). jax is imported lazily inside
+start/stop so the obs package stays importable without jax (the jax-free
+tools import sibling modules).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional
+
+from draco_tpu.obs.tracer import NULL_TRACER
+
+ANCHOR_FILE = "host_anchor.json"
+
+
+def _quiet_start_trace(log_dir: str) -> None:
+    """``jax.profiler.start_trace`` with the python tracer DISABLED.
+
+    The default capture interleaves a python-callstack event per host frame
+    — ~1M events for a CI-sized 8-step window, flooding the bounded trace
+    buffer and truncating the device stream this module exists to capture
+    (the host half is already covered by the span tracer, obs/tracer.py).
+    jax 0.4.x exposes no public knob, so this builds the ProfilerSession
+    with ``ProfileOptions.python_tracer_level = 0`` through the same
+    internal state ``start_trace`` uses; if the internals move with a
+    toolchain bump, it degrades to the public (noisy) ``start_trace``
+    rather than losing the capture."""
+    import jax
+
+    already_active = False
+    try:
+        from jax._src import profiler as _prof
+        from jax._src import xla_bridge
+        from jax._src.lib import xla_client
+
+        opts = xla_client.profiler.ProfileOptions()
+        opts.python_tracer_level = 0
+        with _prof._profile_state.lock:
+            if _prof._profile_state.profile_session is not None:
+                already_active = True
+            else:
+                xla_bridge.get_backend()  # the session needs a live backend
+                _prof._profile_state.profile_session = \
+                    xla_client.profiler.ProfilerSession(opts)
+                _prof._profile_state.create_perfetto_link = False
+                _prof._profile_state.create_perfetto_trace = False
+                _prof._profile_state.log_dir = str(log_dir)
+    except Exception:
+        # internals moved (or a backend/XLA error — note XlaRuntimeError
+        # subclasses RuntimeError, so no bare RuntimeError re-raise here):
+        # keep capturing via the public path, accept the noise
+        jax.profiler.start_trace(log_dir)
+        return
+    if already_active:
+        # only OUR sentinel propagates — a second concurrent window is a
+        # caller bug, not a degradation case
+        raise RuntimeError(
+            "profiler session already active — only one "
+            "profiler_window may run at a time")
+
+
+class NullProfilerWindow:
+    """Disabled window: every call is a no-op (no clock read, no branch
+    beyond the method call)."""
+
+    __slots__ = ()
+    active = False
+    profiled = False
+
+    def maybe_start(self, step_end: int, first_step=None) -> None:
+        pass
+
+    def maybe_stop(self, step_end: int, drain=None) -> None:
+        pass
+
+    def stop(self, drain=None) -> None:
+        pass
+
+
+NULL_PROFILER_WINDOW = NullProfilerWindow()
+
+
+class ProfilerWindow:
+    """One jax.profiler capture window over steps
+    [profile_steps[0], profile_steps[1]) — snapped outward to whole work
+    units by the caller's ``step_end`` granularity (a chunk profiles whole
+    or not at all, exactly the PR 4 per-site behavior)."""
+
+    def __init__(self, profile_dir: str, profile_steps: tuple = (3, 8),
+                 tracer=NULL_TRACER, on_stop=None):
+        self.dir = profile_dir
+        self.steps = tuple(profile_steps)
+        self.tracer = tracer
+        self.active = False
+        self.profiled = False
+        self._anchor: dict = {}
+        self._first: Optional[int] = None
+        self._last_end: Optional[int] = None
+        # called with the profile dir after a successful stop — the loops
+        # pass heartbeat.observe_device so status.json grows the ``device``
+        # block from the capture that just landed
+        self._on_stop = on_stop
+
+    def maybe_start(self, step_end: int, first_step=None) -> None:
+        """``first_step``: the unit's FIRST step (chunk start) — under K>1
+        the capture snaps outward to the whole chunk, so the profiled step
+        count is [first_step, last stop step], not [profile_steps)."""
+        if self.active or self.profiled or step_end < self.steps[0]:
+            return
+        os.makedirs(self.dir, exist_ok=True)
+        _quiet_start_trace(self.dir)
+        self._first = int(first_step if first_step is not None else step_end)
+        # stamped AFTER start_trace returns; with the python tracer off the
+        # capture has no start event, so the merge anchors on the DRAIN
+        # stamp below instead (device_attr.merge_timeline)
+        self._anchor = {
+            "schema": 1,
+            "profile_steps": list(self.steps),
+            "first_step": self._first,
+            "started_unix": time.time(),
+            "started_perf": time.perf_counter(),
+            # host-tracer-relative µs of the same instant (None when the
+            # run has no tracer — the timeline then keeps separate origins)
+            "tracer_ts_us": getattr(self.tracer, "now_us", lambda: None)(),
+        }
+        self.active = True
+
+    def maybe_stop(self, step_end: int, drain=None) -> None:
+        if not self.active:
+            return
+        self._last_end = int(step_end)  # newest unit fully inside the window
+        if step_end >= self.steps[1] - 1:
+            self.stop(drain)
+
+    def stop(self, drain=None) -> None:
+        """Stop the capture (drain first — the PR 4 fix, now unconditional:
+        stopping mid-async-dispatch truncates the profiled steps) and write
+        the anchor file."""
+        if not self.active:
+            return
+        import jax
+
+        if drain is not None:
+            try:
+                jax.block_until_ready(drain)
+            except Exception:
+                # a poisoned carry (fault injection, device error) raises on
+                # await — the loops call stop() from their finally blocks,
+                # so propagating here would MASK the original exception and
+                # leak the profiler session; a truncated capture is the
+                # honest outcome of a run that died mid-window
+                pass
+        # the DRAIN stamp: the devices just went idle, so the capture's last
+        # device-event END corresponds to this host instant — the merge
+        # anchor that survives the python tracer being off
+        self._anchor.update(
+            drained_unix=time.time(),
+            drained_perf=time.perf_counter(),
+            drained_tracer_ts_us=getattr(self.tracer, "now_us",
+                                         lambda: None)(),
+        )
+        jax.profiler.stop_trace()
+        self.active = False
+        self.profiled = True
+        self._anchor.update(
+            stopped_unix=time.time(),
+            stopped_perf=time.perf_counter(),
+            last_step=self._last_end,
+        )
+        if self._last_end is not None and self._first is not None:
+            self._anchor["steps_profiled"] = self._last_end - self._first + 1
+        tmp = os.path.join(self.dir, ANCHOR_FILE + ".tmp")
+        try:
+            with open(tmp, "w") as fh:
+                json.dump(self._anchor, fh)
+            os.replace(tmp, os.path.join(self.dir, ANCHOR_FILE))
+        except OSError:
+            pass  # anchor is best-effort; the capture itself already landed
+        if self._on_stop is not None:
+            try:
+                self._on_stop(self.dir)
+            except Exception:
+                pass  # observation must never take the run down
+
+
+def profiler_window(profile_dir: Optional[str], profile_steps: tuple = (3, 8),
+                    enabled: bool = True, tracer=NULL_TRACER, on_stop=None):
+    """The one construction rule all four loop sites share: a real window
+    iff a profile_dir is configured on the metrics-emitting process, else
+    the shared no-op singleton (callers never branch)."""
+    if profile_dir and enabled:
+        return ProfilerWindow(profile_dir, profile_steps, tracer, on_stop)
+    return NULL_PROFILER_WINDOW

@@ -79,6 +79,11 @@ class NullTracer:
     def name_thread(self, label: str) -> None:
         pass
 
+    def now_us(self):
+        """No tracer clock — anchor consumers treat None as "no shared
+        timebase" (obs/profiling.profiler_window)."""
+        return None
+
     def flush(self) -> None:
         pass
 
@@ -210,6 +215,12 @@ class SpanTracer:
         ev = {"name": "thread_name", "ph": "M", "pid": self._pid,
               "tid": threading.get_ident(), "args": {"name": label}}
         self._append(ev)
+
+    def now_us(self) -> float:
+        """Current tracer-relative timestamp (µs) — the shared clock the
+        profiler window's anchor stamps so device captures can be shifted
+        onto the host lanes (obs/profiling.py + obs/device_attr.py)."""
+        return round((time.perf_counter() - self._t0) * 1e6, 3)
 
     @property
     def last_span(self) -> Optional[str]:

@@ -51,7 +51,12 @@ import numpy as np
 from draco_tpu import rng as drng
 from draco_tpu.config import TrainConfig
 from draco_tpu.data.batching import chunk_ranges
-from draco_tpu.obs import NULL_TRACER, CompileWatch, RunHeartbeat
+from draco_tpu.obs import (
+    NULL_TRACER,
+    CompileWatch,
+    RunHeartbeat,
+    profiler_window,
+)
 from draco_tpu.obs.forensics import record_value
 from draco_tpu.resilience import faults as faults_mod
 from draco_tpu.resilience.supervisor import (
@@ -258,20 +263,16 @@ def _run_eager(setup, cfg, state, start, last_step, adv, straggle, writer,
     from draco_tpu.parallel.sp_step import synthetic_text
 
     tracer, heartbeat, watch = obs.tracer, obs.heartbeat, obs.compile_watch
-    total_end, profile_dir, profile_steps = (obs.total_end, obs.profile_dir,
-                                             obs.profile_steps)
+    total_end = obs.total_end
+    # shared capture window (obs/profiling.py): start/stop + the
+    # drain-before-stop fix + the merged-timeline anchor, one
+    # implementation for all four loop sites (ISSUE 9); on stop the capture
+    # folds into the heartbeat's ``device`` status block
+    win = profiler_window(obs.profile_dir, obs.profile_steps, tracer=tracer,
+                          on_stop=heartbeat.observe_device)
     metrics = {}
-    profiling = False
     for step in range(start, last_step + 1):
-        if profile_dir and step == profile_steps[0]:
-            jax.profiler.start_trace(profile_dir)
-            profiling = True
-        if profiling and step == profile_steps[1]:
-            # drain the async-dispatch queue before stopping, or the capture
-            # truncates the still-executing profiled steps
-            jax.block_until_ready(state.params)
-            jax.profiler.stop_trace()
-            profiling = False
+        win.maybe_start(step)
         with tracer.span("gather"):
             toks = jnp.asarray(
                 synthetic_text(cfg.seed, step, cfg.num_workers,
@@ -286,6 +287,7 @@ def _run_eager(setup, cfg, state, start, last_step, adv, straggle, writer,
                     state, toks, jnp.asarray(adv[step]),
                     jnp.asarray(~straggle[step]),
                 )
+        win.maybe_stop(step, state.params)
         # materialize metrics at log boundaries only — the eager loop's
         # historical device-sync cadence; fetching every step for the
         # heartbeat would re-serialize the async-dispatch pipeline. The
@@ -313,9 +315,7 @@ def _run_eager(setup, cfg, state, start, last_step, adv, straggle, writer,
                 writer.flush()
             _snap_stop(cfg, state, step, obs, already_saved=bool(boundary))
             break
-    if profiling:
-        jax.block_until_ready(state.params)
-        jax.profiler.stop_trace()
+    win.stop(state.params)  # loop ended inside the window
     return state, metrics
 
 
@@ -328,8 +328,7 @@ def _run_chunked(setup, cfg, state, start, last_step, adv, straggle, writer,
     from draco_tpu.utils.metrics import DeferredMetricWriter
 
     tracer, heartbeat, watch = obs.tracer, obs.heartbeat, obs.compile_watch
-    total_end, profile_dir, profile_steps = (obs.total_end, obs.profile_dir,
-                                             obs.profile_steps)
+    total_end = obs.total_end
     if setup.train_token_many is None:
         raise ValueError(
             f"{tag} route setup lacks train_token_many — rebuild it with "
@@ -380,17 +379,17 @@ def _run_chunked(setup, cfg, state, start, last_step, adv, straggle, writer,
             )
         return toks, masks, presents
 
-    profiling = profiled = False
+    # shared capture window (obs/profiling.py): chunk-snapped start/stop +
+    # drain-before-stop + the merged-timeline anchor, same rule as
+    # Trainer._run_chunked (ISSUE 9); on stop the capture folds into the
+    # heartbeat's ``device`` status block
+    win = profiler_window(obs.profile_dir, obs.profile_steps, tracer=tracer,
+                          on_stop=heartbeat.observe_device)
     try:
         chunk = assemble(0)
         for i, (s0, k) in enumerate(ranges):
             end = s0 + k - 1
-            if (profile_dir and not profiling and not profiled
-                    and end >= profile_steps[0]):
-                # chunk-snapped capture, same rule as Trainer._run_chunked:
-                # start at the first chunk reaching profile_steps[0]
-                jax.profiler.start_trace(profile_dir)
-                profiling = True
+            win.maybe_start(end, first_step=s0)
             toks, masks, presents = chunk
             with tracer.span("dispatch", chunk_start=s0, k=k), \
                     watch.expect("train_token_many", key=k):
@@ -413,11 +412,7 @@ def _run_chunked(setup, cfg, state, start, last_step, adv, straggle, writer,
                                            if prefetch is not None else 0),
                         **watch.snapshot()})
                     tracer.flush()
-            if profiling and end >= profile_steps[1] - 1:
-                jax.block_until_ready(state.params)
-                jax.profiler.stop_trace()
-                profiling = False
-                profiled = True
+            win.maybe_stop(end, state.params)
             if boundary:
                 boundary_eval_ckpt(end, state)
             if _stop_requested(obs, end):
@@ -429,9 +424,10 @@ def _run_chunked(setup, cfg, state, start, last_step, adv, straggle, writer,
                            already_saved=bool(boundary))
                 break
     finally:
-        if profiling:
-            jax.profiler.stop_trace()
-        if prefetch is not None:
-            prefetch.close()
+        try:
+            win.stop(state.params)  # loop ended inside the window
+        finally:
+            if prefetch is not None:
+                prefetch.close()
     last = deferred.last
     return state, ({"loss": last["loss"]} if "loss" in last else {})

@@ -37,7 +37,12 @@ from draco_tpu.config import TrainConfig
 from draco_tpu.data import batching
 from draco_tpu.data.datasets import Dataset, load_dataset
 from draco_tpu.data.prefetch import BatchPrefetcher, ChunkPrefetcher
-from draco_tpu.obs import RunHeartbeat, make_compile_watch, make_tracer
+from draco_tpu.obs import (
+    RunHeartbeat,
+    make_compile_watch,
+    make_tracer,
+    profiler_window,
+)
 from draco_tpu.obs.forensics import record_value
 from draco_tpu.resilience import faults as faults_mod
 from draco_tpu.resilience.supervisor import (
@@ -308,14 +313,15 @@ class Trainer:
     def _run_eager(self, n_steps: int, profile_dir, profile_steps) -> dict:
         cfg = self.cfg
         last = {}
-        profiling = False
+        # the shared capture window (obs/profiling.py): start/stop/drain +
+        # the merged-timeline anchor, one implementation for all four loop
+        # sites (previously copy-pasted per site, ISSUE 9); on stop the
+        # capture folds into the heartbeat's ``device`` status block
+        win = profiler_window(profile_dir, profile_steps, self._is_main,
+                              self.tracer,
+                              on_stop=self.heartbeat.observe_device)
         for step in range(self._start_step, n_steps + 1):
-            if profile_dir and step == profile_steps[0] and self._is_main:
-                jax.profiler.start_trace(profile_dir)
-                profiling = True
-            if profiling and step == profile_steps[1]:
-                jax.profiler.stop_trace()
-                profiling = False
+            win.maybe_start(step)
             seg = Segments()
             seg.begin("fetch")
             with self.tracer.span("gather+upload", step=step):
@@ -349,6 +355,7 @@ class Trainer:
                 jax.block_until_ready(self.state.params)
             seg.end()
 
+            win.maybe_stop(step, self.state.params)
             record = {"step": step, **metrics, **seg.as_dict()}
             last = record
             self.heartbeat.observe(record)
@@ -375,8 +382,7 @@ class Trainer:
                     self.writer.flush()
                 self._snap_stop(step, already_saved=bool(boundary))
                 break
-        if profiling:  # loop ended before profile_steps[1]
-            jax.profiler.stop_trace()
+        win.stop(self.state.params)  # loop ended inside the window
         return last
 
     def _run_chunked(self, n_steps: int, profile_dir, profile_steps) -> dict:
@@ -401,7 +407,9 @@ class Trainer:
         def should_log(step):
             return step % cfg.log_every == 0 or step == 1
 
-        profiling = profiled = False
+        win = profiler_window(profile_dir, profile_steps, self._is_main,
+                              self.tracer,
+                              on_stop=self.heartbeat.observe_device)
         # t_fetch = this chunk's host assemble + upload wall; t_comp = the
         # flush window's remaining wall (device execution + drain) amortized
         # over its steps — same record keys as the eager loop's segments
@@ -422,10 +430,9 @@ class Trainer:
         chunk, fetch_s = upload(0)
         for i, (start, k) in enumerate(ranges):
             end = start + k - 1
-            if (profile_dir and not profiling and not profiled
-                    and self._is_main and end >= profile_steps[0]):
-                jax.profiler.start_trace(profile_dir)
-                profiling = True
+            # capture snaps to whole chunks; the chunk start rides along so
+            # the anchor's steps_profiled reflects the snapped window
+            win.maybe_start(end, first_step=start)
             xs, ys, masks, presents = chunk
             with self.tracer.span("dispatch", chunk_start=start, k=k), \
                     self.compile_watch.expect("train_many", key=k):
@@ -464,11 +471,7 @@ class Trainer:
                 window_t0 = time.perf_counter()
                 window_fetch = 0.0
                 window_steps = 0
-            if profiling and end >= profile_steps[1] - 1:
-                jax.block_until_ready(self.state.params)
-                jax.profiler.stop_trace()
-                profiling = False
-                profiled = True
+            win.maybe_stop(end, self.state.params)
             if boundary:
                 self.evaluate(end)
                 if cfg.train_dir:
@@ -489,9 +492,7 @@ class Trainer:
                     deferred.flush(should_log)
                 self._snap_stop(end, already_saved=bool(boundary))
                 break
-        if profiling:
-            jax.block_until_ready(self.state.params)
-            jax.profiler.stop_trace()
+        win.stop(self.state.params)
         return deferred.last
 
     def _prefetch_depth(self) -> dict:

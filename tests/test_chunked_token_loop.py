@@ -77,13 +77,15 @@ ROUTES = {
     "sp": dict(
         kw=dict(num_workers=4, seq_shards=2, mode="geometric_median",
                 worker_fail=1, straggle_mode="drop", straggle_count=1),
-        train=lambda cfg: train_sp(cfg, make_mesh_2d(4, 2), quiet=True),
+        train=lambda cfg, prof=None: train_sp(cfg, make_mesh_2d(4, 2),
+                                              quiet=True, profile_dir=prof),
     ),
     "tp": dict(
         kw=dict(num_workers=9, approach="cyclic", worker_fail=2,
                 adversary_count=1, redundancy="shared",
                 straggle_mode="drop", straggle_count=1),
-        train=lambda cfg: train_tp(cfg, make_folded_wtp_mesh(9), quiet=True),
+        train=lambda cfg, prof=None: train_tp(cfg, make_folded_wtp_mesh(9),
+                                              quiet=True, profile_dir=prof),
     ),
     # the approximate family on the single-shard fold (ISSUE 8): no live
     # adversary (validate rejects one), two seeded drops per step inside
@@ -94,7 +96,8 @@ ROUTES = {
                 redundancy="shared", code_redundancy=1.5,
                 straggler_alpha=0.25, straggle_mode="drop",
                 straggle_count=2),
-        train=lambda cfg: train_sp(cfg, make_mesh_2d(8, 1), quiet=True),
+        train=lambda cfg, prof=None: train_sp(cfg, make_mesh_2d(8, 1),
+                                              quiet=True, profile_dir=prof),
     ),
 }
 
@@ -113,7 +116,11 @@ def test_chunked_equals_eager_bitwise(route, tmp_path):
         d = str(tmp_path / f"{route}_k{k}")
         cfg = make_cfg(**r["kw"], steps_per_call=k, train_dir=d,
                        trace_dir=d, eval_freq=3, log_every=1)
-        state, metrics = r["train"](cfg)
+        # the chunked run additionally captures a jax.profiler window
+        # (ISSUE 9): the capture must observe, never perturb — metrics
+        # stay bitwise-equal to the unprofiled eager run, still under
+        # compile_guard="raise" with 0 steady retraces
+        state, metrics = r["train"](cfg, d if k == 4 else None)
         out[k] = (params_vec(state), metric_stream(d), float(metrics["loss"]))
     np.testing.assert_array_equal(out[1][0], out[4][0])
     assert out[1][1] == out[4][1]  # identical per-step metric values
@@ -234,6 +241,19 @@ def _assert_route_telemetry(route, kw, run_dir):
     assert {"train_token_many[3]", "train_token_many[1]"} <= labels
     assert not any(r["steady_recompile"] for r in ledger)
     assert any(e.get("cat") == "compile" for e in events)
+    # the profiled window's device surface (ISSUE 9): capture + shared-clock
+    # anchor landed, and the heartbeat folded the capture into the
+    # ``device`` status block (no scope map on a plain --profile-dir run,
+    # so attribution honestly reads 0 — everything in the unattributed row)
+    from draco_tpu.obs import device_attr
+
+    assert device_attr.find_capture(str(run_dir)) is not None
+    anchor = device_attr.load_anchor(str(run_dir))
+    assert anchor is not None and anchor["steps_profiled"] == 7
+    assert anchor["tracer_ts_us"] is not None
+    dev = status["device"]
+    assert dev["profiled_steps"] == 7 and dev["total_device_us"] > 0
+    assert dev["attributed_frac"] == 0.0 and dev["decode_share"] == 0.0
 
 
 def test_device_token_gen_bitwise_and_distinct():

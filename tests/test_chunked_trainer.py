@@ -124,7 +124,11 @@ def test_chunked_equals_eager_bitwise(ds, approach, tmp_path):
         tr = Trainer(make_cfg(**kw, steps_per_call=k, train_dir=d,
                               trace_dir=d),
                      mesh=mesh, dataset=ds, quiet=True)
-        last = tr.run()
+        # the chunked run additionally captures a jax.profiler window
+        # (ISSUE 9): the capture must observe, never perturb — metrics
+        # stay bitwise-equal to the unprofiled eager run, still under
+        # compile_guard="raise" with 0 steady retraces
+        last = tr.run(profile_dir=(d if k == 4 else None))
         out[k] = (params_vec(tr), metric_stream(d), last)
         # the sentinel saw the run's compiles and zero steady-state
         # recompiles (compile_guard="raise" would already have failed the
@@ -303,6 +307,21 @@ def _assert_telemetry_artifacts(run_dir, approach):
         assert fxb["top_suspects"] and all(
             t["trust"] < 1.0 for t in fxb["top_suspects"])
         assert status["schema"] == 2
+    # the profiled window's device block (ISSUE 9): the capture + anchor
+    # landed and the heartbeat folded the per-phase attribution — a plain
+    # --profile-dir run has no scope map, so the honest state is all time
+    # in the unattributed row (attributed_frac 0, device_attr docstring)
+    from draco_tpu.obs import device_attr
+
+    assert device_attr.find_capture(str(run_dir)) is not None
+    anchor = device_attr.load_anchor(str(run_dir))
+    assert anchor is not None and anchor["steps_profiled"] == 6
+    assert anchor["tracer_ts_us"] is not None  # shared-clock anchor stamped
+    dev = status["device"]
+    assert dev["profiled_steps"] == 6
+    assert dev["total_device_us"] > 0
+    assert sum(dev["phase_fracs"].values()) == pytest.approx(1.0, abs=2e-3)
+    assert dev["attributed_frac"] == 0.0 and dev["decode_share"] == 0.0
 
 
 @pytest.mark.core

@@ -690,3 +690,122 @@ def test_lm_lowering_audit_matches_r5_rung():
         assert int(flag("steps", leg)) + 1 == LM_BIG["max_steps"]
         got = set(flag("variants", leg).split(","))
         assert got >= set(variants), (got, variants)
+
+
+def test_device_profile_check_gates_on_flipped_decode_share(tmp_path,
+                                                            capsys):
+    """tools/device_profile.py --check (jax-free): the committed artifact
+    passes its self-consistency gate; a flipped decode-share row exits 1
+    and names the cell + metric; a broken phase sum and an un-tripped
+    mismatch control gate too (ISSUE 9 acceptance)."""
+    import json
+
+    from tools import device_profile
+
+    committed = os.path.join(REPO, "baselines_out", "device_profile.json")
+    assert device_profile.main(["--check", "--artifact", committed]) == 0
+    capsys.readouterr()
+
+    data = json.load(open(committed))
+    cell = next(r for r in data["cells"] if not r.get("control"))
+    # flip the decode-share column without touching the phase rows it is
+    # derived from — the check recomputes and names the drift
+    cell["programs"][0]["decode_share"] = round(
+        cell["programs"][0]["decode_share"] + 0.25, 4)
+    bad = tmp_path / "device_profile.json"
+    bad.write_text(json.dumps(data))
+    assert device_profile.main(["--check", "--artifact", str(bad)]) == 1
+    out = capsys.readouterr().out
+    assert cell["cell"] in out and "decode_share" in out
+
+    # a phase row edited out from under the total breaks the sums contract
+    data = json.load(open(committed))
+    cell = next(r for r in data["cells"] if not r.get("control"))
+    cell["programs"][0]["phases"]["draco_comp"]["time_us"] = 0.0
+    bad.write_text(json.dumps(data))
+    assert device_profile.main(["--check", "--artifact", str(bad)]) == 1
+    assert "phase rows sum" in capsys.readouterr().out
+
+    # the seeded mismatch control must have tripped
+    data = json.load(open(committed))
+    next(r for r in data["cells"] if r.get("control"))["ok"] = False
+    bad.write_text(json.dumps(data))
+    assert device_profile.main(["--check", "--artifact", str(bad)]) == 1
+    assert "control did not trip" in capsys.readouterr().out
+
+
+def test_perf_watch_gates_on_flipped_device_metrics(tmp_path):
+    """A decode-share regression in device_profile.json gates perf_watch
+    at the time tolerance and names the metric; the explicit-collective
+    instruction count is pinned at tolerance 0 in BOTH directions (a
+    collective vanishing from the trace is as much a semantic change as
+    one appearing)."""
+    import json
+
+    from tools import perf_watch
+
+    root = tmp_path
+    (root / "baselines_out").mkdir()
+
+    def artifact(decode_share, ar_instr, control_ok=True):
+        phases = {
+            "draco_comp": {"time_us": 700.0, "frac": 0.7, "events": 10},
+            "draco_encode": {"time_us": 50.0, "frac": 0.05, "events": 2},
+            "draco_decode": {"time_us": decode_share * 1000.0,
+                             "frac": decode_share, "events": 5},
+            "draco_update": {"time_us": 30.0, "frac": 0.03, "events": 1},
+            "other": {"time_us": 20.0, "frac": 0.02, "events": 1},
+            "unattributed": {"time_us": 0.0, "frac": 0.0, "events": 0},
+        }
+        counts = {"all_reduce": ar_instr, "all_gather": 0, "all_to_all": 0,
+                  "collective_permute": 5, "reduce_scatter": 0}
+        led = {k: {"instructions": counts[k], "events": counts[k] * 8,
+                   "bytes": counts[k] * 4096, "time_us": 1.0}
+               for k in counts}
+        return {"schema": 1, "all_ok": True, "cells": [
+            {"cell": "lm_sp_k4", "steps_per_call": 4, "ok": True,
+             "programs": [{
+                 "module": "jit_many_body", "total_device_us": 1000.0,
+                 "phases": phases, "decode_share": decode_share,
+                 "collectives": {"explicit": led,
+                                 "gspmd": {}},
+                 "cross_check": {"ok": True, "expected": counts,
+                                 "observed": counts},
+             }]},
+            {"cell": "control_extra_all_gather", "control": True,
+             "ok": control_ok},
+        ]}
+
+    path = root / "baselines_out" / "device_profile.json"
+    path.write_text(json.dumps(artifact(0.20, 2)))
+    assert perf_watch.main(["--root", str(root), "--snapshot"]) == 0
+    snap = json.loads(
+        (root / "baselines_out" / "perf_watch.json").read_text())
+    assert "device.lm_sp_k4.draco_decode_share" in snap["metrics"]
+    assert "device.lm_sp_k4.coll.all_reduce.instructions" in snap["metrics"]
+    assert "device.control_extra_all_gather.tripped" in snap["metrics"]
+    # zero-count kinds with a zero manifest don't spam the metric set
+    assert "device.lm_sp_k4.coll.all_to_all.instructions" \
+        not in snap["metrics"]
+    assert perf_watch.main(["--root", str(root)]) == 0  # clean
+
+    # decode share grows 30% relative: gates at the 10% time tolerance
+    path.write_text(json.dumps(artifact(0.26, 2)))
+    out = root / "report.json"
+    assert perf_watch.main(["--root", str(root), "--json", str(out)]) == 1
+    regs = {r["metric"] for r in json.loads(out.read_text())["regressions"]}
+    assert "device.lm_sp_k4.draco_decode_share" in regs
+
+    # an explicit collective VANISHING (2 -> 1, the "good" direction for a
+    # lower-better kind) still gates: the ledger is pinned, not scored
+    path.write_text(json.dumps(artifact(0.20, 1)))
+    assert perf_watch.main(["--root", str(root), "--json", str(out)]) == 1
+    regs = {r["metric"] for r in json.loads(out.read_text())["regressions"]}
+    assert {"device.lm_sp_k4.coll.all_reduce.instructions",
+            "device.lm_sp_k4.coll.all_reduce.bytes"} <= regs
+
+    # the mismatch control silently not tripping gates too
+    path.write_text(json.dumps(artifact(0.20, 2, control_ok=False)))
+    assert perf_watch.main(["--root", str(root), "--json", str(out)]) == 1
+    regs = {r["metric"] for r in json.loads(out.read_text())["regressions"]}
+    assert "device.control_extra_all_gather.tripped" in regs

@@ -51,6 +51,15 @@ Folded sources (all optional — a missing artifact folds nothing):
                                 feasible is a semantic change, not an
                                 improvement), wall ms/step at the time
                                 tolerance
+  baselines_out/device_profile.json
+                                the device-time attribution ledger
+                                (tools/device_profile.py, ISSUE 9):
+                                per-cell draco phase shares at the time
+                                tolerance (decode-share regressions gate),
+                                explicit-collective instruction/byte
+                                counts pinned at tolerance 0 both ways,
+                                manifest cross-check + seeded mismatch
+                                control as 0-tolerance ok flags
 
 Tolerances are per metric KIND (relative change vs baseline): time metrics
 default 10% (ms/step, a 20% regression trips loudly), bytes 10%, flops 2%
@@ -297,6 +306,63 @@ def fold_straggler(root: str, metrics: dict) -> None:
                 "source": src}
 
 
+def fold_device_profile(root: str, metrics: dict) -> None:
+    """Device-time attribution artifact (tools/device_profile.py, ISSUE 9):
+    per-cell phase SHARES at the ordinary time tolerance — a decode-share
+    creep past 10% relative is exactly the regression ROADMAP items 1-2
+    must develop under — and the explicit-collective instruction/byte
+    ledger pinned at tolerance 0 in BOTH directions (the runtime trace and
+    the static Manifest must agree; a collective appearing OR vanishing is
+    a semantic change, never noise). Cross-check flags and the seeded
+    mismatch control gate as 0-tolerance ok-kind."""
+    path = os.path.join(root, "baselines_out", "device_profile.json")
+    data = _read_json(path)
+    if not isinstance(data, dict):
+        return
+    src = "baselines_out/device_profile.json"
+    if "all_ok" in data:
+        metrics["device.all_ok"] = {"value": float(bool(data["all_ok"])),
+                                    "kind": "ok", "source": src}
+    for row in data.get("cells", []):
+        cell = row.get("cell")
+        if not cell:
+            continue
+        if row.get("control"):
+            metrics[f"device.{cell}.tripped"] = {
+                "value": float(bool(row.get("ok"))), "kind": "ok",
+                "source": src}
+            continue
+        programs = row.get("programs", [])
+        for pi, prog in enumerate(programs):
+            # today every cell profiles ONE program and the key is the bare
+            # cell; a multi-program cell suffixes the module so a second
+            # program can never silently overwrite the first's gate rows
+            base = f"device.{cell}" if len(programs) == 1 else \
+                f"device.{cell}.{prog.get('module') or pi}"
+            for phase in ("draco_comp", "draco_encode", "draco_decode",
+                          "draco_update"):
+                frac = (prog.get("phases", {}).get(phase) or {}).get("frac")
+                if isinstance(frac, (int, float)):
+                    metrics[f"{base}.{phase}_share"] = {
+                        "value": float(frac), "kind": "time_ms",
+                        "source": src}
+            check = prog.get("cross_check") or {}
+            metrics[f"{base}.cross_check_ok"] = {
+                "value": float(bool(check.get("ok"))), "kind": "ok",
+                "source": src}
+            expl = (prog.get("collectives") or {}).get("explicit") or {}
+            for kind, led in sorted(expl.items()):
+                if not led.get("instructions") and not (
+                        check.get("expected") or {}).get(kind):
+                    continue
+                metrics[f"{base}.coll.{kind}.instructions"] = {
+                    "value": float(led.get("instructions", 0)),
+                    "kind": "pinned", "source": src}
+                metrics[f"{base}.coll.{kind}.bytes"] = {
+                    "value": float(led.get("bytes", 0)),
+                    "kind": "pinned", "source": src}
+
+
 def fold_all(root: str) -> dict:
     metrics: dict = {}
     fold_bench(root, metrics)
@@ -305,6 +371,7 @@ def fold_all(root: str) -> dict:
     fold_program_lint(root, metrics)
     fold_chaos(root, metrics)
     fold_straggler(root, metrics)
+    fold_device_profile(root, metrics)
     return metrics
 
 

@@ -31,6 +31,8 @@ import json
 import os
 import sys
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 
 def load_trace(path: str) -> "tuple[list, int]":
     """(events, droppedEvents). The tracer's bounded buffer drops the
@@ -184,7 +186,51 @@ def fold_status(path: str) -> dict:
     return out
 
 
-def make_report(trace_path: str, metrics_path=None) -> dict:
+def fold_device(profile_dir: str):
+    """The device half (ISSUE 9): when the run dir holds a jax.profiler
+    capture, fold it into the per-phase device table + collective comms
+    ledger via obs/device_attr (jax-free, but part of draco_tpu — this
+    tool stays usable from a bare tools/ checkout by degrading to a note
+    when the package is absent). Missing or torn captures are tolerated
+    exactly like metrics.jsonl."""
+    try:
+        from draco_tpu.obs import device_attr
+    except ImportError:
+        # bare tools/ checkout: probe the capture layout inline (the one
+        # place the package's find_capture glob can't be reused)
+        import glob
+
+        if glob.glob(os.path.join(profile_dir, "plugins", "profile", "*",
+                                  "*.trace.json*")):
+            return {"note": "profiler capture present but draco_tpu not "
+                            "importable — device attribution skipped"}
+        return None  # no capture at all — the common case, no note
+    try:
+        fold = device_attr.fold_capture(profile_dir)
+    except Exception:
+        return None
+    if not fold:
+        return None  # no capture (the common case) or a torn one
+    out = {"trace": fold.get("trace"), "programs": []}
+    anchor = fold.get("anchor") or {}
+    if anchor.get("steps_profiled") is not None:
+        out["steps_profiled"] = anchor["steps_profiled"]
+    for prog in fold["programs"]:
+        row = {
+            "module": prog["module"],
+            "total_device_us": round(prog["total_device_us"], 1),
+            "wall_us": round(prog["wall_us"], 1),
+            "phases": {k: {"time_us": round(v["time_us"], 1),
+                           "frac": round(v["frac"], 4),
+                           "events": v["events"]}
+                       for k, v in prog["phases"].items()},
+            "collectives": prog["collectives"],
+        }
+        out["programs"].append(row)
+    return out
+
+
+def make_report(trace_path: str, metrics_path=None, profile_dir=None) -> dict:
     events, dropped = load_trace(trace_path)
     phases, wall_ms = fold_spans(events)
     report = {
@@ -218,6 +264,13 @@ def make_report(trace_path: str, metrics_path=None) -> dict:
             report["metrics"]["path"] = metrics_path
         except OSError:
             pass
+    # device half (ISSUE 9): default probe is the trace's own directory —
+    # runs that pointed --profile-dir at the train/trace dir get the device
+    # table for free; a missing capture folds nothing
+    probe = profile_dir or os.path.dirname(trace_path) or "."
+    device = fold_device(probe)
+    if device:
+        report["device"] = device
     return report
 
 
@@ -270,6 +323,38 @@ def print_table(report: dict, out=None) -> None:
             bits.append(f"loss {m.get('first_loss'):.4f} -> "
                         f"{m.get('last_loss'):.4f}")
         print("metrics: " + "  ".join(bits), file=out)
+    # per-phase device table + comms ledger (ISSUE 9) — only when the run
+    # dir holds a profiler capture
+    dev = report.get("device")
+    if dev and dev.get("note"):
+        print(f"device: {dev['note']}", file=out)
+    elif dev:
+        steps = dev.get("steps_profiled")
+        for prog in dev.get("programs", []):
+            print(f"device program {prog['module']}: "
+                  f"{prog['total_device_us'] / 1e3:.1f} ms device self-time"
+                  + (f" over {steps} profiled steps" if steps else ""),
+                  file=out)
+            hdr = f"  {'device phase':<20}{'events':>8}{'total ms':>12}" \
+                  f"{'share':>8}"
+            print(hdr, file=out)
+            print("  " + "-" * (len(hdr) - 2), file=out)
+            rows = sorted(prog["phases"].items(),
+                          key=lambda kv: -kv[1]["time_us"])
+            for name, r in rows:
+                print(f"  {name:<20}{r['events']:>8}"
+                      f"{r['time_us'] / 1e3:>12.2f}{r['frac']:>8.1%}",
+                      file=out)
+            for side in ("explicit", "gspmd"):
+                for kind, row in sorted(
+                        (prog.get("collectives", {}).get(side) or {})
+                        .items()):
+                    if not row.get("instructions"):
+                        continue
+                    print(f"  collective {side}/{kind}: "
+                          f"instructions={row['instructions']} "
+                          f"events={row['events']} bytes={row['bytes']} "
+                          f"time_ms={row['time_us'] / 1e3:.2f}", file=out)
 
 
 def main(argv=None) -> int:
@@ -280,6 +365,9 @@ def main(argv=None) -> int:
                     help="metrics.jsonl path (default: next to the trace)")
     ap.add_argument("--json", default="",
                     help="also write the folded report as JSON here")
+    ap.add_argument("--profile-dir", default="",
+                    help="jax.profiler capture dir for the device table "
+                         "(default: probe the trace's own directory)")
     args = ap.parse_args(argv)
 
     trace_path = args.path
@@ -287,7 +375,8 @@ def main(argv=None) -> int:
         trace_path = os.path.join(trace_path, "trace.json")
     metrics_path = args.metrics or os.path.join(
         os.path.dirname(trace_path), "metrics.jsonl")
-    report = make_report(trace_path, metrics_path)
+    report = make_report(trace_path, metrics_path,
+                         profile_dir=args.profile_dir or None)
     print_table(report)
     if args.json:
         with open(args.json, "w") as fh:
