@@ -245,7 +245,8 @@ def _compiled_flops(compiled):
         return None
 
 
-def run(cfg_kwargs, ds, mesh, steps, warmup=1, reps=2, want_flops=False):
+def run(cfg_kwargs, ds, mesh, steps, warmup=1, reps=2, want_flops=False,
+        info=None):
     """Per-step wall-clock of the jitted train step, plus the compile cost.
 
     Returns ``(dt_per_step_s, loss, flops, compile_s)``. The first-call
@@ -281,6 +282,13 @@ def run(cfg_kwargs, ds, mesh, steps, warmup=1, reps=2, want_flops=False):
 
     cfg = TrainConfig(**cfg_kwargs)
     tr = Trainer(cfg, mesh=mesh, dataset=ds, quiet=True)
+    if info is not None:
+        # logical wire ledger at the program's registered shapes
+        # (obs/numerics.wire_ledger, ISSUE 10) — the record's
+        # extra.wire_bytes series perf_watch tracks round-over-round
+        from draco_tpu.obs import numerics as numerics_mod
+
+        info["wire_ledger"] = numerics_mod.wire_ledger(cfg, tr.setup.dim)
     state = tr.state
     host_x, host_y = [], []
     for step in range(1, steps + 1):
@@ -423,10 +431,20 @@ def measure(args, metric_name, error=None, detail=None):
 
     # the contender: cyclic code, r=2s+1 redundant compute like the reference
     _PHASE["name"] = "cyclic_leg"
+    cyc_info = {}
     t_cyclic, loss_c, flops_c, compile_c = run(
         dict(common, approach="cyclic", redundancy="simulate"),
         ds, mesh, args.steps, args.warmup, args.reps, want_flops=True,
+        info=cyc_info,
     )
+    ledger = cyc_info.get("wire_ledger")
+    if ledger:
+        # logical codeword bytes per step (all workers, f32 wire) — the
+        # series the item-4 narrow wire will halve/quarter (ISSUE 10)
+        base_extra["wire_bytes"] = ledger["bytes_per_step"]["f32"]
+        base_extra["wire_bytes_per_worker"] = \
+            ledger["bytes_per_worker"]["f32"]
+        base_extra["wire_dim"] = ledger["dim"]
     peak = _peak_flops(device_kind)
     mfu = (
         round(flops_c / t_cyclic / peak, 4)

@@ -185,6 +185,34 @@ def add_fit_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                         "program a further build warns (default) or raises "
                         "— a mid-run retrace re-pays the compile the "
                         "scan-chunked loops exist to amortize (PERF.md §8)")
+    p.add_argument("--numerics-watch", type=str, default="off",
+                   choices=["off", "on"],
+                   help="numerics observatory (obs/numerics.py, ISSUE 10): "
+                        "per-step dynamic-range columns (absmax/rms/"
+                        "underflow-overflow fractions at the bf16 and "
+                        "int8-per-block thresholds/exponent histogram) for "
+                        "the pre-encode gradients, the wire codewords, and "
+                        "the decoded aggregate — riding the (K, m) metric "
+                        "block at zero extra device fetches (coded "
+                        "approaches only)")
+    p.add_argument("--shadow-wire", type=str, default="off",
+                   choices=["off", "bf16", "int8"],
+                   help="shadow-quantized coded wire: round the codewords "
+                        "to this dtype in-graph, decode the shadow copy "
+                        "alongside the f32 path (which alone updates "
+                        "params), and emit shadow_err/shadow_residual/"
+                        "shadow_flag_agree + shadow detection columns — "
+                        "the ROADMAP item 4 measurement harness "
+                        "(tools/wire_study.py drives the committed matrix)")
+    p.add_argument("--shadow-round", type=str, default="nearest",
+                   choices=["nearest", "stochastic"],
+                   help="shadow quantizer rounding: deterministic nearest "
+                        "or per-step seeded stochastic rounding (noise "
+                        "shared across wire rows, so identical rows stay "
+                        "identical)")
+    p.add_argument("--shadow-block", type=int, default=256,
+                   help="int8 shadow per-block scale granularity "
+                        "(elements per f32 scale along the wire row)")
     p.add_argument("--compile-warmup", type=int, default=1,
                    help="XLA builds allowed per registered program (per "
                         "chunk shape) before the compile guard treats a "
@@ -302,6 +330,10 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         trace_dir=args.trace_dir,
         compile_guard=args.compile_guard,
         compile_warmup=args.compile_warmup,
+        numerics_watch=args.numerics_watch,
+        shadow_wire=args.shadow_wire,
+        shadow_round=args.shadow_round,
+        shadow_block=args.shadow_block,
         step_guard=args.step_guard,
         guard_residual_tol=args.guard_residual_tol,
         fault_spec=args.fault_spec,

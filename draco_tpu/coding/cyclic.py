@@ -246,7 +246,8 @@ def _complex_solve(a_re, a_im, b_re, b_im, rcond: float = 0.0):
 
 
 def _locate_v(code: CyclicCode, e_re: jnp.ndarray, e_im: jnp.ndarray,
-              present: Optional[jnp.ndarray] = None):
+              present: Optional[jnp.ndarray] = None,
+              rel_tol: float = HEALTH_REL_TOL):
     """Locator + recombination vector from one projected column e (n,).
 
     Steps 2–5 of the decode: syndrome → error-locator solve → honest-row
@@ -263,9 +264,13 @@ def _locate_v(code: CyclicCode, e_re: jnp.ndarray, e_im: jnp.ndarray,
 
       * honest rows deviate by f32 solve noise only (≈1e-6 relative);
       * a corrupt row deviates by its injected error magnitude;
-      * rows above HEALTH_REL_TOL × RMS(e) are ``flagged`` (present rows
+      * rows above ``rel_tol`` × RMS(e) are ``flagged`` (present rows
         only — a zero-filled straggler erasure is known-missing, not a
-        detected adversary);
+        detected adversary). ``rel_tol`` defaults to HEALTH_REL_TOL (the
+        f32 wire's solve-noise margin); the shadow-quantized decode
+        (obs/numerics.py, ISSUE 10) passes a wider quantization-aware
+        threshold because honest rows on a bf16/int8 wire deviate by
+        rounding noise, not f32 noise;
       * ``residual`` is the *unflagged* present rows' deviation energy as
         a fraction of total received energy — ≈ 0 whenever the decode is
         self-consistent (the located-honest codeword explains every row it
@@ -364,7 +369,7 @@ def _locate_v(code: CyclicCode, e_re: jnp.ndarray, e_im: jnp.ndarray,
     dev = (e_re - fit_re) ** 2 + (e_im - fit_im) ** 2  # (n,) |e - C1 q̂|²
     energy = e_re**2 + e_im**2
     msq = jnp.sum(energy * pres_f) / jnp.maximum(jnp.sum(pres_f), 1.0)
-    flagged = (dev > (HEALTH_REL_TOL**2) * msq) & (pres_f > 0)
+    flagged = (dev > (rel_tol**2) * msq) & (pres_f > 0)
     resid_sq = jnp.sum(jnp.where(flagged, 0.0, dev) * pres_f) / jnp.maximum(
         jnp.sum(energy * pres_f), 1e-30)
     # loud-row outlier mask (LOUD_REL_TOL docstring): forensic-only — the
@@ -382,7 +387,8 @@ def _locate_v(code: CyclicCode, e_re: jnp.ndarray, e_im: jnp.ndarray,
 
 
 def decode(code: CyclicCode, r_re: jnp.ndarray, r_im: jnp.ndarray, rand_factor: jnp.ndarray,
-           present: Optional[jnp.ndarray] = None, with_health: bool = False):
+           present: Optional[jnp.ndarray] = None, with_health: bool = False,
+           rel_tol: float = HEALTH_REL_TOL):
     """Recover the exact sum of the n batch gradients from corrupt rows.
 
     r_re, r_im: (n, d) received encoded rows (≤ s rows arbitrarily corrupt).
@@ -411,7 +417,8 @@ def decode(code: CyclicCode, r_re: jnp.ndarray, r_im: jnp.ndarray, rand_factor: 
     # 1. project to one column: e = R @ f  (the only O(n·d) work besides the
     #    final recombination — one fused pass over (R_re, R_im))
     e_re, e_im = ops_coded.complex_project(r_re, r_im, rand_factor)
-    v_full_re, v_full_im, honest, health = _locate_v(code, e_re, e_im, present)
+    v_full_re, v_full_im, honest, health = _locate_v(code, e_re, e_im,
+                                                     present, rel_tol)
 
     # 6. recombine: Re(v^T R) / n — the second O(n·d) pass, fused
     decoded = ops_coded.complex_recombine(v_full_re, v_full_im, r_re, r_im) / n
@@ -423,7 +430,8 @@ def decode(code: CyclicCode, r_re: jnp.ndarray, r_im: jnp.ndarray, rand_factor: 
 def decode_layers(code: CyclicCode, r_re: jnp.ndarray, r_im: jnp.ndarray,
                   rand_factor: jnp.ndarray, offsets,
                   present: Optional[jnp.ndarray] = None,
-                  with_health: bool = False):
+                  with_health: bool = False,
+                  rel_tol: float = HEALTH_REL_TOL):
     """Layer-granularity decode — one locator per parameter tensor.
 
     The reference decodes each layer independently with its own random
@@ -456,7 +464,7 @@ def decode_layers(code: CyclicCode, r_re: jnp.ndarray, r_im: jnp.ndarray,
     e_re_l = jnp.stack(e_res)  # (L, n)
     e_im_l = jnp.stack(e_ims)
     v_re_l, v_im_l, honest_l, health_l = jax.vmap(
-        lambda er, ei: _locate_v(code, er, ei, present)
+        lambda er, ei: _locate_v(code, er, ei, present, rel_tol)
     )(e_re_l, e_im_l)
     parts = [
         ops_coded.complex_recombine(v_re_l[i], v_im_l[i], r_re[:, a:b], r_im[:, a:b])

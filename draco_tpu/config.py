@@ -222,6 +222,31 @@ class TrainConfig:
     # K∈{1,4} equivalence suites run under), "off" records only.
     compile_guard: str = "warn"
     compile_warmup: int = 1
+    # Numerics observatory (obs/numerics.py; ISSUE 10). "on" adds per-step
+    # dynamic-range columns (absmax / rms / bf16- and int8-threshold
+    # underflow-overflow fractions / exponent histogram) for the pre-encode
+    # gradients, the post-encode codewords, and the decoded aggregate,
+    # riding the existing (K, m) metric block — zero extra device fetches,
+    # zero retraces. Coded approaches only (cyclic / maj_vote / approx):
+    # the baseline path ships no codewords and emits no optional columns.
+    numerics_watch: str = "off"
+    # Shadow-quantized wire (obs/numerics.py): round the codewords to the
+    # narrow dtype INSIDE the step body, decode the shadow copy alongside
+    # the f32 path, and emit shadow_err / shadow_residual /
+    # shadow_flag_agree (+ shadow detection counts) columns. The f32 path
+    # alone updates params — K∈{1,4} equivalence stays bitwise with the
+    # shadow enabled. This is the measurement ROADMAP item 4's real
+    # bf16/int8 wire will be built and regression-gated on.
+    shadow_wire: str = "off"  # off | bf16 | int8
+    # Shadow rounding mode: "nearest" (deterministic round-to-nearest) or
+    # "stochastic" (per-step seeded noise, shared across wire rows so
+    # bitwise-identical rows quantize identically — maj_vote's soundness
+    # condition survives).
+    shadow_round: str = "nearest"
+    # int8 per-block scale granularity: one f32 scale per this many
+    # elements along the wire row (also the blocking the numerics columns'
+    # int8 underflow threshold uses).
+    shadow_block: int = 256
 
     # --- resilience (draco_tpu/resilience; ISSUE 6) ---
     # In-graph step guard: fold the decode-health signals (loud
@@ -421,6 +446,34 @@ class TrainConfig:
         if self.compile_warmup < 0:
             raise ValueError(
                 f"compile_warmup must be >= 0, got {self.compile_warmup}"
+            )
+        if self.numerics_watch not in ("off", "on"):
+            raise ValueError(
+                f"numerics_watch must be off|on, got {self.numerics_watch!r}"
+            )
+        if self.shadow_wire not in ("off", "bf16", "int8"):
+            raise ValueError(
+                f"shadow_wire must be off|bf16|int8, got {self.shadow_wire!r}"
+            )
+        if self.shadow_round not in ("nearest", "stochastic"):
+            raise ValueError(
+                f"shadow_round must be nearest|stochastic, got "
+                f"{self.shadow_round!r}"
+            )
+        if self.shadow_block < 1:
+            raise ValueError(
+                f"shadow_block must be >= 1, got {self.shadow_block}"
+            )
+        if ((self.numerics_watch == "on" or self.shadow_wire != "off")
+                and self.approach not in ("cyclic", "maj_vote", "approx")):
+            # the observatory measures the CODED wire (encode → decode);
+            # the baseline path ships raw rows, emits no optional metric
+            # columns at all (no exactness certificate), and has no decode
+            # to shadow — keeping it column-free preserves the PR 4
+            # "baseline emits nothing" invariant
+            raise ValueError(
+                "numerics_watch/shadow_wire require a coded approach "
+                f"(cyclic|maj_vote|approx), got {self.approach!r}"
             )
         if self.step_guard not in ("off", "on"):
             raise ValueError(

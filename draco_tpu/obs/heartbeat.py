@@ -53,7 +53,17 @@ from draco_tpu.obs.forensics import AccusationLedger
 #      block, and ``num_workers``). The ``device`` block (ISSUE 9 — last
 #      profiled window's phase fractions / decode share) is ADDITIVE under
 #      schema 2: consumers tolerate it missing, assert it when present.
-STATUS_SCHEMA = 2
+#   3: the numerics observatory (ISSUE 10): a static ``wire`` block (the
+#      logical worker→aggregator bytes ledger, obs/numerics.wire_ledger,
+#      set once per run via :meth:`RunHeartbeat.set_wire` — BOTH
+#      production loops stamp it on every run, watch or not, since the
+#      ledger is derived from shapes alone) and a folded ``numerics``
+#      block (last dynamic-range values + worst-case underflow/overflow
+#      fractions + shadow-wire error/agreement extremes +
+#      ``shadow_sentinel_steps``, the count of fault-poisoned shadow
+#      comparisons), which appears only on watch-enabled runs. Consumers
+#      tolerate either block missing, assert shape when present.
+STATUS_SCHEMA = 3
 
 # per-step detection-count columns (in-graph, coding/cyclic.py +
 # coding/repetition.py): tp = flagged ∧ adversarial ∧ present,
@@ -67,6 +77,16 @@ _FLAGGED_KEYS = ("located_errors", "det_flagged")
 _LAST_KEYS = ("decode_residual", "vote_agree", "flagged_groups",
               "honest_located", "decode_residual_bound",
               "recovered_fraction")
+
+# numerics-observatory fold (obs/numerics.py, ISSUE 10): last-value range
+# stats, running maxima of the danger fractions and shadow errors, running
+# minimum of the shadow flag agreement — the ``numerics`` status block
+_NX_LAST = ("nx_grad_absmax", "nx_grad_rms", "nx_wire_absmax",
+            "nx_wire_rms", "nx_agg_absmax", "nx_agg_rms")
+_NX_MAX = ("nx_wire_uf_bf16", "nx_wire_uf_int8", "nx_wire_of_bf16",
+           "nx_grad_nonfinite", "nx_wire_nonfinite", "shadow_err",
+           "shadow_residual")
+_NX_MIN = ("shadow_flag_agree",)
 
 
 class RunHeartbeat:
@@ -90,6 +110,11 @@ class RunHeartbeat:
         self._skipped_steps = 0.0
         self._guard_seen = False  # any record carried guard columns
         self._last: dict = {}
+        # numerics-observatory fold (ISSUE 10): the ``numerics`` status
+        # block accumulated from the nx_*/shadow_* columns, plus the
+        # static ``wire`` ledger the loops stamp once (set_wire)
+        self._nx: dict = {}
+        self._wire: Optional[dict] = None
         # last profiled window's device block (obs/device_attr.py, ISSUE 9)
         # — set by observe_device, wired as the profiler window's on_stop
         # hook; rides every subsequent beat
@@ -138,9 +163,48 @@ class RunHeartbeat:
             self._guard_trips += float(record["guard_trips"])
             self._skipped_steps += float(record.get("skipped_steps", 0.0))
             self._guard_seen = True
+        # numerics observatory (ISSUE 10): fold whatever nx_/shadow_
+        # columns the record carries — last values for the range stats,
+        # running max for the danger fractions / shadow errors, running
+        # min for the flag agreement
+        for k in _NX_LAST:
+            if k in record:
+                self._nx[k] = float(record[k])
+        # a shadow column at the -1.0 NaN sentinel (numerics.
+        # SHADOW_SENTINEL) marks a fault-poisoned comparison: it must stay
+        # VISIBLE at the roll-up, not vanish under max() — count the step
+        # once and exclude sentinel values from the extreme folds
+        if any(k in record and float(record[k]) < 0.0
+               for k in _NX_MAX + _NX_MIN if k.startswith("shadow_")):
+            self._nx["shadow_sentinel_steps"] = \
+                self._nx.get("shadow_sentinel_steps", 0) + 1
+        for k in _NX_MAX:
+            if k in record:
+                v = float(record[k])
+                if k.startswith("shadow_") and v < 0.0:
+                    continue
+                key = f"{k}_max"
+                self._nx[key] = max(self._nx.get(key, float("-inf")), v)
+        for k in _NX_MIN:
+            if k in record:
+                v = float(record[k])
+                if v < 0.0:
+                    continue
+                key = f"{k}_min"
+                self._nx[key] = min(self._nx.get(key, float("inf")), v)
         if self.ledger is not None:
             self.ledger.observe(record)
         self._last = record
+
+    def set_wire(self, ledger: Optional[dict]) -> None:
+        """Stamp the run's static logical wire-bytes ledger
+        (obs/numerics.wire_ledger) — the ``wire`` status block. Called once
+        by both production loops right after setup, when the program's
+        flat-gradient dimension is known. None (or a disabled heartbeat)
+        is a no-op."""
+        if self.path is None or ledger is None:
+            return
+        self._wire = dict(ledger)
 
     def observe_device(self, profile_dir: str) -> None:
         """Fold the just-stopped profiler capture into the ``device`` status
@@ -218,6 +282,12 @@ class RunHeartbeat:
             # per-worker forensics (obs/forensics.AccusationLedger):
             # top suspects, trust vector, episode counts
             payload["forensics"] = self.ledger.summary()
+        if self._wire is not None:
+            # static logical wire-bytes ledger (ISSUE 10, set_wire)
+            payload["wire"] = self._wire
+        if self._nx:
+            # folded numerics-observatory block (ISSUE 10)
+            payload["numerics"] = dict(self._nx)
         if self._device is not None:
             # last profiled window's device-time attribution (ISSUE 9);
             # consumers tolerate the key missing, assert it when present

@@ -29,7 +29,8 @@ def build_code_from_cfg(cfg):
     return None
 
 
-def approx_aggregate(code, grads: jnp.ndarray, present=None, constrain=None):
+def approx_aggregate(code, grads: jnp.ndarray, present=None, constrain=None,
+                     cfg=None, adv_mask=None, step=None):
     """The approx family's whole aggregation sequence — ingest forensics →
     weighted-partial-sum encode → present mask → optimal-decoding partial
     recovery → residual-vs-bound health — in ONE place, shared by the CNN
@@ -40,7 +41,14 @@ def approx_aggregate(code, grads: jnp.ndarray, present=None, constrain=None):
     this family (no Byzantine certificate); stragglers are the fault model
     and the only per-worker accusation signal is the non-finite ingest
     check. ``constrain``: optional sharding-constraint hook applied to the
-    encoded (n, d) rows (the CNN path pins them to the worker axis)."""
+    encoded (n, d) rows (the CNN path pins them to the worker axis).
+
+    ``cfg``/``adv_mask``/``step`` (optional, passed by both call sites):
+    enable the numerics observatory (obs/numerics.py, ISSUE 10) — dynamic-
+    range columns for grads/wire/aggregate and the shadow-quantized decode
+    — stashed under ``health["watch"]`` for ``decode_health_metrics`` to
+    merge into the metric row. Identity (no added ops) when the watch is
+    off."""
     from draco_tpu.obs import forensics as forensics_mod
 
     bad_rows = forensics_mod.nonfinite_rows(grads)
@@ -56,6 +64,20 @@ def approx_aggregate(code, grads: jnp.ndarray, present=None, constrain=None):
             code, rows, present=present, with_health=True,
             batch_grads=grads)
     health["bad_rows"] = bad_rows
+    if cfg is not None:
+        from draco_tpu.obs import numerics as numerics_mod
+
+        if numerics_mod.watch_enabled(cfg):
+            watch = {}
+            if cfg.numerics_watch == "on":
+                watch.update(numerics_mod.numerics_columns(
+                    cfg, [grads], [rows], agg))
+            if cfg.shadow_wire != "off":
+                amask = (jnp.zeros((code.n,), bool) if adv_mask is None
+                         else adv_mask)
+                watch.update(numerics_mod.approx_shadow(
+                    cfg, code, rows, grads, agg, present, amask, step))
+            health["watch"] = watch
     return agg, health
 
 
@@ -99,7 +121,8 @@ def aggregate_flat_grads(grads: jnp.ndarray, adv_mask, cfg, code, rand_factor,
     if cfg.approach == "approx":
         # approximate family (coding/approx.py; ISSUE 8): the shared
         # sequence above — health is the residual-vs-bound certificate
-        return approx_aggregate(code, grads, present=present)
+        return approx_aggregate(code, grads, present=present, cfg=cfg,
+                                adv_mask=adv_mask, step=step)
     if cfg.approach == "cyclic":
         # ingest-row health, BEFORE encode: a non-finite per-worker gradient
         # row attributes to its worker here, where row k still means worker
@@ -139,6 +162,22 @@ def aggregate_flat_grads(grads: jnp.ndarray, adv_mask, cfg, code, rand_factor,
                     code, enc_re, enc_im, rand_factor, present=present,
                     with_health=True)
         health["bad_rows"] = bad_rows
+        from draco_tpu.obs import numerics as numerics_mod
+
+        if numerics_mod.watch_enabled(cfg):
+            # numerics observatory (obs/numerics.py, ISSUE 10): dynamic-
+            # range columns + the shadow-quantized decode, stashed under
+            # health["watch"] for decode_health_metrics to merge — the f32
+            # decode above alone feeds the update
+            watch = {}
+            if cfg.numerics_watch == "on":
+                watch.update(numerics_mod.numerics_columns(
+                    cfg, [grads], [enc_re, enc_im], agg))
+            if cfg.shadow_wire != "off":
+                watch.update(numerics_mod.cyclic_shadow(
+                    cfg, code, enc_re, enc_im, agg, health, rand_factor,
+                    leaf_offsets, present, adv_mask, step))
+            health["watch"] = watch
         return agg, health
     with jax.named_scope("draco_decode"):
         grads = attacks.inject_plain(grads, adv_mask, cfg.err_mode,
@@ -240,27 +279,45 @@ APPROX_HEALTH_NAMES = ("decode_residual", "decode_residual_bound",
                        "recovered_fraction")
 
 
+def metric_family_names(cfg) -> tuple:
+    """The OPTIONAL column families a route's metric schema appends after
+    its base columns, declared once for every consumer (ISSUE 10 satellite):
+    the CNN path's ``metric_names`` (training/step.py) and every LM route's
+    ``token_metric_names`` below both call this, so a new column family —
+    decode health, packed forensics masks, the numerics observatory, guard
+    columns, whatever comes next — is declared HERE once and both loops'
+    step bodies and host flushes agree on the order by construction.
+
+    Family order: per-approach health columns → packed forensics masks →
+    numerics/shadow observatory columns (cfg.numerics_watch /
+    cfg.shadow_wire, obs/numerics.py) → guard columns. The baseline
+    approach contributes nothing before the guard block — no exactness
+    certificate, no accusation set, no coded wire (the PR 4 invariant)."""
+    from draco_tpu.obs import numerics as numerics_mod
+    from draco_tpu.obs.forensics import mask_metric_names
+
+    names = ()
+    if cfg.approach == "cyclic":
+        names += DECODE_HEALTH_NAMES + mask_metric_names(cfg.num_workers)
+    elif cfg.approach == "approx":
+        names += APPROX_HEALTH_NAMES + mask_metric_names(cfg.num_workers)
+    elif cfg.approach == "maj_vote":
+        names += ("vote_agree", "flagged_groups", "det_flagged", "det_tp",
+                  "det_adv") + mask_metric_names(cfg.num_workers)
+    names += numerics_mod.watch_metric_names(cfg)
+    if cfg.step_guard == "on":
+        names += GUARD_METRIC_NAMES
+    return names
+
+
 def token_metric_names(cfg) -> tuple:
     """Column order of the (K, m) metric block for an LM route at ``cfg``
     — every route builder stores this on its setup so the shared token
-    loop flushes the right schema. Coded routes additionally carry the
-    packed per-worker forensics masks (obs/forensics.mask_metric_names:
-    accused / present / seeded-adversary bitmask words riding the same
-    block); baseline routes emit neither health nor forensics columns."""
-    names = TOKEN_METRIC_NAMES
-    if cfg.approach == "cyclic":
-        from draco_tpu.obs.forensics import mask_metric_names
-
-        names = names + DECODE_HEALTH_NAMES \
-            + mask_metric_names(cfg.num_workers)
-    elif cfg.approach == "approx":
-        from draco_tpu.obs.forensics import mask_metric_names
-
-        names = names + APPROX_HEALTH_NAMES \
-            + mask_metric_names(cfg.num_workers)
-    if cfg.step_guard == "on":
-        names = names + GUARD_METRIC_NAMES
-    return names
+    loop flushes the right schema. The optional families (health masks /
+    forensics / numerics / guard) come from the one shared assembly
+    (:func:`metric_family_names`); baseline routes emit only the base
+    columns."""
+    return TOKEN_METRIC_NAMES + metric_family_names(cfg)
 
 
 def accusation_mask(health, present=None):
@@ -304,6 +361,9 @@ def decode_health_metrics(health, adv_mask, present) -> dict:
 
     if health is None:
         return {}
+    # numerics-observatory columns (obs/numerics.py, ISSUE 10) stashed by
+    # the aggregation tails — already final column-name -> scalar pairs
+    watch = health.pop("watch", {})
     if "bound" in health:
         # approx family (APPROX_HEALTH_NAMES docstring): the certificate is
         # residual ≤ bound, there is no located-error set — the packed
@@ -317,6 +377,7 @@ def decode_health_metrics(health, adv_mask, present) -> dict:
         }
         out.update(forensics_mod.pack_mask_columns(
             accusation_mask(health, present), present, adv_mask))
+        out.update(watch)
         return out
     det = _detection_metrics(health["flagged"], adv_mask, present)
     out = {
@@ -327,6 +388,7 @@ def decode_health_metrics(health, adv_mask, present) -> dict:
     }
     out.update(forensics_mod.pack_mask_columns(
         accusation_mask(health, present), present, adv_mask))
+    out.update(watch)
     return out
 
 

@@ -341,17 +341,22 @@ def lint_programs():
     started resharding the ring, exactly the drift the budget exists to
     catch."""
     from draco_tpu.analysis.registry import (
-        LintProgram, Manifest, built_token_program, ci_lm_config,
+        BF16_DTYPES, LintProgram, Manifest, built_token_program,
+        ci_lm_config,
     )
     from draco_tpu.parallel.mesh import make_mesh_2d
 
     manifest = Manifest(collectives=LINT_COLLECTIVES)
+    # the shadow-watch program's bf16 rounds are whitelisted converts;
+    # everything else in its manifest matches the ring budget exactly
+    manifest_bf16 = Manifest(collectives=LINT_COLLECTIVES,
+                             allowed_dtypes=BF16_DTYPES)
 
-    def _build(name, many, **overrides):
+    def _build(name, many, mf=None, **overrides):
         cfg = ci_lm_config(seq_shards=2, **overrides)
         mesh = make_mesh_2d(4, 2)  # 8 CI devices; n=8 folds 2 lanes/device
         setup = build_sp_train_setup(cfg, mesh)
-        return built_token_program(name, cfg, mesh, setup, manifest,
+        return built_token_program(name, cfg, mesh, setup, mf or manifest,
                                    many=many)
 
     return [
@@ -373,6 +378,17 @@ def lint_programs():
                     build=lambda: _build("lm_sp_ring_approx_many_k2", True,
                                          approach="approx", worker_fail=0,
                                          code_redundancy=1.5,
+                                         step_guard="on")),
+        # shadow-watch production program (obs/numerics.py, ISSUE 10): the
+        # numerics columns + bf16 shadow decode ride the shared flat-grad
+        # tail — the ring's explicit-collective budget and donation must
+        # not move (the shadow is reductions + a second GSPMD decode of
+        # already-gathered rows, never a shard_map collective)
+        LintProgram("lm_sp_ring_shadow_many_k2", route="sp",
+                    build=lambda: _build("lm_sp_ring_shadow_many_k2", True,
+                                         mf=manifest_bf16,
+                                         numerics_watch="on",
+                                         shadow_wire="bf16",
                                          step_guard="on")),
     ]
 

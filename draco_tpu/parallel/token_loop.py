@@ -180,6 +180,11 @@ def run_token_loop(setup, cfg: TrainConfig, steps: Optional[int] = None,
     # (obs/forensics.AccusationLedger), fed by the same observer hook
     heartbeat = RunHeartbeat(cfg.train_dir or None, enabled=is_main,
                              num_workers=cfg.num_workers)
+    # static logical wire-bytes ledger (obs/numerics.wire_ledger, ISSUE
+    # 10): the ``wire`` status block, from the route's flat-grad dimension
+    from draco_tpu.obs import numerics as numerics_mod
+
+    heartbeat.set_wire(numerics_mod.wire_ledger(cfg, setup.dim))
     compile_watch = make_compile_watch(cfg, tracer, is_main)
     eval_toks = None
     if cfg.eval_freq:

@@ -42,6 +42,7 @@ from draco_tpu.coding import repetition as rep_mod
 from draco_tpu.data import augment as augment_mod
 from draco_tpu.models import build_model, input_shape
 from draco_tpu.obs import forensics as forensics_mod
+from draco_tpu.obs import numerics as numerics_mod
 from draco_tpu.resilience import faults as faults_mod
 from draco_tpu.runtime import WORKER_AXIS
 
@@ -321,6 +322,18 @@ def build_train_setup(cfg: TrainConfig, mesh, dataset_name: Optional[str] = None
             out["flagged_groups"] = vhealth["flagged_groups"]
             out.update(_detection_metrics(vhealth["flagged"], adv_mask,
                                           present))
+            # numerics observatory (obs/numerics.py, ISSUE 10): this
+            # family's wire IS the raw gradient rows; the shadow re-votes
+            # over the quantized rows (deterministic rounding preserves
+            # within-group bitwise equality, the vote's soundness condition)
+            if numerics_mod.watch_enabled(cfg):
+                if cfg.numerics_watch == "on":
+                    out.update(numerics_mod.numerics_columns(
+                        cfg, [grads], [grads], voted))
+                if cfg.shadow_wire != "off":
+                    out.update(numerics_mod.majvote_shadow(
+                        cfg, rep_code, grads, voted, vhealth, vkey,
+                        present, adv_mask, state.step))
             # per-worker forensics columns (obs/forensics): the vote's own
             # out-voted set ∪ non-finite ingest rows, packed with the
             # present + seeded-adversary masks to ride the metric block
@@ -360,7 +373,8 @@ def build_train_setup(cfg: TrainConfig, mesh, dataset_name: Optional[str] = None
             decoded, health = approx_aggregate(
                 code, grads, present=present,
                 constrain=lambda r: jax.lax.with_sharding_constraint(
-                    r, shard_w))
+                    r, shard_w),
+                cfg=cfg, adv_mask=adv_mask, step=state.step)
             new_state = apply_update(state, decoded, new_stats)
             out = _metrics(losses, precs, present)
             # residual-vs-bound health + packed forensics masks (accused =
@@ -396,9 +410,15 @@ def build_train_setup(cfg: TrainConfig, mesh, dataset_name: Optional[str] = None
                 # ingest-row forensics: attribute non-finite rows BEFORE the
                 # algebraic encode smears them (forensics.nonfinite_rows)
                 bad_rows = forensics_mod.nonfinite_rows(grads)
+                # grad-stage numerics (obs/numerics.py): computed here,
+                # where the pre-encode rows still exist
+                grad_watch = (numerics_mod.stage_columns(
+                    "grad", [grads], cfg.shadow_block)
+                    if cfg.numerics_watch == "on" else {})
                 with jax.named_scope("draco_encode"):
                     enc_re, enc_im = cyclic_mod.encode_shared(code, grads)
-                return enc_re, enc_im, new_stats, losses, precs, bad_rows
+                return (enc_re, enc_im, new_stats, losses, precs, bad_rows,
+                        grad_watch)
 
         else:  # "simulate": the reference's true r× redundant compute
 
@@ -431,6 +451,9 @@ def build_train_setup(cfg: TrainConfig, mesh, dataset_name: Optional[str] = None
                 # ingest-row forensics: any non-finite value in worker i's
                 # hat_s redundant lanes attributes to worker i
                 bad_rows = forensics_mod.nonfinite_rows(grads)
+                grad_watch = (numerics_mod.stage_columns(
+                    "grad", [grads], cfg.shadow_block)
+                    if cfg.numerics_watch == "on" else {})
                 with jax.named_scope("draco_encode"):
                     enc_re, enc_im = cyclic_mod.encode(code, grads)
                 # fold the per-sub-batch stats back to one per worker
@@ -440,11 +463,11 @@ def build_train_setup(cfg: TrainConfig, mesh, dataset_name: Optional[str] = None
                     else None
                 )
                 return (enc_re, enc_im, new_stats, jnp.mean(losses, 1),
-                        jnp.mean(precs, 1), bad_rows)
+                        jnp.mean(precs, 1), bad_rows, grad_watch)
 
         def step_body(state: TrainState, x, y, adv_mask, present=None):
-            (enc_re, enc_im, new_stats, losses, precs,
-             bad_rows) = compute_encoded(state, x, y)
+            (enc_re, enc_im, new_stats, losses, precs, bad_rows,
+             grad_watch) = compute_encoded(state, x, y)
             with jax.named_scope("draco_encode"):
                 enc_re, enc_im = attacks.inject_cyclic(enc_re, enc_im, adv_mask,
                                                        cfg.err_mode, adv_mag)
@@ -487,6 +510,23 @@ def build_train_setup(cfg: TrainConfig, mesh, dataset_name: Optional[str] = None
             from draco_tpu.parallel.common import decode_health_metrics
 
             health["bad_rows"] = bad_rows
+            # numerics observatory (obs/numerics.py, ISSUE 10): wire/agg
+            # stages + the shadow-quantized decode join the grad-stage
+            # columns from compute_encoded; decode_health_metrics merges
+            # the stash — the f32 decode above alone feeds the update
+            if numerics_mod.watch_enabled(cfg):
+                watch = dict(grad_watch)
+                if cfg.numerics_watch == "on":
+                    watch.update(numerics_mod.stage_columns(
+                        "wire", [enc_re, enc_im], cfg.shadow_block))
+                    watch.update(numerics_mod.stage_columns(
+                        "agg", [decoded], cfg.shadow_block))
+                if cfg.shadow_wire != "off":
+                    watch.update(numerics_mod.cyclic_shadow(
+                        cfg, code, enc_re, enc_im, decoded, health,
+                        rand_factor, leaf_offsets, present, adv_mask,
+                        state.step))
+                health["watch"] = watch
             out.update(decode_health_metrics(health, adv_mask, present))
             # guard signals: finite decode + loud residual + located rows
             # beyond the locator budget (the beyond-budget fault class)
@@ -525,32 +565,19 @@ def build_train_setup(cfg: TrainConfig, mesh, dataset_name: Optional[str] = None
     # fetches once per chunk. The chunk length K is the operands' leading
     # dim, so one program per distinct chunk size (the trainer's main K and
     # its remainder chunks), not per call.
-    # decode-health telemetry columns ride the same block (ISSUE 4): the
-    # per-step values are in-graph scalars, so the chunked regime ships
-    # them for free in the one existing per-flush fetch. The cyclic column
-    # set is the LM routes' (one schema source: common.DECODE_HEALTH_NAMES)
-    from draco_tpu.parallel.common import (APPROX_HEALTH_NAMES,
-                                           DECODE_HEALTH_NAMES)
+    # decode-health / forensics / numerics / guard telemetry columns ride
+    # the same block (ISSUES 4/7/10): the per-step values are in-graph
+    # scalars, so the chunked regime ships them for free in the one
+    # existing per-flush fetch. The optional families come from the ONE
+    # shared assembly (parallel/common.metric_family_names) so this path
+    # and every LM route declare each family exactly once; only the
+    # CNN-specific base columns (prec1, cyclic honest_located) live here.
+    from draco_tpu.parallel.common import metric_family_names
 
     metric_names = ("loss", "prec1")
-    # coded approaches append the packed per-worker forensics masks
-    # (obs/forensics.mask_metric_names); baseline emits no columns at all —
-    # no exactness certificate, no accusation set
     if cfg.approach == "cyclic":
-        metric_names += (("honest_located",) + DECODE_HEALTH_NAMES
-                         + forensics_mod.mask_metric_names(n))
-    elif cfg.approach == "approx":
-        metric_names += (APPROX_HEALTH_NAMES
-                         + forensics_mod.mask_metric_names(n))
-    elif cfg.approach == "maj_vote":
-        metric_names += (("vote_agree", "flagged_groups", "det_flagged",
-                          "det_tp", "det_adv")
-                         + forensics_mod.mask_metric_names(n))
-    if cfg.step_guard == "on":
-        # guard columns ride the same (K, m) block (resilience/guards.py)
-        from draco_tpu.resilience.guards import GUARD_METRIC_NAMES
-
-        metric_names += GUARD_METRIC_NAMES
+        metric_names += ("honest_located",)
+    metric_names += metric_family_names(cfg)
 
     def many_body(state: TrainState, xs, ys, masks, presents):
         def body(st, operand):
@@ -597,7 +624,7 @@ def lint_programs():
     would mean a shard_map/ppermute crept into the CNN path.
     """
     from draco_tpu.analysis.registry import (
-        BuiltProgram, LintProgram, Manifest,
+        BF16_DTYPES, DEFAULT_DTYPES, BuiltProgram, LintProgram, Manifest,
     )
 
     def _cfg(**overrides):
@@ -610,7 +637,7 @@ def lint_programs():
         kw.update(overrides)
         return TrainConfig(**kw)
 
-    def _build(name, cfg, many=False, k=2):
+    def _build(name, cfg, many=False, k=2, bf16=False):
         from draco_tpu import rng as drng, runtime
 
         mesh = runtime.make_mesh(cfg.num_workers)
@@ -619,7 +646,11 @@ def lint_programs():
         shape = input_shape(cfg.dataset)
         adv = drng.adversary_schedule(cfg.seed, k + 1, n,
                                      cfg.num_adversaries)
-        manifest = Manifest(collectives={})
+        # the bf16 shadow wire's converts are whitelisted promotion sites;
+        # its programs carry bf16 element types by design (ISSUE 10)
+        manifest = Manifest(collectives={},
+                            allowed_dtypes=(BF16_DTYPES if bf16
+                                            else DEFAULT_DTYPES))
         extra = {"dim": setup.dim, "devices_in_mesh": int(mesh.devices.size)}
         if many:
             args = (setup.state,
@@ -659,4 +690,19 @@ def lint_programs():
            cfg=_cfg(approach="approx", worker_fail=0, redundancy="shared",
                     code_redundancy=1.5, step_guard="on"),
            many=True),
+        # shadow-watch programs (obs/numerics.py, ISSUE 10): the numerics
+        # columns + shadow-quantized decode must keep every invariant —
+        # zero explicit collectives, full state donation, zero host traffic
+        # (reductions + a second decode, never a callback). The bf16 shadow
+        # carries bf16 element types by design (BF16_DTYPES manifest, the
+        # converts are the whitelisted promotion sites); the int8 shadow
+        # stores its levels in f32 (numerics.quantize_rows docstring) and
+        # its stochastic-rounding PRNG is plain ui32 bit generation.
+        mk("cnn_cyclic_many_shadow_k2",
+           cfg=_cfg(numerics_watch="on", shadow_wire="bf16"),
+           many=True, bf16=True),
+        mk("cnn_approx_shadow_int8_step",
+           cfg=_cfg(approach="approx", worker_fail=0, redundancy="shared",
+                    code_redundancy=1.5, numerics_watch="on",
+                    shadow_wire="int8", shadow_round="stochastic")),
     ]

@@ -80,6 +80,13 @@ class Trainer:
         self.heartbeat = RunHeartbeat(cfg.train_dir or None,
                                       enabled=self._is_main,
                                       num_workers=cfg.num_workers)
+        # static logical wire-bytes ledger (obs/numerics.wire_ledger,
+        # ISSUE 10): the ``wire`` status block — derived from the program's
+        # registered shapes, stamped once per run
+        from draco_tpu.obs import numerics as numerics_mod
+
+        self.heartbeat.set_wire(numerics_mod.wire_ledger(cfg,
+                                                         self.setup.dim))
         # compile/retrace sentinel (obs/compile_watch.py): every XLA
         # executable build lands in compiles.jsonl + the trace's compile
         # lane, and a steady-state recompile of a labelled program trips

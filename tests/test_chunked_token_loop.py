@@ -80,10 +80,15 @@ ROUTES = {
         train=lambda cfg, prof=None: train_sp(cfg, make_mesh_2d(4, 2),
                                               quiet=True, profile_dir=prof),
     ),
+    # the cyclic tp route runs with the numerics observatory + bf16 shadow
+    # wire enabled (obs/numerics.py, ISSUE 10): K∈{1,4} equality must hold
+    # with the watch on, and _assert_route_telemetry pins the shadow
+    # columns (flag agreement 1.0, detection preserved under quantization)
     "tp": dict(
         kw=dict(num_workers=9, approach="cyclic", worker_fail=2,
                 adversary_count=1, redundancy="shared",
-                straggle_mode="drop", straggle_count=1),
+                straggle_mode="drop", straggle_count=1,
+                numerics_watch="on", shadow_wire="bf16"),
         train=lambda cfg, prof=None: train_tp(cfg, make_folded_wtp_mesh(9),
                                               quiet=True, profile_dir=prof),
     ),
@@ -91,11 +96,15 @@ ROUTES = {
     # adversary (validate rejects one), two seeded drops per step inside
     # the ⌈αn⌉ = 2 budget — the per-record residual-vs-bound certificate
     # and absent≠accused are asserted in _assert_route_telemetry
+    # the approx route carries the watch too (numerics + bf16 shadow on
+    # the optimal-decoding family's wire) — its exact-code counterpart is
+    # the tp cell above, so both observatory families are pinned on this
+    # loop
     "approx": dict(
         kw=dict(num_workers=8, approach="approx", worker_fail=0,
                 redundancy="shared", code_redundancy=1.5,
                 straggler_alpha=0.25, straggle_mode="drop",
-                straggle_count=2),
+                straggle_count=2, numerics_watch="on", shadow_wire="bf16"),
         train=lambda cfg, prof=None: train_sp(cfg, make_mesh_2d(8, 1),
                                               quiet=True, profile_dir=prof),
     ),
@@ -161,6 +170,15 @@ def _assert_route_telemetry(route, kw, run_dir):
             assert r["det_tp"] == want  # recall = 1.0
             assert r["located_errors"] == want  # precision = 1.0
             assert r["decode_residual"] < 1e-3
+            # numerics observatory + bf16 shadow (ISSUE 10): finite range
+            # stats, flag agreement exactly 1.0, detection P/R preserved
+            # under quantization — on the REAL folded w×tp GSPMD mesh
+            assert r["nx_wire_absmax"] > 0 and r["nx_wire_rms"] > 0
+            assert r["nx_grad_nonfinite"] == 0.0
+            assert r["shadow_flag_agree"] == 1.0, r
+            assert 0.0 <= r["shadow_err"] < 0.05, r
+            assert r["shadow_det_flagged"] == want
+            assert r["shadow_det_tp"] == want
             # per-worker attribution exact (packed forensics masks, ISSUE
             # 7): accused == adversarial ∧ present, bit for bit — an
             # absent worker is never an accused worker
@@ -178,7 +196,7 @@ def _assert_route_telemetry(route, kw, run_dir):
         fxb = status["forensics"]
         assert fxb["num_workers"] == n and fxb["accused_total"] > 0
         assert fxb["top_suspects"]
-        assert status["schema"] == 2
+        assert status["schema"] == 3
     elif kw.get("approach") == "approx":
         from draco_tpu.obs import forensics as fx
 
@@ -191,6 +209,12 @@ def _assert_route_telemetry(route, kw, run_dir):
                 r["decode_residual_bound"] + 1e-5, r
             assert 0.0 < r["recovered_fraction"] <= 1.0
             assert "det_tp" not in r and "located_errors" not in r
+            # watch columns on this family too (ISSUE 10): shadow flag
+            # surface is the non-finite wire rows — empty on a clean run
+            assert r["nx_wire_absmax"] > 0
+            assert r["shadow_flag_agree"] == 1.0 and \
+                r["shadow_det_flagged"] == 0.0
+            assert 0.0 <= r["shadow_err"] < 0.05, r
             masks = fx.record_masks(r, n)
             assert masks is not None, r
             assert masks["present"] == tuple(~strag[r["step"]])
@@ -206,7 +230,7 @@ def _assert_route_telemetry(route, kw, run_dir):
         fxb = status["forensics"]
         assert fxb["accused_total"] == 0 and fxb["episodes_total"] == 0
         assert fxb["trust"] == [1.0] * n
-        assert status["schema"] == 2
+        assert status["schema"] == 3
     else:
         assert all("det_tp" not in r for r in train)
         assert all("wmask_accused0" not in r for r in train)

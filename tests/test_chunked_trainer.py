@@ -87,16 +87,27 @@ def metric_stream(train_dir):
 # chunked vs eager equivalence — all three approaches, adversary + stragglers
 # --------------------------------------------------------------------------
 
+# the exact coded approaches run with the numerics observatory AND the
+# bf16 shadow wire enabled suite-wide (obs/numerics.py, ISSUE 10): the
+# watch must not perturb the f32 path — these very tests pin K∈{1,4}
+# bitwise equality with it on — and _assert_decode_health pins the shadow
+# columns (flag agreement 1.0, detection preserved under quantization)
+# per record. baseline stays watch-free (no coded wire, no optional
+# columns — PR 4); the approx family's watch coverage lives in the LM
+# suite's tp/approx wire-study cells + tools/wire_study.py, keeping this
+# suite's compile bill inside the tier-1 budget.
+_WATCH = dict(numerics_watch="on", shadow_wire="bf16")
+
 APPROACHES = {
     # n=9 so the cyclic joint budget t + e <= s holds with a LIVE adversary
     # and a straggler drop in the same run (s=2, t=1, e=1, n > 4s)
     "cyclic": dict(approach="cyclic", num_workers=9, worker_fail=2,
                    adversary_count=1, err_mode="rev_grad",
                    straggle_mode="drop", straggle_count=1,
-                   redundancy="shared"),
+                   redundancy="shared", **_WATCH),
     "maj_vote": dict(approach="maj_vote", group_size=4, worker_fail=1,
                      err_mode="rev_grad", straggle_mode="drop",
-                     straggle_count=1),
+                     straggle_count=1, **_WATCH),
     "baseline": dict(approach="baseline", mode="geometric_median",
                      worker_fail=1, err_mode="rev_grad",
                      straggle_mode="drop", straggle_count=1),
@@ -199,7 +210,21 @@ def _assert_decode_health(approach, stream, kw):
         if approach == "baseline":
             assert "det_tp" not in vals and "decode_residual" not in vals
             assert "wmask_accused0" not in vals
+            assert "nx_wire_absmax" not in vals and "shadow_err" not in vals
             continue
+        # numerics observatory + bf16 shadow wire (obs/numerics.py, ISSUE
+        # 10) on the watch-enabled approaches: range stats sane and
+        # finite, and quantization changes NO accusation — flag agreement
+        # exactly 1.0 on every step, end-to-end shadow error at bf16
+        # rounding scale
+        if kw.get("shadow_wire"):
+            assert vals["nx_wire_absmax"] > 0 and vals["nx_wire_rms"] > 0
+            for stage in ("grad", "wire", "agg"):
+                assert vals[f"nx_{stage}_nonfinite"] == 0.0, (step, stage)
+                assert 0.0 <= vals[f"nx_{stage}_uf_int8"] <= 1.0
+                assert 0.0 <= vals[f"nx_{stage}_of_bf16"] <= 1.0
+            assert vals["shadow_flag_agree"] == 1.0, (step, vals)
+            assert 0.0 <= vals["shadow_err"] < 0.05, (step, vals)
         if approach == "approx":
             # the residual-vs-bound certificate per record (ISSUE 8): the
             # measured decode error never exceeds the arrived support's
@@ -226,6 +251,10 @@ def _assert_decode_health(approach, stream, kw):
         assert vals["det_adv"] == want, (step, vals)
         assert vals["det_tp"] == want  # recall = 1.0
         assert vals[flag_col[approach]] == want  # precision = 1.0
+        # detection P/R == 1.0 PRESERVED under the bf16 shadow (the ISSUE
+        # 10 acceptance pin): the shadow flag set scores identically
+        assert vals["shadow_det_flagged"] == want, (step, vals)
+        assert vals["shadow_det_tp"] == want
         masks = fx.record_masks(vals, n)
         assert masks is not None, (step, vals)
         assert masks["adv"] == tuple(adv[step]), step
@@ -278,7 +307,17 @@ def _assert_telemetry_artifacts(run_dir, approach):
     assert not any(r["steady_recompile"] for r in ledger)
     compile_events = [e for e in events if e.get("cat") == "compile"]
     assert len(compile_events) == len(ledger) == status["compiles"]
+    # the static wire-bytes ledger (ISSUE 10) rides every status payload;
+    # the folded numerics block only on watch-enabled runs (the coded
+    # approaches here — baseline runs watch-free)
+    wire = status["wire"]
+    assert wire["family"] == APPROACHES[approach]["approach"]
+    assert wire["bytes_per_worker"]["f32"] == \
+        (2 if approach == "cyclic" else 1) * 4 * wire["dim"]
+    assert wire["bytes_per_worker"]["bf16"] * 2 == \
+        wire["bytes_per_worker"]["f32"]
     if approach == "baseline":
+        assert "numerics" not in status
         assert "decode_health" not in status
         assert "forensics" not in status
     elif approach == "approx":
@@ -294,7 +333,7 @@ def _assert_telemetry_artifacts(run_dir, approach):
         assert fxb["accused_total"] == 0 and fxb["episodes_total"] == 0
         assert fxb["top_suspects"] == []
         assert fxb["trust"] == [1.0] * 8
-        assert status["schema"] == 2
+        assert status["schema"] == 3
     else:
         health = status["decode_health"]
         assert health["precision"] == 1.0 and health["recall"] == 1.0
@@ -306,7 +345,13 @@ def _assert_telemetry_artifacts(run_dir, approach):
         assert fxb["accused_total"] > 0 and fxb["episodes_total"] > 0
         assert fxb["top_suspects"] and all(
             t["trust"] < 1.0 for t in fxb["top_suspects"])
-        assert status["schema"] == 2
+        assert status["schema"] == 3
+        # the folded numerics block (ISSUE 10): worst-case shadow error
+        # bounded, flag agreement never dipped below 1.0
+        nx = status["numerics"]
+        assert nx["shadow_flag_agree_min"] == 1.0
+        assert 0.0 <= nx["shadow_err_max"] < 0.05
+        assert nx["nx_wire_absmax"] > 0 and nx["nx_grad_nonfinite_max"] == 0.0
     # the profiled window's device block (ISSUE 9): the capture + anchor
     # landed and the heartbeat folded the per-phase attribution — a plain
     # --profile-dir run has no scope map, so the honest state is all time

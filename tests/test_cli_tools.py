@@ -809,3 +809,194 @@ def test_perf_watch_gates_on_flipped_device_metrics(tmp_path):
     assert perf_watch.main(["--root", str(root), "--json", str(out)]) == 1
     regs = {r["metric"] for r in json.loads(out.read_text())["regressions"]}
     assert "device.control_extra_all_gather.tripped" in regs
+
+
+@pytest.mark.slow
+def test_wire_study_tool(tmp_path):
+    """tools/wire_study.py smoke (ISSUE 10; slow-marked — the live-cell
+    behavior is already pinned in tier 1 by the watch-enabled K∈{1,4}
+    equivalence suites, and the committed artifact by --check +
+    check_artifacts + the perf_watch flipped-row gates): a cyclic bf16
+    cell runs the shadow-quantized wire with a LIVE adversary, and
+    detection survives quantization — flag agreement 1.0, shadow P/R 1.0,
+    bounded end-to-end error, and the logical bytes ledger at the
+    program's real dimension."""
+    import json
+
+    from tools import wire_study
+
+    out = tmp_path / "wire.json"
+    rc = wire_study.main([
+        "--out", str(out), "--cpu-mesh", "8", "--families", "cyclic",
+        "--dtypes", "bf16", "--ks", "1", "--max-steps", "6",
+    ])
+    rep = json.loads(out.read_text())
+    assert rc == 0 and rep["all_ok"]
+    row = rep["rows"][0]
+    assert row["family"] == "cyclic" and row["dtype"] == "bf16"
+    assert row["steps"] == 6
+    assert row["det_preserved"]
+    assert row["shadow_flag_agree_min"] == 1.0
+    assert row["det_precision_shadow"] == 1.0
+    assert row["det_recall_shadow"] == 1.0
+    assert row["adv_total"] > 0  # the adversary was really live
+    assert 0.0 <= row["shadow_err_max"] < 0.05
+    assert row["guard_trips_total"] == 0.0
+    per = row["wire"]["bytes_per_worker"]
+    assert per["bf16"] * 2 == per["f32"] and per["int8"] < per["bf16"]
+
+
+def test_wire_study_check_names_failures(tmp_path):
+    """--check (jax-free) trips on a stale ledger, a lost bf16 detection
+    pin, and a false all_ok — naming the cell."""
+    import json
+
+    from tools import wire_study
+
+    committed = os.path.join(REPO, "baselines_out", "wire_study.json")
+    data = json.load(open(committed))
+    assert wire_study.main(["--check", "--artifact", committed]) == 0
+
+    bad = tmp_path / "wire_study.json"
+    # ledger bytes inconsistent with dim
+    d2 = json.loads(json.dumps(data))
+    d2["rows"][0]["wire"]["bytes_per_worker"]["f32"] += 4
+    bad.write_text(json.dumps(d2))
+    assert wire_study.main(["--check", "--artifact", str(bad)]) == 1
+
+    # a bf16 row losing detection must fail even if its ok flag lies
+    d2 = json.loads(json.dumps(data))
+    row = next(r for r in d2["rows"] if r["dtype"] == "bf16")
+    row["det_preserved"] = False
+    bad.write_text(json.dumps(d2))
+    assert wire_study.main(["--check", "--artifact", str(bad)]) == 1
+
+    d2 = json.loads(json.dumps(data))
+    d2["all_ok"] = False
+    bad.write_text(json.dumps(d2))
+    assert wire_study.main(["--check", "--artifact", str(bad)]) == 1
+
+
+def test_perf_watch_gates_on_flipped_wire_metrics(tmp_path):
+    """The wire-study fold (ISSUE 10): shadow residual / flag agreement
+    are PINNED at tolerance 0 — a flipped row gates in BOTH directions
+    (the live flipped-row control of the acceptance criteria) — and a
+    det_preserved flip or shadow-recall drop gates as 0-tolerance ok."""
+    import json
+
+    from tools import perf_watch
+
+    root = tmp_path
+    (root / "baselines_out").mkdir()
+
+    def artifact(residual=0.0001, agree=1.0, preserved=True, recall=1.0):
+        row = {"family": "cyclic", "dtype": "bf16", "k": 4,
+               "shadow_err_max": 0.005, "shadow_residual_max": residual,
+               "shadow_flag_agree_min": agree, "det_preserved": preserved,
+               "det_precision_shadow": 1.0, "det_recall_shadow": recall,
+               "wire": {"bytes_per_worker": {"f32": 800, "bf16": 400,
+                                             "int8": 214}},
+               "ok": True}
+        return {"all_ok": True, "rows": [row]}
+
+    path = root / "baselines_out" / "wire_study.json"
+    path.write_text(json.dumps(artifact()))
+    assert perf_watch.main(["--root", str(root), "--snapshot"]) == 0
+    snap = json.loads(
+        (root / "baselines_out" / "perf_watch.json").read_text())
+    assert "wire.cyclic.bf16.k4.shadow_residual_max" in snap["metrics"]
+    assert "wire.cyclic.bf16.k4.bytes_per_worker" in snap["metrics"]
+    assert perf_watch.main(["--root", str(root)]) == 0  # clean
+
+    out = root / "report.json"
+    # the flipped shadow-residual row: a DECREASE also gates (pinned)
+    path.write_text(json.dumps(artifact(residual=0.00005)))
+    assert perf_watch.main(["--root", str(root), "--json", str(out)]) == 1
+    regs = {r["metric"] for r in json.loads(out.read_text())["regressions"]}
+    assert "wire.cyclic.bf16.k4.shadow_residual_max" in regs
+
+    # flag agreement dipping below 1.0 gates
+    path.write_text(json.dumps(artifact(agree=0.875)))
+    assert perf_watch.main(["--root", str(root), "--json", str(out)]) == 1
+    regs = {r["metric"] for r in json.loads(out.read_text())["regressions"]}
+    assert "wire.cyclic.bf16.k4.shadow_flag_agree_min" in regs
+
+    # detection lost under quantization gates
+    path.write_text(json.dumps(artifact(preserved=False, recall=0.8)))
+    assert perf_watch.main(["--root", str(root), "--json", str(out)]) == 1
+    regs = {r["metric"] for r in json.loads(out.read_text())["regressions"]}
+    assert {"wire.cyclic.bf16.k4.det_preserved",
+            "wire.cyclic.bf16.k4.det_recall_shadow"} <= regs
+
+
+def test_perf_watch_gates_on_flipped_chaos_numerics(tmp_path):
+    """The nan_grad cells' ISSUE 10 NaN-safety flags (numerics_finite /
+    fault_visible) gate perf_watch at tolerance 0."""
+    import json
+
+    from tools import perf_watch
+
+    root = tmp_path
+    (root / "baselines_out").mkdir()
+    matrix = {"all_ok": True, "rows": [
+        {"loop": "cnn_k4", "fault": "nan_grad", "ok": True,
+         "outcome": "guarded", "attributed": True,
+         "numerics_finite": True, "fault_visible": True},
+    ]}
+    (root / "baselines_out" / "chaos_matrix.json").write_text(
+        json.dumps(matrix))
+    assert perf_watch.main(["--root", str(root), "--snapshot"]) == 0
+    snap = json.loads(
+        (root / "baselines_out" / "perf_watch.json").read_text())
+    assert "chaos.cnn_k4.nan_grad.numerics_finite" in snap["metrics"]
+    assert perf_watch.main(["--root", str(root)]) == 0
+
+    matrix["rows"][0]["numerics_finite"] = False
+    (root / "baselines_out" / "chaos_matrix.json").write_text(
+        json.dumps(matrix))
+    out = root / "report.json"
+    assert perf_watch.main(["--root", str(root), "--json", str(out)]) == 1
+    regs = [r["metric"] for r in json.loads(out.read_text())["regressions"]]
+    assert "chaos.cnn_k4.nan_grad.numerics_finite" in regs
+
+
+def test_check_artifacts_tool(tmp_path, capsys):
+    """tools/check_artifacts.py (jax-free, ISSUE 10 satellite): one
+    command re-verifies every committed artifact and exits 0 on the
+    repo; a root with a broken artifact exits 1 NAMING the first
+    failing check."""
+    from tools import check_artifacts
+
+    assert check_artifacts.main(["--root", REPO]) == 0
+    out = capsys.readouterr().out
+    assert "all" in out and "passed" in out
+
+    # an empty root has no perf_watch baseline: the first check fails
+    # and is named
+    (tmp_path / "baselines_out").mkdir()
+    assert check_artifacts.main(["--root", str(tmp_path)]) == 1
+    out = capsys.readouterr().out
+    assert "FAILED at 'perf_watch'" in out
+
+    # a root whose perf_watch passes but whose wire study is broken names
+    # THAT check: copy the committed snapshot world minus wire_study
+    import json
+    import shutil
+
+    for f in ("perf_watch.json", "program_lint.json", "chaos_matrix.json",
+              "straggler_study.json", "device_profile.json",
+              "wire_study.json"):
+        src = os.path.join(REPO, "baselines_out", f)
+        if os.path.exists(src):
+            shutil.copy(src, tmp_path / "baselines_out" / f)
+    study = json.load(open(tmp_path / "baselines_out" / "wire_study.json"))
+    # break the ledger ARITHMETIC of a column perf_watch does not fold
+    # (the f32 bytes of a bf16 row), so the perf_watch check still passes
+    # and the failure is attributed to the wire_study verifier
+    study["rows"][0]["wire"]["bytes_per_worker"]["f32"] += 4
+    (tmp_path / "baselines_out" / "wire_study.json").write_text(
+        json.dumps(study))
+    # BENCH_r*/MULTICHIP_r* are read from the root: absent here, their
+    # metrics fold as missing (non-fatal without --strict-missing)
+    assert check_artifacts.main(["--root", str(tmp_path)]) == 1
+    assert "FAILED at 'wire_study --check'" in capsys.readouterr().out

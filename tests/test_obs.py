@@ -941,3 +941,232 @@ def test_trace_report_tolerates_partial_artifacts(tmp_path, capsys):
     assert rc == 0
     head = capsys.readouterr().out.splitlines()[0]
     assert "DROPPED" not in head
+
+
+# --------------------------------------------------------------------------
+# obs/numerics.py — the wire & numerics observatory (ISSUE 10)
+# --------------------------------------------------------------------------
+
+@pytest.mark.core
+def test_numerics_stage_columns_and_exponent_histogram():
+    """Known tensor -> exact range stats: absmax/rms over finite elements,
+    threshold fractions over all elements, exponent-bin fractions summing
+    to the finite-nonzero fraction."""
+    from draco_tpu.obs import numerics as nx
+
+    x = jnp.asarray([1.0, -2.0, 0.5, 0.0, 2.0 ** -20, 2.0 ** 10,
+                     -(2.0 ** -30), 300.0], jnp.float32)
+    cols = {k: float(v) for k, v in nx.stage_columns("wire", [x],
+                                                     block=4).items()}
+    assert cols["nx_wire_absmax"] == pytest.approx(1024.0)
+    assert cols["nx_wire_rms"] == pytest.approx(
+        float(np.sqrt(np.mean(np.square(np.asarray(x))))), rel=1e-6)
+    # bf16 shares f32's exponent range, so only f32 SUBNORMALS sit under
+    # the bf16 subnormal minimum — and XLA:CPU flushes those to zero
+    # before the stats see them, so the honest count here is 0 (the
+    # column matters on non-FTZ backends and for future narrower dtypes)
+    assert cols["nx_wire_uf_bf16"] == 0.0
+    assert cols["nx_wire_of_bf16"] == 0.0
+    assert cols["nx_wire_nonfinite"] == 0.0
+    # exponent bins cover the finite nonzero elements exactly
+    hist = sum(cols[f"nx_wire_exp{i}"] for i in range(nx.NUM_EXP_BINS))
+    assert hist == pytest.approx(7 / 8)  # one exact zero excluded
+    assert cols["nx_wire_exp5"] == pytest.approx(2 / 8)  # 2^10 and 300
+    assert cols["nx_wire_exp1"] == pytest.approx(2 / 8)  # 2^-20, 2^-30
+    # int8 underflow threshold is per 4-element block: in block [1,-2,.5,0]
+    # nothing sits under absmax/254; in block [2^-20, 2^10, -2^-30, 300]
+    # the two tiny values round to zero at scale 1024/127
+    assert cols["nx_wire_uf_int8"] == pytest.approx(2 / 8)
+
+
+@pytest.mark.core
+def test_numerics_columns_nan_safe_sentinels():
+    """An injected NaN/Inf never reaches a stats column: absmax/rms mask
+    to the finite elements, the fractions stay in [0, 1], and the
+    nonfinite fraction carries the fault signal (the chaos-matrix
+    NaN-safety contract)."""
+    from draco_tpu.obs import numerics as nx
+
+    x = jnp.asarray([[1.0, float("nan"), 2.0, float("inf")],
+                     [0.5, 1.5, -1.0, 3.0]], jnp.float32)
+    cols = {k: float(v) for k, v in nx.stage_columns("grad", [x],
+                                                     block=4).items()}
+    assert all(np.isfinite(v) for v in cols.values()), cols
+    assert cols["nx_grad_nonfinite"] == pytest.approx(2 / 8)
+    assert cols["nx_grad_absmax"] == pytest.approx(3.0)
+    # all-nonfinite input still yields finite sentinels
+    bad = jnp.full((4,), float("nan"), jnp.float32)
+    cols = {k: float(v) for k, v in nx.stage_columns("agg", [bad],
+                                                     block=4).items()}
+    assert all(np.isfinite(v) for v in cols.values()), cols
+    assert cols["nx_agg_nonfinite"] == 1.0 and cols["nx_agg_absmax"] == 0.0
+
+
+@pytest.mark.core
+def test_quantize_rows_bf16_int8_and_row_identity():
+    """bf16 nearest == the astype round trip; int8 per-block error is
+    bounded by half an LSB of the block scale; bitwise-identical rows
+    quantize bitwise-identically under BOTH rounding modes (maj_vote's
+    soundness condition); stochastic rounding is deterministic per key."""
+    from draco_tpu.obs import numerics as nx
+
+    rs = np.random.RandomState(7)
+    x = jnp.asarray(rs.randn(3, 40).astype(np.float32) * 10.0)
+    qb = nx.quantize_rows(x, "bf16")
+    np.testing.assert_array_equal(
+        np.asarray(qb),
+        np.asarray(x.astype(jnp.bfloat16).astype(jnp.float32)))
+    qi = np.asarray(nx.quantize_rows(x, "int8", block=16))
+    xn = np.asarray(x)
+    # per-(row, 16-block) scale: |err| <= scale/2 = absmax/254
+    for r in range(3):
+        for b0 in range(0, 40, 16):
+            blk = xn[r, b0:b0 + 16]
+            scale = np.abs(blk).max() / 127.0
+            assert np.max(np.abs(qi[r, b0:b0 + 16] - blk)) <= scale / 2 + 1e-7
+    # identical rows stay identical (shared noise draw across rows)
+    import jax as _jax
+
+    same = jnp.broadcast_to(x[0], (4, 40))
+    key = _jax.random.key(3)
+    for mode in ("bf16", "int8"):
+        q = np.asarray(nx.quantize_rows(same, mode, block=16, key=key))
+        assert all(np.array_equal(q[0], q[i]) for i in range(4))
+        q2 = np.asarray(nx.quantize_rows(same, mode, block=16, key=key))
+        np.testing.assert_array_equal(q, q2)  # keyed == deterministic
+    # int8 of a non-finite input maps to 0 (no NaN encoding on an integer
+    # wire); bf16 keeps the NaN (bf16 has one)
+    bad = jnp.asarray([[1.0, float("nan")]], jnp.float32)
+    assert np.asarray(nx.quantize_rows(bad, "int8", block=2))[0, 1] == 0.0
+    assert np.isnan(np.asarray(nx.quantize_rows(bad, "bf16"))[0, 1])
+
+
+@pytest.mark.core
+def test_wire_ledger_arithmetic():
+    """Logical bytes ledger: cyclic ships re+im (2 words/element), others
+    one; int8 adds one f32 scale per block; per-step = n x per-worker."""
+    from draco_tpu.config import TrainConfig
+    from draco_tpu.obs import numerics as nx
+
+    cfg = TrainConfig(approach="cyclic", worker_fail=1, num_workers=8,
+                      shadow_block=256)
+    led = nx.wire_ledger(cfg, 1000)
+    per = led["bytes_per_worker"]
+    assert per["f32"] == 2 * 4 * 1000
+    assert per["bf16"] == 2 * 2 * 1000
+    assert per["int8"] == 2 * 1000 + 4 * 2 * 4  # 4 blocks of 256 per half
+    assert led["bytes_per_step"] == {k: v * 8 for k, v in per.items()}
+    cfg2 = TrainConfig(approach="maj_vote", group_size=4, num_workers=8)
+    led2 = nx.wire_ledger(cfg2, 1000)
+    assert led2["bytes_per_worker"]["f32"] == 4 * 1000
+
+
+@pytest.mark.core
+def test_shadow_columns_sentinel_and_agreement():
+    """A fault-poisoned shadow comparison lands at the finite sentinel
+    (-1.0), never NaN; flag agreement counts present workers only and the
+    shadow detection counts score against the seeded truth."""
+    from draco_tpu.obs import numerics as nx
+
+    agg = jnp.asarray([1.0, 2.0], jnp.float32)
+    flags = jnp.asarray([False, True, False, False])
+    sflags = jnp.asarray([False, True, True, False])
+    present = jnp.asarray([True, True, True, False])
+    adv = jnp.asarray([False, True, False, False])
+    cols = nx.shadow_columns(agg, agg * 1.01, 1e-3, flags, sflags, adv,
+                             present)
+    vals = {k: float(v) for k, v in cols.items()}
+    assert vals["shadow_err"] == pytest.approx(0.01, rel=1e-3)
+    # worker 2 disagrees; worker 3 is absent and does not count
+    assert vals["shadow_flag_agree"] == pytest.approx(2 / 3)
+    assert vals["shadow_det_flagged"] == 2.0 and vals["shadow_det_tp"] == 1.0
+    poisoned = nx.shadow_columns(
+        jnp.asarray([float("nan"), 1.0]), agg, float("nan"), flags, sflags,
+        adv, present)
+    assert float(poisoned["shadow_err"]) == nx.SHADOW_SENTINEL
+    assert float(poisoned["shadow_residual"]) == nx.SHADOW_SENTINEL
+
+
+@pytest.mark.core
+def test_heartbeat_numerics_and_wire_blocks(tmp_path):
+    """The heartbeat folds nx_/shadow_ columns into the ``numerics``
+    status block (last values, running max of the danger fractions,
+    running MIN of the flag agreement) and carries the static ``wire``
+    ledger stamped via set_wire — both under schema 3."""
+    from draco_tpu.obs import STATUS_SCHEMA
+
+    hb = RunHeartbeat(str(tmp_path))
+    hb.set_wire({"family": "cyclic", "dim": 10,
+                 "bytes_per_worker": {"f32": 80, "bf16": 40, "int8": 14}})
+    hb.observe({"step": 1, "loss": 1.0, "nx_wire_absmax": 5.0,
+                "nx_wire_rms": 1.0, "nx_wire_uf_int8": 0.1,
+                "nx_grad_nonfinite": 0.0, "shadow_err": 0.01,
+                "shadow_flag_agree": 1.0})
+    hb.observe({"step": 2, "loss": 0.9, "nx_wire_absmax": 4.0,
+                "nx_wire_rms": 0.9, "nx_wire_uf_int8": 0.3,
+                "nx_grad_nonfinite": 0.0, "shadow_err": 0.002,
+                "shadow_flag_agree": 0.5})
+    payload = hb.beat(2, 4)
+    assert payload["schema"] == STATUS_SCHEMA == 3
+    assert payload["wire"]["bytes_per_worker"]["bf16"] == 40
+    nxb = payload["numerics"]
+    assert nxb["nx_wire_absmax"] == 4.0  # last value
+    assert nxb["nx_wire_uf_int8_max"] == pytest.approx(0.3)  # running max
+    assert nxb["shadow_err_max"] == pytest.approx(0.01)
+    assert nxb["shadow_flag_agree_min"] == pytest.approx(0.5)  # running min
+    # a fault-poisoned shadow comparison (the -1.0 sentinel) is COUNTED,
+    # never folded into the extremes — shadow_err_max must not hide it
+    hb.observe({"step": 3, "loss": 2.0, "shadow_err": -1.0,
+                "shadow_residual": -1.0, "shadow_flag_agree": -1.0})
+    nxb = hb.beat(3, 4)["numerics"]
+    assert nxb["shadow_err_max"] == pytest.approx(0.01)  # sentinel excluded
+    assert nxb["shadow_flag_agree_min"] == pytest.approx(0.5)
+    assert nxb["shadow_sentinel_steps"] == 1
+    # watch-free runs carry neither block
+    hb2 = RunHeartbeat(str(tmp_path / "plain"))
+    hb2.observe({"step": 1, "loss": 1.0})
+    p2 = hb2.beat(1, 2)
+    assert "numerics" not in p2 and "wire" not in p2
+
+
+def test_numerics_nan_fault_live_columns_finite(tmp_path):
+    """Live NaN-safety pin (ISSUE 10 satellite): under an injected
+    nan_grad fault the numerics columns carry finite sentinels, the
+    nonfinite-fraction column goes loud at the fault step, the rest of
+    the metric block still parses, and the step guard trips."""
+    from draco_tpu.config import TrainConfig
+    from draco_tpu.data.datasets import load_dataset
+    from draco_tpu.runtime import make_mesh
+    from draco_tpu.training.trainer import Trainer
+
+    d = str(tmp_path / "run")
+    ds = load_dataset("synthetic-mnist", synthetic_train=128,
+                      synthetic_test=32)
+    cfg = TrainConfig(network="FC", dataset="synthetic-mnist", batch_size=4,
+                      num_workers=8, approach="cyclic", worker_fail=1,
+                      err_mode="rev_grad", redundancy="shared", max_steps=5,
+                      eval_freq=0, train_dir=d, log_every=1, step_guard="on",
+                      numerics_watch="on", shadow_wire="bf16",
+                      fault_spec="nan_grad@3:w2", steps_per_call=5)
+    tr = Trainer(cfg, mesh=make_mesh(8), dataset=ds, quiet=True)
+    tr.run()
+    tr.close()
+    recs = [json.loads(l) for l in open(tmp_path / "run" / "metrics.jsonl")]
+    train = [r for r in recs if "loss" in r and r.get("split") != "eval"]
+    assert [r["step"] for r in train] == [1, 2, 3, 4, 5]
+    for r in train:
+        for k, v in r.items():
+            if k.startswith(("nx_", "shadow_")):
+                assert np.isfinite(v), (r["step"], k, v)
+    fault = train[2]
+    assert fault["nx_grad_nonfinite"] > 0.0  # the fault is VISIBLE
+    assert fault["guard_trips"] >= 1.0 and fault["skipped_steps"] == 1.0
+    # shadow comparison at the fault step degrades to the sentinel or a
+    # finite value — never NaN (columns asserted finite above); clean
+    # steps stay pristine
+    clean = [r for r in train if r["step"] != 3]
+    assert all(r["nx_grad_nonfinite"] == 0.0 for r in clean)
+    assert all(r["guard_trips"] == 0.0 for r in clean)
+    status = json.load(open(tmp_path / "run" / "status.json"))
+    assert status["numerics"]["nx_grad_nonfinite_max"] > 0.0
+    assert status["wire"]["family"] == "cyclic"

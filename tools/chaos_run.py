@@ -104,6 +104,10 @@ def _base_cfg_kw():
         batch_size=4, num_workers=NUM_WORKERS, max_steps=MAX_STEPS,
         eval_freq=EVAL_FREQ, log_every=1, lr=0.05, compress_ckpt=True,
         step_guard="on", prefetch_timeout_s=2.0, prefetch_restarts=2,
+        # numerics observatory on in EVERY cell (obs/numerics.py, ISSUE
+        # 10): the columns must stay finite-sentineled under each fault
+        # class — the nan_grad cells assert it (_numerics_verdict)
+        numerics_watch="on",
     )
 
 
@@ -281,6 +285,43 @@ def _straggle_verdict(train_dir, worker, step):
             "never_accused": never_accused}
 
 
+def _numerics_verdict(train_dir, step):
+    """ISSUE 10 NaN-safety at the fault step: the numerics columns carry
+    FINITE sentinel values (stats are computed over the finite elements
+    only — the fault's signature is the nonfinite fraction going loud,
+    never a NaN column), and no scalar column of the record is NaN/Inf —
+    i.e. an injected non-finite gradient does not poison the metric
+    block. Returns {numerics_finite, fault_visible}."""
+    import math
+
+    rec = None
+    try:
+        with open(os.path.join(train_dir, "metrics.jsonl")) as fh:
+            for line in fh:
+                try:
+                    r = json.loads(line)
+                except ValueError:
+                    continue
+                if r.get("step") == step and r.get("split") != "eval" \
+                        and "loss" in r:
+                    rec = r
+    except OSError:
+        pass
+    if rec is None or "nx_grad_nonfinite" not in rec:
+        return {"numerics_finite": False, "fault_visible": False}
+    # the observatory columns + the training metrics must be finite; the
+    # decode-health residual is deliberately NOT in this set — a NaN
+    # decode_residual at the fault step IS the guard's loud signal
+    # (resilience/guards.py), not poisoning
+    finite = all(
+        math.isfinite(float(v)) for k, v in rec.items()
+        if isinstance(v, (int, float))
+        and (k.startswith("nx_") or k.startswith("shadow_")
+             or k in ("loss", "prec1")))
+    return {"numerics_finite": bool(finite),
+            "fault_visible": bool(rec["nx_grad_nonfinite"] > 0.0)}
+
+
 def _attempt(run, cfg, steps=None):
     """(params_vec | None, error | None) — a run either finishes or raises."""
     try:
@@ -376,6 +417,11 @@ def run_case(loop: str, fault: str, make_cfg, run, clean_vec, workdir):
         row["injected"] = injected
         row["accused"] = accused
         row["attributed"] = attributed
+    if fault == "nan_grad":
+        # ISSUE 10 NaN-safety pin: the numerics columns at the fault step
+        # are finite sentinels and the injected non-finite gradient is
+        # VISIBLE in the nonfinite-fraction column
+        row.update(_numerics_verdict(d, step))
 
     if err is not None:
         name = type(err).__name__
@@ -435,6 +481,15 @@ def run_case(loop: str, fault: str, make_cfg, run, clean_vec, workdir):
         row.update(ok=False, outcome="FAILED",
                    detail=f"fault survived but unattributed: injected "
                           f"{row['injected']} vs accused {row['accused']}")
+    if row["ok"] and fault == "nan_grad" and not (
+            row["numerics_finite"] and row["fault_visible"]):
+        # survived the fault but the observatory either went NaN (block
+        # poisoned) or failed to show the non-finite ingest — the ISSUE
+        # 10 NaN-safety contract, not an ok cell
+        row.update(ok=False, outcome="FAILED",
+                   detail=f"numerics columns under nan_grad: finite="
+                          f"{row['numerics_finite']} visible="
+                          f"{row['fault_visible']}")
     return row
 
 

@@ -1,0 +1,179 @@
+#!/usr/bin/env python
+"""Re-verify every committed ``baselines_out/`` artifact in one jax-free
+command — ISSUE 10's "is the evidence still true?" button.
+
+The repo's committed artifacts are load-bearing: perf_watch gates rounds
+against them, tests assert they cover the registry, and PERF.md quotes
+their numbers. Each artifact already has its own verifier; this tool runs
+ALL of them (plus schema smokes of the jax-free report tools against
+synthesized inputs, so a report-tool regression surfaces here too) and
+exits nonzero NAMING THE FIRST FAILURE:
+
+  perf_watch          diff current artifacts vs the committed snapshot
+  device_profile      --check: sums/cross-check/control of the committed
+                      device-time ledger
+  wire_study          --check: ledger arithmetic + bf16 detection pins of
+                      the committed shadow-wire matrix
+  program_lint        committed all_ok roll-up
+  chaos_matrix        committed all_ok roll-up
+  straggler_study     committed all_ok roll-up
+  trace_report smoke  folds a synthesized trace.json + metrics.jsonl +
+                      schema-current status.json without error
+  forensics_report    folds a synthesized packed-mask metrics.jsonl and
+      smoke           reproduces the expected per-worker fold
+
+Pure artifact folding — runs on a laptop against an scp'd checkout, no
+accelerator stack. Wired into tests/test_cli_tools.py.
+
+Usage:
+  python tools/check_artifacts.py [--root .]
+"""
+
+from __future__ import annotations
+
+import argparse
+import contextlib
+import io
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _flag_check(relpath, flag="all_ok"):
+    def check(root):
+        path = os.path.join(root, relpath)
+        try:
+            with open(path) as fh:
+                data = json.load(fh)
+        except (OSError, ValueError) as e:
+            return f"cannot read {relpath}: {e}"
+        if not data.get(flag):
+            return f"{relpath}: {flag} is false"
+        return None
+    return check
+
+
+def _check_perf_watch(root):
+    from tools import perf_watch
+
+    rc = perf_watch.main(["--root", root])
+    return None if rc == 0 else f"perf_watch exited {rc}"
+
+
+def _check_device_profile(root):
+    from tools import device_profile
+
+    artifact = os.path.join(root, "baselines_out", "device_profile.json")
+    rc = device_profile.main(["--check", "--artifact", artifact])
+    return None if rc == 0 else f"device_profile --check exited {rc}"
+
+
+def _check_wire_study(root):
+    from tools import wire_study
+
+    artifact = os.path.join(root, "baselines_out", "wire_study.json")
+    rc = wire_study.main(["--check", "--artifact", artifact])
+    return None if rc == 0 else f"wire_study --check exited {rc}"
+
+
+def _check_trace_report(root):
+    """Schema smoke: the jax-free report must fold a minimal-but-current
+    run dir (trace + metrics + a STATUS_SCHEMA-versioned status.json) —
+    a schema bump that forgot trace_report trips here, jax-free."""
+    from draco_tpu.obs.heartbeat import STATUS_SCHEMA
+    from tools import trace_report
+
+    with tempfile.TemporaryDirectory(prefix="check_trace_") as d:
+        events = [
+            {"name": "dispatch", "ph": "X", "ts": 0.0, "dur": 5000.0,
+             "pid": 1, "tid": 1},
+            {"name": "flush", "ph": "X", "ts": 5000.0, "dur": 1000.0,
+             "pid": 1, "tid": 1},
+        ]
+        with open(os.path.join(d, "trace.json"), "w") as fh:
+            json.dump({"traceEvents": events}, fh)
+        with open(os.path.join(d, "metrics.jsonl"), "w") as fh:
+            fh.write(json.dumps({"step": 1, "loss": 1.0, "t_comp": 0.01})
+                     + "\n")
+        status = {"schema": STATUS_SCHEMA, "state": "done", "step": 1,
+                  "updated_at": 0.0,
+                  "wire": {"family": "cyclic", "dim": 10,
+                           "bytes_per_worker": {"f32": 80, "bf16": 40,
+                                                "int8": 14}},
+                  "numerics": {"nx_wire_absmax": 1.0,
+                               "shadow_err_max": 0.001,
+                               "shadow_flag_agree_min": 1.0}}
+        with open(os.path.join(d, "status.json"), "w") as fh:
+            json.dump(status, fh)
+        rc = trace_report.main([d])
+        return None if rc == 0 else f"trace_report smoke exited {rc}"
+
+
+def _check_forensics_report(root):
+    from tools import forensics_report
+
+    with tempfile.TemporaryDirectory(prefix="check_fx_") as d:
+        rec = {"step": 1, "loss": 1.0, "wmask_accused0": 0b0100,
+               "wmask_present0": 0b1111, "wmask_adv0": 0b0100}
+        with open(os.path.join(d, "metrics.jsonl"), "w") as fh:
+            fh.write(json.dumps(rec) + "\n")
+        rc = forensics_report.main([d, "--num-workers", "4"])
+        if rc != 0:
+            return f"forensics_report smoke exited {rc}"
+        rep = json.load(open(os.path.join(d, "forensics.json")))
+        if rep["workers"][2]["accused"] != 1 \
+                or rep["workers"][2]["tp"] != 1:
+            return "forensics_report smoke: fold did not attribute w2"
+        return None
+
+
+CHECKS = (
+    ("perf_watch", _check_perf_watch),
+    ("device_profile --check", _check_device_profile),
+    ("wire_study --check", _check_wire_study),
+    ("program_lint all_ok",
+     _flag_check(os.path.join("baselines_out", "program_lint.json"))),
+    ("chaos_matrix all_ok",
+     _flag_check(os.path.join("baselines_out", "chaos_matrix.json"))),
+    ("straggler_study all_ok",
+     _flag_check(os.path.join("baselines_out", "straggler_study.json"))),
+    ("trace_report smoke", _check_trace_report),
+    ("forensics_report smoke", _check_forensics_report),
+)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--root", type=str, default=".",
+                    help="repo root holding baselines_out/ + BENCH_r*.json")
+    ap.add_argument("--verbose", action="store_true",
+                    help="show the sub-verifiers' own output")
+    args = ap.parse_args(argv)
+    root = os.path.abspath(args.root)
+    for name, check in CHECKS:
+        buf = io.StringIO()
+        try:
+            if args.verbose:
+                err = check(root)
+            else:
+                with contextlib.redirect_stdout(buf), \
+                        contextlib.redirect_stderr(buf):
+                    err = check(root)
+        except Exception as e:  # noqa: BLE001 — naming the failure IS the job
+            err = f"{type(e).__name__}: {e}"
+        if err is not None:
+            sub = buf.getvalue().strip()
+            if sub:
+                print(sub)
+            print(f"check_artifacts: FAILED at {name!r}: {err}")
+            return 1
+        print(f"check_artifacts: ok  {name}")
+    print(f"check_artifacts: all {len(CHECKS)} artifact checks passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -51,6 +51,15 @@ Folded sources (all optional — a missing artifact folds nothing):
                                 feasible is a semantic change, not an
                                 improvement), wall ms/step at the time
                                 tolerance
+  baselines_out/wire_study.json
+                                the shadow-quantized wire matrix
+                                (tools/wire_study.py, ISSUE 10): shadow
+                                residual / flag agreement pinned at
+                                tolerance 0 (deterministic decode of a
+                                deterministically quantized wire), shadow
+                                detection P/R + det_preserved as
+                                0-tolerance ok flags, logical wire bytes
+                                at the bytes tolerance
   baselines_out/device_profile.json
                                 the device-time attribution ledger
                                 (tools/device_profile.py, ISSUE 9):
@@ -160,6 +169,13 @@ def fold_bench(root: str, metrics: dict) -> None:
             metrics[f"bench.{name}.compile_ms"] = {
                 "value": float(extra["compile_ms"]), "kind": "compile_ms",
                 "source": src}
+        if isinstance(extra.get("wire_bytes"), (int, float)):
+            # logical codeword bytes per step (obs/numerics.wire_ledger,
+            # ISSUE 10) — the series that will show the item-4 win when
+            # the real narrow wire lands
+            metrics[f"bench.{name}.wire_bytes"] = {
+                "value": float(extra["wire_bytes"]), "kind": "bytes",
+                "source": src}
 
 
 def fold_multichip(root: str, metrics: dict) -> None:
@@ -260,6 +276,14 @@ def fold_chaos(root: str, metrics: dict) -> None:
             metrics[f"chaos.{loop}.{fault}.attributed"] = {
                 "value": float(bool(row["attributed"])), "kind": "ok",
                 "source": src}
+        # ISSUE 10 NaN-safety flags on the nan_grad cells: the numerics
+        # columns staying finite-sentineled (and the fault staying
+        # visible in the nonfinite fraction) gate at tolerance 0 too
+        for flag in ("numerics_finite", "fault_visible"):
+            if flag in row:
+                metrics[f"chaos.{loop}.{fault}.{flag}"] = {
+                    "value": float(bool(row[flag])), "kind": "ok",
+                    "source": src}
 
 
 def fold_straggler(root: str, metrics: dict) -> None:
@@ -304,6 +328,47 @@ def fold_straggler(root: str, metrics: dict) -> None:
             metrics[f"{key}.ms_per_step"] = {
                 "value": float(row["ms_per_step"]), "kind": "time_ms",
                 "source": src}
+
+
+def fold_wire_study(root: str, metrics: dict) -> None:
+    """Wire-study artifact (tools/wire_study.py, ISSUE 10): the shadow
+    residual and flag-agreement columns are PINNED at tolerance 0 in both
+    directions — a deterministic seeded decode of a deterministically
+    quantized wire moving AT ALL is a semantic change (the flipped-row
+    control in tests/test_cli_tools.py proves the gate live). The
+    detection-preserved bool and shadow detection P/R gate as 0-tolerance
+    ok-kind; logical wire bytes ride at the bytes tolerance so a ledger
+    drift (dim change) shows up without gating honest model edits."""
+    path = os.path.join(root, "baselines_out", "wire_study.json")
+    data = _read_json(path)
+    if not isinstance(data, dict):
+        return
+    src = "baselines_out/wire_study.json"
+    if "all_ok" in data:
+        metrics["wire.all_ok"] = {"value": float(bool(data["all_ok"])),
+                                  "kind": "ok", "source": src}
+    for row in data.get("rows", []):
+        fam, dtype, k = row.get("family"), row.get("dtype"), row.get("k")
+        if fam is None or dtype is None or k is None:
+            continue
+        key = f"wire.{fam}.{dtype}.k{k}"
+        for col in ("shadow_err_max", "shadow_residual_max",
+                    "shadow_flag_agree_min"):
+            if isinstance(row.get(col), (int, float)):
+                metrics[f"{key}.{col}"] = {
+                    "value": float(row[col]), "kind": "pinned",
+                    "source": src}
+        metrics[f"{key}.det_preserved"] = {
+            "value": float(bool(row.get("det_preserved"))), "kind": "ok",
+            "source": src}
+        for col in ("det_precision_shadow", "det_recall_shadow"):
+            if isinstance(row.get(col), (int, float)):
+                metrics[f"{key}.{col}"] = {
+                    "value": float(row[col]), "kind": "ok", "source": src}
+        per = (row.get("wire") or {}).get("bytes_per_worker") or {}
+        if isinstance(per.get(dtype), (int, float)):
+            metrics[f"{key}.bytes_per_worker"] = {
+                "value": float(per[dtype]), "kind": "bytes", "source": src}
 
 
 def fold_device_profile(root: str, metrics: dict) -> None:
@@ -371,6 +436,7 @@ def fold_all(root: str) -> dict:
     fold_program_lint(root, metrics)
     fold_chaos(root, metrics)
     fold_straggler(root, metrics)
+    fold_wire_study(root, metrics)
     fold_device_profile(root, metrics)
     return metrics
 

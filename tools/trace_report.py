@@ -154,8 +154,10 @@ def fold_metrics(path: str) -> dict:
 # status.json schema versions this report knows how to read — mirrors
 # obs/heartbeat.STATUS_SCHEMA (hardcoded: this tool is jax-free AND
 # draco_tpu-free, usable from a bare checkout of tools/). Pre-versioning
-# files carry no field and are accepted.
-KNOWN_STATUS_SCHEMAS = (2,)
+# files carry no field and are accepted. Schema 3 adds the additive
+# ``wire``/``numerics`` blocks (ISSUE 10); schema-2 payloads stay
+# readable (the blocks just never appear).
+KNOWN_STATUS_SCHEMAS = (2, 3)
 
 
 def fold_status(path: str) -> dict:
@@ -180,7 +182,7 @@ def fold_status(path: str) -> dict:
             f"alongside obs/heartbeat.STATUS_SCHEMA")
     out = {}
     for key in ("schema", "state", "cause", "resumable_step", "step",
-                "updated_at"):
+                "updated_at", "wire", "numerics"):
         if key in status:
             out[key] = status[key]
     return out
@@ -292,6 +294,33 @@ def print_table(report: dict, out=None) -> None:
         if status.get("resumable_step") is not None:
             line += f"   resumable from step {status['resumable_step']}"
         print(line, file=out)
+    # wire ledger + numerics observatory (ISSUE 10): the status blocks a
+    # watch-enabled run stamps — logical bytes per worker per step with
+    # the narrow-dtype candidates, and the folded range/shadow extremes
+    wire = (status or {}).get("wire")
+    if wire:
+        b = wire.get("bytes_per_worker", {})
+        f32 = b.get("f32")
+        parts = [f"wire[{wire.get('family')}]: d={wire.get('dim')}"]
+        if f32:
+            parts.append(f"f32 {f32 / 1024:.1f} KiB/worker/step")
+            for dt in ("bf16", "int8"):
+                if b.get(dt):
+                    parts.append(f"{dt} {b[dt] / 1024:.1f} KiB "
+                                 f"({b[dt] / f32:.2f}x)")
+        if wire.get("shadow_wire", "off") != "off":
+            parts.append(f"shadow={wire['shadow_wire']}")
+        print("   ".join(parts), file=out)
+    nx = (status or {}).get("numerics")
+    if nx:
+        bits = []
+        for k in ("nx_wire_absmax", "nx_wire_rms", "shadow_err_max",
+                  "shadow_residual_max", "shadow_flag_agree_min",
+                  "nx_wire_uf_int8_max", "nx_grad_nonfinite_max"):
+            if k in nx:
+                bits.append(f"{k.replace('nx_', '')}={nx[k]:.4g}")
+        if bits:
+            print("numerics: " + "  ".join(bits), file=out)
     # guard + decode-health header (folded from the per-step columns —
     # previously invisible to this jax-free path)
     m = report.get("metrics") or {}

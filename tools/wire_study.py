@@ -1,0 +1,276 @@
+#!/usr/bin/env python
+"""Wire study: what would a bf16/int8 worker→aggregator wire do to decode
+error and Byzantine detection? — ISSUE 10's committed evidence, measured by
+the shadow-quantized wire (obs/numerics.py) on the production chunked loop.
+
+ROADMAP item 4 will narrow the coded wire; this study is the measurement
+foundation it gets built and regression-gated on. Each cell trains the same
+FC/synthetic-mnist workload under {cyclic, maj_vote, approx} ×
+{bf16, int8} × K∈{1,4} with ``numerics_watch=on`` and ``shadow_wire`` set —
+the f32 path alone updates params, the shadow decode of the quantized
+codewords rides the same step body — and records, from the run's own
+metrics.jsonl:
+
+  shadow_err_max        worst-step relative L2 error of the shadow
+                        aggregate vs the f32 aggregate — the end-to-end
+                        cost of the narrow dtype
+  shadow_residual_max   worst-step shadow decode-health residual
+  shadow_flag_agree_min worst-step fraction of present workers whose
+                        shadow detection flag equals the f32 flag — 1.0
+                        means quantization changed NO accusation
+  det_precision/recall (_shadow)
+                        detection P/R vs the seeded schedules, on the f32
+                        AND the shadow flag sets — the exact-code cells run
+                        a LIVE rev_grad adversary, so "detection survives
+                        the narrow wire" is measured, not assumed
+  wire                  the logical bytes ledger (obs/numerics.wire_ledger)
+                        — f32/bf16/int8 bytes per worker per step at the
+                        program's registered shapes
+
+``tools/perf_watch.py`` folds the committed artifact: the shadow residual /
+flag-agreement columns gate round-over-round as pinned tolerance-0 kinds
+(proven live by the flipped-row control in tests/test_cli_tools.py), the
+detection bools at tolerance 0, wire bytes at the bytes tolerance.
+
+``--check`` re-verifies a committed artifact jax-free (ledger arithmetic,
+bf16 detection-preserved pins, all_ok roll-up) — wired into
+tools/check_artifacts.py.
+
+Usage (CPU, ~2 min):
+  python tools/wire_study.py --cpu-mesh 8
+  python tools/wire_study.py --check
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+NUM_WORKERS = 8
+FAMILIES = {
+    # live rev_grad adversary on both exact codes: the study must show
+    # detection P/R under quantization, not just decode error
+    "cyclic": dict(approach="cyclic", worker_fail=1, err_mode="rev_grad",
+                   redundancy="shared"),
+    "maj_vote": dict(approach="maj_vote", group_size=4, worker_fail=1,
+                     err_mode="rev_grad"),
+    # the approx family rejects live adversaries (no Byzantine
+    # certificate); its fault axis is seeded drops inside the α budget
+    "approx": dict(approach="approx", worker_fail=0, redundancy="shared",
+                   code_redundancy=1.5, straggler_alpha=0.25,
+                   straggle_mode="drop", straggle_count=1),
+}
+DTYPES = ("bf16", "int8")
+KS = (1, 4)
+
+
+def _fold_prec_recall(tp, flagged, adv):
+    """Detection precision/recall with the empty-denominator healthy-state
+    convention (obs/heartbeat.decode_health)."""
+    return ((tp / flagged) if flagged else 1.0,
+            (tp / adv) if adv else 1.0)
+
+
+def run_cell(family: str, dtype: str, k: int, args, mesh, ds) -> dict:
+    from draco_tpu.config import TrainConfig
+    from draco_tpu.obs import numerics as numerics_mod
+    from draco_tpu.training.trainer import Trainer
+
+    d = tempfile.mkdtemp(prefix=f"wire_{family}_{dtype}_k{k}_")
+    cfg = TrainConfig(
+        network="FC", dataset="synthetic-mnist", batch_size=4, lr=0.05,
+        momentum=0.9, num_workers=NUM_WORKERS, max_steps=args.max_steps,
+        eval_freq=0, train_dir=d, log_every=1, steps_per_call=k,
+        step_guard="on", compile_guard="raise",
+        numerics_watch="on", shadow_wire=dtype,
+        shadow_round=args.shadow_round, **FAMILIES[family],
+    )
+    tr = Trainer(cfg, mesh=mesh, dataset=ds, quiet=True)
+    try:
+        tr.run()
+        dim = tr.setup.dim
+    finally:
+        tr.close()
+    recs = []
+    with open(os.path.join(d, "metrics.jsonl")) as fh:
+        for line in fh:
+            r = json.loads(line)
+            if "loss" in r and r.get("split") != "eval":
+                recs.append(r)
+    shutil.rmtree(d, ignore_errors=True)
+
+    exact = family in ("cyclic", "maj_vote")
+    flag_col = {"cyclic": "located_errors", "maj_vote": "det_flagged"}
+    tp = sum(r.get("det_tp", 0.0) for r in recs)
+    adv = sum(r.get("det_adv", 0.0) for r in recs)
+    flagged = sum(r.get(flag_col.get(family, ""), 0.0) for r in recs)
+    stp = sum(r["shadow_det_tp"] for r in recs)
+    sflagged = sum(r["shadow_det_flagged"] for r in recs)
+    prec, rec = _fold_prec_recall(tp, flagged, adv)
+    sprec, srec = _fold_prec_recall(stp, sflagged, adv)
+    row = {
+        "family": family, "dtype": dtype, "k": k,
+        "steps": len(recs),
+        "shadow_err_max": round(max(r["shadow_err"] for r in recs), 6),
+        "shadow_residual_max": round(
+            max(r["shadow_residual"] for r in recs), 6),
+        "shadow_flag_agree_min": round(
+            min(r["shadow_flag_agree"] for r in recs), 6),
+        "det_precision": round(prec, 6), "det_recall": round(rec, 6),
+        "det_precision_shadow": round(sprec, 6),
+        "det_recall_shadow": round(srec, 6),
+        "adv_total": adv,
+        "wire_absmax_max": round(
+            max(r["nx_wire_absmax"] for r in recs), 6),
+        "wire_uf_int8_max": round(
+            max(r["nx_wire_uf_int8"] for r in recs), 6),
+        "wire_of_bf16_max": round(
+            max(r["nx_wire_of_bf16"] for r in recs), 6),
+        "guard_trips_total": sum(r.get("guard_trips", 0.0) for r in recs),
+        "loss_final": round(recs[-1]["loss"], 6),
+        "wire": numerics_mod.wire_ledger(cfg, dim),
+    }
+    # detection survives the narrow wire: shadow P/R both 1.0 with a live
+    # adversary (exact codes); the approx cells' surface is flag agreement
+    row["det_preserved"] = bool(
+        (not exact or (sprec == 1.0 and srec == 1.0 and adv > 0))
+        and row["shadow_flag_agree_min"] == 1.0)
+    # every shadow column stayed finite (the NaN sentinel is -1.0 — a
+    # clean run must never produce it)
+    clean = all(r["shadow_err"] >= 0 and r["shadow_residual"] >= 0
+                and r["shadow_flag_agree"] >= 0 for r in recs)
+    row["ok"] = bool(row["det_preserved"] and clean
+                     and row["guard_trips_total"] == 0.0
+                     and row["steps"] == args.max_steps)
+    return row
+
+
+# --------------------------------------------------------------------------
+# --check: jax-free artifact re-verification (tools/check_artifacts.py)
+# --------------------------------------------------------------------------
+
+
+def check_artifact(path: str) -> int:
+    """Re-verify a committed wire_study.json: the roll-up, the per-row
+    detection pins, and the ledger arithmetic (bytes must match the
+    recorded dim — a stale ledger would misreport the item-4 win). Exits
+    nonzero naming the first failure."""
+    try:
+        with open(path) as fh:
+            data = json.load(fh)
+    except (OSError, ValueError) as e:
+        print(f"wire_study --check: cannot read {path}: {e}")
+        return 1
+    rows = data.get("rows", [])
+    want_cells = {(f, dt, k) for f in FAMILIES for dt in DTYPES for k in KS}
+    got_cells = {(r.get("family"), r.get("dtype"), r.get("k"))
+                 for r in rows}
+    if not want_cells <= got_cells:
+        print(f"wire_study --check: missing cells "
+              f"{sorted(want_cells - got_cells)}")
+        return 1
+    for r in rows:
+        cell = f"{r['family']}.{r['dtype']}.k{r['k']}"
+        w = r.get("wire") or {}
+        rows_per = 2 if r["family"] == "cyclic" else 1
+        dim = w.get("dim", 0)
+        per = w.get("bytes_per_worker", {})
+        if per.get("f32") != 4 * rows_per * dim \
+                or per.get("bf16") != 2 * rows_per * dim:
+            print(f"wire_study --check: {cell}: ledger bytes inconsistent "
+                  f"with dim={dim} ({per})")
+            return 1
+        if not (per.get("int8", 0) < per.get("bf16", 0)
+                < per.get("f32", 0)):
+            print(f"wire_study --check: {cell}: dtype ordering broken "
+                  f"({per})")
+            return 1
+        if r["dtype"] == "bf16" and not r.get("det_preserved"):
+            print(f"wire_study --check: {cell}: bf16 shadow lost "
+                  f"detection (det_preserved false) — the ISSUE 10 "
+                  f"acceptance pin")
+            return 1
+        if not r.get("ok"):
+            print(f"wire_study --check: {cell}: row not ok")
+            return 1
+    if not data.get("all_ok"):
+        print("wire_study --check: all_ok is false")
+        return 1
+    print(f"wire_study --check: {len(rows)} cells verified ({path})")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", type=str,
+                    default=os.path.join("baselines_out", "wire_study.json"))
+    ap.add_argument("--max-steps", type=int, default=12)
+    ap.add_argument("--shadow-round", type=str, default="nearest",
+                    choices=["nearest", "stochastic"])
+    ap.add_argument("--families", type=str, default="",
+                    help="comma-separated subset (default: all)")
+    ap.add_argument("--dtypes", type=str, default="",
+                    help="comma-separated subset of bf16,int8")
+    ap.add_argument("--ks", type=str, default="",
+                    help="comma-separated subset of 1,4")
+    ap.add_argument("--check", action="store_true",
+                    help="re-verify a committed artifact (jax-free)")
+    ap.add_argument("--artifact", type=str, default="",
+                    help="artifact path for --check (default --out)")
+    ap.add_argument("--cpu-mesh", type=int, default=0, metavar="N",
+                    help="force an N-device virtual CPU mesh")
+    args = ap.parse_args(argv)
+    if args.check:
+        return check_artifact(args.artifact or args.out)
+    from draco_tpu.cli import maybe_force_cpu_mesh
+
+    if args.cpu_mesh:
+        maybe_force_cpu_mesh(args)
+
+    from draco_tpu.data.datasets import load_dataset
+    from draco_tpu.runtime import make_mesh
+
+    families = [f for f in args.families.split(",") if f] or list(FAMILIES)
+    dtypes = [d for d in args.dtypes.split(",") if d] or list(DTYPES)
+    ks = [int(x) for x in args.ks.split(",") if x] or list(KS)
+    ds = load_dataset("synthetic-mnist", synthetic_train=512,
+                      synthetic_test=128)
+    mesh = make_mesh(NUM_WORKERS)
+    rows = []
+    for family in families:
+        for dtype in dtypes:
+            for k in ks:
+                row = run_cell(family, dtype, k, args, mesh, ds)
+                rows.append(row)
+                print(f"wire_study: {family:8s} {dtype:4s} k={k} -> "
+                      f"err_max={row['shadow_err_max']:.4g} "
+                      f"agree_min={row['shadow_flag_agree_min']} "
+                      f"det_shadow={row['det_precision_shadow']:.2f}/"
+                      f"{row['det_recall_shadow']:.2f} ok={row['ok']}",
+                      flush=True)
+
+    payload = {
+        "schema": 1,
+        "tool": "tools/wire_study.py",
+        "num_workers": NUM_WORKERS,
+        "max_steps": args.max_steps,
+        "shadow_round": args.shadow_round,
+        "rows": rows,
+        "all_ok": bool(rows) and all(r["ok"] for r in rows),
+    }
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+    with open(args.out, "w") as fh:
+        json.dump(payload, fh, indent=1, sort_keys=True)
+    print(f"wire_study: {len(rows)} cells -> {args.out} "
+          f"(all_ok={payload['all_ok']})")
+    return 0 if payload["all_ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
