@@ -100,6 +100,13 @@ def add_fit_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                    help="cyclic decode: one locator on the flat gradient, or "
                         "one per parameter tensor like the reference "
                         "(cyclic_master.py:125-129)")
+    p.add_argument("--decode-impl", type=str, default="auto",
+                   choices=["auto", "xla", "pallas"],
+                   help="coded-decode lowering (ops/decode_kernels.py): "
+                        "auto = fused Pallas kernels on TPU backends / "
+                        "historical XLA path elsewhere; xla pins the "
+                        "historical path; pallas selects the fused kernels "
+                        "(their reference XLA lowering off-TPU)")
     p.add_argument("--eval-freq", type=int, default=50)
     p.add_argument("--train-dir", type=str, default="./train_out/")
     p.add_argument("--checkpoint-step", type=int, default=0)
@@ -324,6 +331,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         redundancy=args.redundancy if args.redundancy is not None
         else ("shared" if args.approach == "approx" else "simulate"),
         decode_granularity=args.decode_granularity,
+        decode_impl=args.decode_impl,
         compute_dtype=args.compute_dtype,
         steps_per_call=args.steps_per_call,
         token_gen=args.token_gen,

@@ -64,6 +64,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from draco_tpu.coding import assignment as assign_mod
+from draco_tpu.coding import linalg as linalg_mod
 
 PREC = None  # the (n, n) solves are tiny; matmul default precision is fine
 
@@ -149,14 +150,19 @@ def decode_weights(code: ApproxCode, present: Optional[jnp.ndarray] = None):
     true zeros before the combining matmul).
     ``u`` (n,): the effective per-batch coverage W_Sᵀ v. ``bound``: the
     scalar ‖u − 1‖₂ — the analytic decode-error coefficient of
-    arXiv:2006.09638 for this arrival set."""
+    arXiv:2006.09638 for this arrival set.
+
+    Shared bit-for-bit by every ``decode_impl`` (ISSUE 12): the solve is
+    O(n³) on an (n, n) system — nothing to fuse — so the kernel path keeps
+    it as a prologue op (the kernel fuses the O(n·d) tail only) and the
+    equivalence suites compare decodes built from the identical v."""
     w = jnp.asarray(code.weights)
     n = code.n
     pres = (jnp.ones((n,), jnp.float32) if present is None
             else jnp.asarray(present).astype(jnp.float32))
     wp = w * pres[:, None]
     ones = jnp.ones((n,), jnp.float32)
-    v, _, _, _ = jnp.linalg.lstsq(wp.T, ones, rcond=DECODE_RCOND)
+    v = linalg_mod.truncated_lstsq(wp.T, ones, DECODE_RCOND)
     v = v * pres
     u = jnp.matmul(wp.T, v)
     bound = jnp.sqrt(jnp.sum((u - ones) ** 2))
@@ -177,7 +183,8 @@ def recovered_fraction(code: ApproxCode,
 
 def decode(code: ApproxCode, rows: jnp.ndarray,
            present: Optional[jnp.ndarray] = None,
-           with_health: bool = False, batch_grads: Optional[jnp.ndarray] = None):
+           with_health: bool = False, batch_grads: Optional[jnp.ndarray] = None,
+           impl: str = "xla"):
     """Partial-recovery decode: (n, d) received rows -> (d,) mean gradient.
 
     ``rows``: per-worker weighted partial sums; absent rows (``present``
@@ -196,7 +203,19 @@ def decode(code: ApproxCode, rows: jnp.ndarray,
     repo simulates the fleet in one SPMD program. That is the
     residual-vs-bound harness: the paper's guarantee refereed per step at
     zero extra fetches.
+
+    ``impl`` (ISSUE 12): ``"xla"`` is the historical lowering, bit-for-bit
+    unchanged. ``"fused"`` restructures the O(n·d) health passes (the
+    decode_impl="pallas" CPU fallback: the true-mean reduction becomes a
+    matvec and the residual algebra fuses into the same sweep — bounded-err
+    vs xla from accumulation order only) on the identical weight solve.
+    ``"pallas"`` runs the hand-tiled kernel
+    (ops/decode_kernels.approx_decode): mask, combine, true-mean and both
+    health norms in ONE pass over the (n, d) wire and gradient blocks.
     """
+    if impl != "xla":
+        return _decode_fused(code, rows, present, with_health, batch_grads,
+                             impl)
     v, u, bound = decode_weights(code, present)
     if present is not None:
         # true zero-fill, not multiplicative masking: a NaN payload in an
@@ -217,6 +236,49 @@ def decode(code: ApproxCode, rows: jnp.ndarray,
     residual = jnp.sqrt(jnp.sum((decoded - true_mean) ** 2)) / scale
     health = {
         "residual": residual,
+        "bound": bound,
+        "recovered_fraction": recovered_fraction(code, present),
+    }
+    return decoded, v, health
+
+
+def _decode_fused(code: ApproxCode, rows, present, with_health, batch_grads,
+                  impl: str):
+    """The fused decode (``decode`` docstring, impl != "xla"): the SAME
+    weight solve as the xla path (decode_weights — a bitwise-shared
+    prologue op), then the O(n·d) work either as the restructured XLA
+    sweep ("fused" — the CPU fallback) or the Pallas kernel
+    ("pallas"/"pallas_interpret"). Health semantics identical to the xla
+    path; only accumulation order differs."""
+    n = code.n
+    v, u, bound = decode_weights(code, present)
+    pres_b = (jnp.ones((n,), bool) if present is None
+              else jnp.asarray(present).astype(bool))
+    if not with_health:
+        rows_m = jnp.where(pres_b[:, None], rows, jnp.zeros_like(rows))
+        return jnp.matmul(v / n, rows_m), v
+    if batch_grads is None:
+        raise ValueError("with_health=True needs batch_grads (the (n, d) "
+                         "pre-mask batch-gradient matrix) to measure the "
+                         "residual against the true sum")
+    if impl in ("pallas", "pallas_interpret"):
+        from draco_tpu.ops import decode_kernels
+
+        decoded, sq_diff, sq_g = decode_kernels.approx_decode(
+            rows, batch_grads, v, pres_b,
+            interpret=(impl == "pallas_interpret"))
+    else:
+        rows_m = jnp.where(pres_b[:, None], rows, jnp.zeros_like(rows))
+        decoded = jnp.matmul(v / n, rows_m)
+        # true mean as a matvec (one BLAS pass instead of a strided
+        # axis-0 reduction) — same value, different accumulation order
+        true_mean = jnp.matmul(jnp.full((n,), 1.0 / n, jnp.float32),
+                               batch_grads)
+        sq_diff = jnp.sum((decoded - true_mean) ** 2)
+        sq_g = jnp.sum(batch_grads.astype(jnp.float32) ** 2)
+    scale = jnp.maximum(jnp.sqrt(sq_g) / n, 1e-30)
+    health = {
+        "residual": jnp.sqrt(sq_diff) / scale,
         "bound": bound,
         "recovered_fraction": recovered_fraction(code, present),
     }

@@ -35,6 +35,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from draco_tpu.coding import linalg as linalg_mod
 from draco_tpu.ops import coded as ops_coded
 
 PREC = jax.lax.Precision.HIGHEST
@@ -217,32 +218,11 @@ def encode_shared(code: CyclicCode, batch_grads: jnp.ndarray):
 # c_coding.cpp:15-84)
 # --------------------------------------------------------------------------
 
-def _complex_solve(a_re, a_im, b_re, b_im, rcond: float = 0.0):
-    """Solve complex A x = b via the real 2m×2m block embedding.
-
-    [[Ar, -Ai], [Ai, Ar]] [xr; xi] = [br; bi]. LU-based jnp.linalg.solve is
-    supported on TPU; the systems here are at most (n-2s) × (n-2s).
-
-    rcond > 0 switches to SVD-truncated least squares (singular values below
-    rcond·σmax zeroed), for systems that can be genuinely rank-deficient —
-    the error-locator Hankel system loses rank when fewer than s rows are
-    actually corrupt; the reference used an SVD least-squares there for the
-    same reason (c_coding.cpp:81). Unlike a fixed ridge, truncation leaves
-    full-rank systems exact, so corrupt-row locator magnitudes stay orders
-    of magnitude below honest rows' instead of being ridge-biased toward
-    them. SVD on the embedded system (not its gram) keeps the threshold
-    meaningful in f32: the gram squares the condition number.
-    """
-    m = a_re.shape[0]
-    top = jnp.concatenate([a_re, -a_im], axis=1)
-    bot = jnp.concatenate([a_im, a_re], axis=1)
-    big = jnp.concatenate([top, bot], axis=0)
-    rhs = jnp.concatenate([b_re, b_im], axis=0)
-    if rcond > 0.0:
-        x, _, _, _ = jnp.linalg.lstsq(big, rhs, rcond=rcond)
-    else:
-        x = jnp.linalg.solve(big, rhs)
-    return x[:m], x[m:]
+# The stacked-real-embedding complex solve moved to coding/linalg.py
+# (ISSUE 12 satellite: one shared home for the hand-rolled solvers, used
+# by both code families and the fused decode kernels' reference path).
+# Bit-identical ops — the XLA decode path stays bitwise.
+_complex_solve = linalg_mod.complex_solve
 
 
 def _locate_v(code: CyclicCode, e_re: jnp.ndarray, e_im: jnp.ndarray,
@@ -386,9 +366,152 @@ def _locate_v(code: CyclicCode, e_re: jnp.ndarray, e_im: jnp.ndarray,
     return v_full_re, v_full_im, honest, health
 
 
+def locator_core(e_re, e_im, c2h_re, c2h_im, c1_re, c1_im, est_re, est_im,
+                 pres_f, s: int, rel_tol: float = HEALTH_REL_TOL):
+    """Steps 2–5 of the decode + health, batched over projected columns —
+    the fused counterpart of :func:`_locate_v` (ISSUE 12 tentpole).
+
+    Identical math and identical health semantics, restructured for the
+    fused decode kernels: a leading batch axis (the per-layer projected
+    columns ``decode_layers`` vmaps over) and only the op set Mosaic
+    lowers inside a Pallas kernel body — the three separate
+    ``_complex_solve`` calls become one-sided Jacobi (the truncated
+    locator least squares, ``linalg.jacobi_lstsq``) plus ONE Gauss–Jordan
+    inverse of the honest-row submatrix that serves both the
+    recombination vector (row 0 of ``rec⁻¹``) and the health fit
+    (``rec⁻¹ e_sel``); ``top_k``/gather/median become pairwise-rank masks
+    and matmul compaction (coding/linalg.py). The Pallas kernel
+    (``ops/decode_kernels.cyclic_locator``) calls THIS function on its
+    VMEM blocks and the ``decode_impl="pallas"`` CPU fallback jits it on
+    the full (L, n) stack, so the two lowerings cannot drift
+    algorithmically. Against the XLA path the results are bounded-err
+    with identical flag/honest sets (the selection and flag margins are
+    orders of magnitude above the solver differences; the equivalence
+    suite pins both).
+
+    e_re, e_im: (bb, n) projected columns. pres_f: (1 or bb, n) f32
+    presence (all-ones when every row arrived). Returns
+    ``(v_re, v_im, honest, flagged, loud, residual)`` — the first five
+    (bb, n) with the v pair already carrying the 1/1 scale of
+    ``_locate_v`` (callers fold /n into it), ``residual`` (bb,).
+    """
+    bb, n = e_re.shape
+    m = n - 2 * s
+    pres_f = jnp.broadcast_to(pres_f, (bb, n))
+
+    if s > 0:
+        # 2. syndrome (bb, 2s): one complex matmul pair
+        e2_re = (jnp.matmul(e_re, c2h_re.T, precision=PREC)
+                 - jnp.matmul(e_im, c2h_im.T, precision=PREC))
+        e2_im = (jnp.matmul(e_re, c2h_im.T, precision=PREC)
+                 + jnp.matmul(e_im, c2h_re.T, precision=PREC))
+        # 3. Hankel system rows via STATIC slices (A[i, j] = E2[s-i-1+j],
+        #    b[i] = E2[2s-i-1]) — no gather, Mosaic constraint
+        a_re = jnp.stack(
+            [e2_re[:, s - 1 - i:2 * s - 1 - i] for i in range(s)], axis=1)
+        a_im = jnp.stack(
+            [e2_im[:, s - 1 - i:2 * s - 1 - i] for i in range(s)], axis=1)
+        b_re = jnp.concatenate(
+            [e2_re[:, 2 * s - 1 - i:2 * s - i] for i in range(s)], axis=1)
+        b_im = jnp.concatenate(
+            [e2_im[:, 2 * s - 1 - i:2 * s - i] for i in range(s)], axis=1)
+        # same scale-free normalisation as _locate_v
+        scale = jnp.sqrt(jnp.maximum(
+            jnp.max(e2_re ** 2 + e2_im ** 2, axis=1), 1e-60))[:, None]
+        big = jnp.concatenate([
+            jnp.concatenate([a_re, -a_im], axis=2),
+            jnp.concatenate([a_im, a_re], axis=2),
+        ], axis=1) / scale[:, :, None]
+        rhs = jnp.concatenate([b_re, b_im], axis=1) / scale
+        al = linalg_mod.jacobi_lstsq(big, rhs, LOCATOR_RCOND)  # (bb, 2s)
+        alpha_re, alpha_im = al[:, :s], al[:, s:]
+        # 4. locator polynomial evaluated on the DFT grid
+        poly_re = jnp.concatenate(
+            [-alpha_re, jnp.ones((bb, 1), e_re.dtype)], axis=1)
+        poly_im = jnp.concatenate(
+            [-alpha_im, jnp.zeros((bb, 1), e_re.dtype)], axis=1)
+        val_re = (jnp.matmul(poly_re, est_re.T, precision=PREC)
+                  - jnp.matmul(poly_im, est_im.T, precision=PREC))
+        val_im = (jnp.matmul(poly_re, est_im.T, precision=PREC)
+                  + jnp.matmul(poly_im, est_re.T, precision=PREC))
+        mag = val_re ** 2 + val_im ** 2
+    else:
+        mag = jnp.ones((bb, n), jnp.float32)
+
+    # deterministic tie-break (see _locate_v) + absent rows never eligible
+    bias = jax.lax.broadcasted_iota(jnp.float32, (bb, n), 1)
+    mag = mag + bias * ((1e-3 / n) * jnp.mean(mag, axis=1, keepdims=True))
+    mag = jnp.where(pres_f > 0, mag, -1.0)
+
+    # 5. honest set + recombination vector + health fit, through ONE
+    #    Gauss–Jordan inverse of the (m, m) honest-row submatrix
+    honest = linalg_mod.topk_mask(mag, m)  # (bb, n) bool
+    sel = linalg_mod.select_matrix(honest, m)  # (bb, m, n) f32
+    rec_re = jnp.matmul(sel.reshape(bb * m, n), c1_re,
+                        precision=PREC).reshape(bb, m, m)
+    rec_im = jnp.matmul(sel.reshape(bb * m, n), c1_im,
+                        precision=PREC).reshape(bb, m, m)
+    e_sel_re = jnp.sum(sel * e_re[:, None, :], axis=2)  # (bb, m)
+    e_sel_im = jnp.sum(sel * e_im[:, None, :], axis=2)
+    inv_re, inv_im = linalg_mod.gauss_inv_c(rec_re, rec_im)
+    # vᵀ rec = e1ᵀ  ⇒  v = row 0 of rec⁻¹, scattered back through sel
+    # (sliced, not integer-indexed: integer indexing lowers to a gather,
+    # which Mosaic cannot lower in the kernel body)
+    row0_re = inv_re[:, 0:1, :].reshape(bb, m, 1)
+    row0_im = inv_im[:, 0:1, :].reshape(bb, m, 1)
+    v_re = jnp.sum(row0_re * sel, axis=1)  # (bb, n)
+    v_im = jnp.sum(row0_im * sel, axis=1)
+    # health fit: q̂ = rec⁻¹ e_sel (the same inverse), codeword = C1 q̂
+    q_re = (jnp.sum(inv_re * e_sel_re[:, None, :], axis=2)
+            - jnp.sum(inv_im * e_sel_im[:, None, :], axis=2))
+    q_im = (jnp.sum(inv_re * e_sel_im[:, None, :], axis=2)
+            + jnp.sum(inv_im * e_sel_re[:, None, :], axis=2))
+    fit_re = (jnp.matmul(q_re, c1_re.T, precision=PREC)
+              - jnp.matmul(q_im, c1_im.T, precision=PREC))
+    fit_im = (jnp.matmul(q_re, c1_im.T, precision=PREC)
+              + jnp.matmul(q_im, c1_re.T, precision=PREC))
+    dev = (e_re - fit_re) ** 2 + (e_im - fit_im) ** 2
+    energy = e_re ** 2 + e_im ** 2
+    msq = (jnp.sum(energy * pres_f, axis=1)
+           / jnp.maximum(jnp.sum(pres_f, axis=1), 1.0))[:, None]
+    flagged = (dev > (rel_tol ** 2) * msq) & (pres_f > 0)
+    resid_sq = (jnp.sum(jnp.where(flagged, 0.0, dev) * pres_f, axis=1)
+                / jnp.maximum(jnp.sum(energy * pres_f, axis=1), 1e-30))
+    # loud-row forensics (LOUD_REL_TOL docstring): rank-selection median
+    # over present∧non-NaN rows matches _locate_v's nanmedian exactly
+    med = linalg_mod.masked_median(
+        energy, (pres_f > 0) & ~jnp.isnan(energy))[:, None]
+    loud = (energy > LOUD_REL_TOL * med) & (pres_f > 0)
+    return v_re, v_im, honest, flagged, loud, jnp.sqrt(resid_sq)
+
+
+def _run_locator(code: CyclicCode, e_re_l, e_im_l, present, rel_tol,
+                 impl: str):
+    """Dispatch the batched locator: ``fused`` = :func:`locator_core`
+    lowered through XLA (the decode_impl="pallas" CPU fallback),
+    ``pallas``/``pallas_interpret`` = the hand-tiled kernel
+    (ops/decode_kernels.cyclic_locator) running the same function on VMEM
+    blocks."""
+    n = code.n
+    pres_f = (jnp.ones((1, n), jnp.float32) if present is None
+              else jnp.asarray(present).astype(jnp.float32)[None, :])
+    if impl in ("pallas", "pallas_interpret"):
+        from draco_tpu.ops import decode_kernels
+
+        return decode_kernels.cyclic_locator(
+            code, e_re_l, e_im_l, pres_f, rel_tol,
+            interpret=(impl == "pallas_interpret"))
+    return locator_core(
+        e_re_l, e_im_l,
+        jnp.asarray(code.c2h_re), jnp.asarray(code.c2h_im),
+        jnp.asarray(code.c1_re), jnp.asarray(code.c1_im),
+        jnp.asarray(code.est_re), jnp.asarray(code.est_im),
+        pres_f, code.s, rel_tol)
+
+
 def decode(code: CyclicCode, r_re: jnp.ndarray, r_im: jnp.ndarray, rand_factor: jnp.ndarray,
            present: Optional[jnp.ndarray] = None, with_health: bool = False,
-           rel_tol: float = HEALTH_REL_TOL):
+           rel_tol: float = HEALTH_REL_TOL, impl: str = "xla"):
     """Recover the exact sum of the n batch gradients from corrupt rows.
 
     r_re, r_im: (n, d) received encoded rows (≤ s rows arbitrarily corrupt).
@@ -412,26 +535,63 @@ def decode(code: CyclicCode, r_re: jnp.ndarray, r_im: jnp.ndarray, rand_factor: 
     marking magnitude-outlier present rows — the forensic-only accusation
     signal, LOUD_REL_TOL) — in-graph values for the telemetry metric
     columns, backward-compatible 2-tuple otherwise.
+
+    ``impl`` selects the locator implementation (ISSUE 12): ``"xla"`` is
+    the historical lowering, bit-for-bit unchanged (the K∈{1,4} bitwise
+    suites run it); ``"fused"`` runs the batched :func:`locator_core`
+    through XLA (the decode_impl="pallas" CPU fallback — bounded-err vs
+    xla, identical honest/flag sets); ``"pallas"`` runs the hand-tiled
+    kernel (ops/decode_kernels, TPU backends). Both non-xla paths fold
+    the 1/n into the recombination vector.
     """
     n = code.n
     # 1. project to one column: e = R @ f  (the only O(n·d) work besides the
     #    final recombination — one fused pass over (R_re, R_im))
     e_re, e_im = ops_coded.complex_project(r_re, r_im, rand_factor)
-    v_full_re, v_full_im, honest, health = _locate_v(code, e_re, e_im,
-                                                     present, rel_tol)
-
-    # 6. recombine: Re(v^T R) / n — the second O(n·d) pass, fused
-    decoded = ops_coded.complex_recombine(v_full_re, v_full_im, r_re, r_im) / n
+    if impl == "xla":
+        v_full_re, v_full_im, honest, health = _locate_v(code, e_re, e_im,
+                                                         present, rel_tol)
+        # 6. recombine: Re(v^T R) / n — the second O(n·d) pass, fused
+        decoded = ops_coded.complex_recombine(v_full_re, v_full_im,
+                                              r_re, r_im) / n
+    else:
+        v_re, v_im, honest_l, flagged_l, loud_l, resid_l = _run_locator(
+            code, e_re[None, :], e_im[None, :], present, rel_tol, impl)
+        honest = honest_l[0]
+        health = {"residual": resid_l[0], "flagged": flagged_l[0],
+                  "loud": loud_l[0]}
+        decoded = ops_coded.complex_recombine(v_re[0] / n, v_im[0] / n,
+                                              r_re, r_im)
     if with_health:
         return decoded, honest, health
     return decoded, honest
+
+
+def _recombine_layers_fused(n: int, v_re_l, v_im_l, bounds, r_re, r_im):
+    """Per-layer recombination of the fused decode path (PERF.md §14):
+    same per-segment complex matvecs as the XLA path, but assembled by
+    dynamic_update_slice writes into one preallocated (d,) output instead
+    of a concatenate, and with the 1/n already folded into the v pair —
+    measured fastest of the in-jit assembly variants on XLA:CPU (the
+    gather- and broadcast-materialized (n, d) weight-matrix forms win as
+    standalone microbenches but fuse pathologically inside the full step
+    program). On TPU the same structure lets consecutive segment writes
+    land in place."""
+    del n  # shape-independent assembly (n rides in the operands)
+    segs = list(zip(bounds[:-1], bounds[1:]))
+    out = jnp.zeros((r_re.shape[1],), jnp.float32)
+    for i, (a, b) in enumerate(segs):
+        seg = ops_coded.complex_recombine(v_re_l[i], v_im_l[i],
+                                          r_re[:, a:b], r_im[:, a:b])
+        out = jax.lax.dynamic_update_slice(out, seg, (a,))
+    return out
 
 
 def decode_layers(code: CyclicCode, r_re: jnp.ndarray, r_im: jnp.ndarray,
                   rand_factor: jnp.ndarray, offsets,
                   present: Optional[jnp.ndarray] = None,
                   with_health: bool = False,
-                  rel_tol: float = HEALTH_REL_TOL):
+                  rel_tol: float = HEALTH_REL_TOL, impl: str = "xla"):
     """Layer-granularity decode — one locator per parameter tensor.
 
     The reference decodes each layer independently with its own random
@@ -451,6 +611,13 @@ def decode_layers(code: CyclicCode, r_re: jnp.ndarray, r_im: jnp.ndarray,
     combined decode-health dict — residual is the worst layer's (a single
     inconsistent layer is a fault), flagged is the union over layers (a row
     corrupted in any layer's coordinates is a located error).
+
+    ``impl`` as in :func:`decode`. This is the fused kernel's home regime
+    (ISSUE 12): the per-layer locators run as ONE batched
+    :func:`locator_core` call over the (L, n) projected-column stack —
+    a hand-tiled Pallas grid on TPU, one XLA program on CPU — instead of
+    L vmapped solver chains, and the per-layer recombination is re-tiled
+    per worker count (:func:`_recombine_layers_fused`).
     """
     n = code.n
     bounds = [int(o) for o in offsets]
@@ -463,17 +630,28 @@ def decode_layers(code: CyclicCode, r_re: jnp.ndarray, r_im: jnp.ndarray,
         e_ims.append(e_im)
     e_re_l = jnp.stack(e_res)  # (L, n)
     e_im_l = jnp.stack(e_ims)
-    v_re_l, v_im_l, honest_l, health_l = jax.vmap(
-        lambda er, ei: _locate_v(code, er, ei, present, rel_tol)
-    )(e_re_l, e_im_l)
-    parts = [
-        ops_coded.complex_recombine(v_re_l[i], v_im_l[i], r_re[:, a:b], r_im[:, a:b])
-        for i, (a, b) in enumerate(zip(bounds[:-1], bounds[1:]))
-    ]
-    decoded = jnp.concatenate(parts) / n
+    if impl == "xla":
+        v_re_l, v_im_l, honest_l, health_l = jax.vmap(
+            lambda er, ei: _locate_v(code, er, ei, present, rel_tol)
+        )(e_re_l, e_im_l)
+        parts = [
+            ops_coded.complex_recombine(v_re_l[i], v_im_l[i], r_re[:, a:b], r_im[:, a:b])
+            for i, (a, b) in enumerate(zip(bounds[:-1], bounds[1:]))
+        ]
+        decoded = jnp.concatenate(parts) / n
+        if with_health:
+            health = {"residual": jnp.max(health_l["residual"]),
+                      "flagged": jnp.any(health_l["flagged"], axis=0),
+                      "loud": jnp.any(health_l["loud"], axis=0)}
+            return decoded, honest_l, health
+        return decoded, honest_l
+    v_re_l, v_im_l, honest_l, flagged_l, loud_l, resid_l = _run_locator(
+        code, e_re_l, e_im_l, present, rel_tol, impl)
+    decoded = _recombine_layers_fused(n, v_re_l / n, v_im_l / n, bounds,
+                                      r_re, r_im)
     if with_health:
-        health = {"residual": jnp.max(health_l["residual"]),
-                  "flagged": jnp.any(health_l["flagged"], axis=0),
-                  "loud": jnp.any(health_l["loud"], axis=0)}
+        health = {"residual": jnp.max(resid_l),
+                  "flagged": jnp.any(flagged_l, axis=0),
+                  "loud": jnp.any(loud_l, axis=0)}
         return decoded, honest_l, health
     return decoded, honest_l

@@ -1,0 +1,344 @@
+"""Shared small-dense linear algebra for the coded decode paths.
+
+Two tiers, one home (ISSUE 12):
+
+**XLA tier** — the exact solvers the production ``decode_impl="xla"`` paths
+have always used, deduplicated here from their former copies:
+:func:`complex_solve` (cyclic's stacked-real-embedding solve, previously
+``coding/cyclic._complex_solve``) and :func:`truncated_lstsq` (the
+rcond-truncated SVD least squares both that embedding and the approx
+family's where-masked optimal-decoding solve call). Bit-for-bit the ops the
+callers inlined before; the K∈{1,4} bitwise equivalence suites pin that.
+
+**Fused tier** — the same math re-derived for the fused decode kernels
+(``ops/decode_kernels.py``): batched over a leading axis and restricted to
+the op set Mosaic (the Pallas TPU compiler) lowers inside a kernel body —
+no ``lax.linalg`` custom calls, no ``sort``/``top_k``/``gather``/``scatter``,
+no traced-index slicing (Mosaic has no ``dynamic_slice``); everything is
+matmuls, elementwise algebra, ``broadcasted_iota`` masks and
+``fori_loop``-carried tensors. Each fused primitive is used twice: the
+Pallas kernels call it on VMEM blocks, and the kernels' REFERENCE path
+(the ``decode_impl="pallas"`` CPU fallback, coding/cyclic.py §fused) jits
+the identical function on full arrays — so the interpret-mode kernel tests
+and the reference path cannot drift algorithmically.
+
+  truncated least squares   :func:`jacobi_lstsq` — one-sided Jacobi SVD,
+                            fixed sweep count (quadratic convergence; the
+                            systems are ≤ 2s×2s ≤ 10×10). Works on A
+                            directly, NOT its gram: the gram squares the
+                            condition number and f32 gram eigenvalues below
+                            ~1e-7·λmax are noise, which would put the
+                            rcond=1e-5 locator cutoff (λ cutoff 1e-10)
+                            under the noise floor — the exact failure the
+                            XLA tier's docstring warns about.
+  square complex solve      :func:`gauss_inv_c` — Gauss–Jordan inverse
+                            with partial pivoting on the complex modulus,
+                            carried as (re, im) pairs. One inversion serves
+                            both decode solves: the recombination vector is
+                            ROW 0 of ``rec⁻¹`` (vᵀrec = e1ᵀ ⇒ v = first row)
+                            and the health fit is ``rec⁻¹ e_sel`` — the XLA
+                            tier pays two separate LU solves for these.
+  honest-row top-k          :func:`topk_mask` — pairwise-comparison ranks
+                            (n ≤ 64, the (n, n) bool block is nothing);
+                            ties break toward the lower index, matching
+                            ``lax.top_k``.
+  masked compaction         :func:`select_matrix` — the top-k rows as an
+                            (m, n) 0/1 selection matrix (cumsum via a
+                            triangular matmul), so "gather the honest rows
+                            of C1" becomes an MXU matmul instead of a
+                            gather.
+  masked median             :func:`masked_median` — rank-selection median
+                            over a masked axis, matching ``jnp.nanmedian``
+                            over present∧finite entries (the cyclic loud-row
+                            threshold's statistic).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# ---------------------------------------------------------------------------
+# XLA tier — the exact production solvers, deduplicated (ISSUE 12 satellite)
+# ---------------------------------------------------------------------------
+
+
+def truncated_lstsq(a: jnp.ndarray, b: jnp.ndarray, rcond: float):
+    """rcond-truncated SVD least squares (singular values below
+    ``rcond·σmax`` zeroed), the shared primitive of the cyclic locator
+    solve (via :func:`complex_solve`) and the approx family's where-masked
+    optimal-decoding solve (coding/approx.decode_weights). Unlike a fixed
+    ridge, truncation leaves full-rank systems f32-exact while keeping
+    genuinely rank-deficient ones NaN-free — both call sites depend on
+    exactly that (cyclic's < s-corrupt locator, approx's whole-cluster
+    absences)."""
+    x, _, _, _ = jnp.linalg.lstsq(a, b, rcond=rcond)
+    return x
+
+
+def complex_solve(a_re, a_im, b_re, b_im, rcond: float = 0.0):
+    """Solve complex A x = b via the real 2m×2m block embedding.
+
+    [[Ar, -Ai], [Ai, Ar]] [xr; xi] = [br; bi]. LU-based jnp.linalg.solve is
+    supported on TPU; the systems here are at most (n-2s) × (n-2s).
+
+    rcond > 0 switches to the SVD-truncated least squares
+    (:func:`truncated_lstsq`), for systems that can be genuinely
+    rank-deficient — the error-locator Hankel system loses rank when fewer
+    than s rows are actually corrupt; the reference used an SVD
+    least-squares there for the same reason (c_coding.cpp:81). SVD on the
+    embedded system (not its gram) keeps the threshold meaningful in f32:
+    the gram squares the condition number.
+
+    (Moved verbatim from ``coding/cyclic._complex_solve`` — the XLA decode
+    path must stay bitwise.)
+    """
+    m = a_re.shape[0]
+    top = jnp.concatenate([a_re, -a_im], axis=1)
+    bot = jnp.concatenate([a_im, a_re], axis=1)
+    big = jnp.concatenate([top, bot], axis=0)
+    rhs = jnp.concatenate([b_re, b_im], axis=0)
+    if rcond > 0.0:
+        x = truncated_lstsq(big, rhs, rcond)
+    else:
+        x = jnp.linalg.solve(big, rhs)
+    return x[:m], x[m:]
+
+
+# ---------------------------------------------------------------------------
+# Fused tier — Mosaic-lowerable batched primitives (leading axis = batch)
+# ---------------------------------------------------------------------------
+
+# One-sided Jacobi sweep count. Convergence is quadratic in sweeps; the
+# largest system any caller builds is the 2s×2s embedded locator (2s ≤ 10
+# at the n=32 s=5 construction ceiling), where 12 cyclic sweeps leave
+# off-diagonal mass below f32 noise with a wide margin. Fixed (never
+# data-dependent) so the op graph is shape-static.
+JACOBI_SWEEPS = 12
+
+# Guard against 0/0 in rotation/normalization algebra on exactly-zero
+# columns (an all-absent syndrome block is legitimately the zero matrix).
+_TINY = 1e-30
+
+
+def _i2(shape, dim):
+    return jax.lax.broadcasted_iota(jnp.int32, shape, dim)
+
+
+def _col(a, j):
+    """Static column j of a (b, m, k) batch as (b, m) — static-strided
+    slice, no dynamic_slice (Mosaic constraint)."""
+    return a[:, :, j]
+
+
+def _set_col(a, j, new):
+    """Mask-based static column write: a[:, :, j] = new, Mosaic-safe."""
+    return jnp.where(_i2(a.shape, 2) == j, new[:, :, None], a)
+
+
+def jacobi_lstsq(a: jnp.ndarray, b: jnp.ndarray, rcond: float,
+                 sweeps: int = JACOBI_SWEEPS):
+    """Truncated least squares ``min ‖A x − b‖`` via one-sided Jacobi SVD.
+
+    a: (bb, m, m) real, b: (bb, m) — returns x (bb, m) with singular
+    directions below ``rcond·σmax`` dropped, the fused-tier counterpart of
+    :func:`truncated_lstsq` (same cutoff semantics; σ come out of the
+    rotations at high relative accuracy because the gram is never formed).
+
+    One-sided Jacobi: rotate column pairs of A (accumulating the rotations
+    in V) until columns are mutually orthogonal — then A·V = W with
+    ``WᵀW = diag(σ²)``, and x = V Σ⁻² Wᵀ b restricted to kept σ. The pair
+    loop is a static python loop (m ≤ 10), every update a masked
+    elementwise op — no traced indexing anywhere.
+    """
+    bb, m, _ = a.shape
+    v0 = jnp.broadcast_to(
+        (_i2((bb, m, m), 1) == _i2((bb, m, m), 2)).astype(a.dtype),
+        (bb, m, m))
+
+    def sweep(_, carry):
+        w, v = carry
+        for p in range(m - 1):
+            for q in range(p + 1, m):
+                wp, wq = _col(w, p), _col(w, q)
+                alpha = jnp.sum(wp * wp, axis=1)
+                beta = jnp.sum(wq * wq, axis=1)
+                gamma = jnp.sum(wp * wq, axis=1)
+                # rotation annihilating the (p, q) off-diagonal of WᵀW:
+                # branchless — |γ| ≈ 0 degrades to the identity rotation
+                live = jnp.abs(gamma) > _TINY
+                g_safe = jnp.where(live, gamma, 1.0)
+                zeta = (beta - alpha) / (2.0 * g_safe)
+                # NB not jnp.sign: equal column norms give ζ = 0 where the
+                # optimal rotation is 45° (t = 1) — sign(0) = 0 would skip it
+                sgn = jnp.where(zeta >= 0.0, 1.0, -1.0)
+                t = sgn / (jnp.abs(zeta) + jnp.sqrt(1.0 + zeta * zeta))
+                t = jnp.where(live, t, 0.0)
+                c = 1.0 / jnp.sqrt(1.0 + t * t)
+                s = c * t
+                c_ = c[:, None]
+                s_ = s[:, None]
+                new_wp = c_ * wp - s_ * wq
+                new_wq = s_ * wp + c_ * wq
+                w = _set_col(_set_col(w, p, new_wp), q, new_wq)
+                vp, vq = _col(v, p), _col(v, q)
+                new_vp = c_ * vp - s_ * vq
+                new_vq = s_ * vp + c_ * vq
+                v = _set_col(_set_col(v, p, new_vp), q, new_vq)
+        return w, v
+
+    # sweeps under ONE fori_loop: the pair loop must stay unrolled (static
+    # column slicing) but the sweep body is identical each pass — carrying
+    # it keeps the op graph sweeps× smaller, which is the difference
+    # between a seconds and a minutes XLA:CPU compile at n=32
+    w, v = jax.lax.fori_loop(0, sweeps, sweep, (a, v0))
+    sig2 = jnp.sum(w * w, axis=1)  # (bb, m) = σ²
+    sig2max = jnp.max(sig2, axis=1, keepdims=True)
+    keep = sig2 > (rcond * rcond) * sig2max
+    wtb = jnp.sum(w * b[:, :, None], axis=1)  # (bb, m) = Wᵀ b
+    coef = jnp.where(keep, wtb / jnp.maximum(sig2, _TINY), 0.0)
+    return jnp.sum(v * coef[:, None, :], axis=2)  # V @ coef
+
+
+def gauss_inv_c(a_re: jnp.ndarray, a_im: jnp.ndarray):
+    """Batched complex matrix inverse via Gauss–Jordan with partial
+    pivoting on the complex modulus, carried as (re, im) pairs.
+
+    a_re, a_im: (bb, m, m). Returns (inv_re, inv_im). Every step is
+    mask-based (iota one-hots select/ swap/ update rows), the pivot row is
+    the max-|a|² row at or below the diagonal with lowest-index tie-break,
+    and the m-step elimination runs under one ``fori_loop`` — the whole
+    inverse is elementwise algebra Mosaic lowers in-kernel. The decode
+    callers invert the honest-row DFT submatrix, full-rank by construction
+    (any n−2s distinct rows of the C1 Vandermonde are independent).
+    """
+    bb, m, _ = a_re.shape
+    shape = (bb, m, m)
+    eye = (_i2(shape, 1) == _i2(shape, 2)).astype(a_re.dtype)
+    eye = jnp.broadcast_to(eye, shape)
+    inv_re = eye
+    inv_im = jnp.zeros(shape, a_re.dtype)
+
+    def rows_get(t, rowsel):
+        return jnp.sum(t * rowsel, axis=1, keepdims=True)  # (bb, 1, m)
+
+    def body(k, carry):
+        a_re, a_im, inv_re, inv_im = carry
+        csel = (_i2(shape, 2) == k).astype(a_re.dtype)
+        col_re = jnp.sum(a_re * csel, axis=2)  # (bb, m)
+        col_im = jnp.sum(a_im * csel, axis=2)
+        mod = col_re * col_re + col_im * col_im
+        # f32 row indices (exact: m ≤ 64) — Mosaic has no integer reductions
+        rowix = _i2((bb, m), 1).astype(a_re.dtype)
+        kf = jnp.float32(1.0) * k
+        mod = jnp.where(rowix >= kf, mod, -1.0)  # eliminated rows ineligible
+        mx = jnp.max(mod, axis=1, keepdims=True)
+        is_max = mod == mx
+        # lowest-index argmax, branchless
+        r = jnp.min(jnp.where(is_max, rowix, float(m)), axis=1)  # (bb,)
+        rsel_k = (_i2(shape, 1) == k).astype(a_re.dtype)
+        rsel_r = (_i2(shape, 1).astype(a_re.dtype)
+                  == r[:, None, None]).astype(a_re.dtype)
+
+        def swap(t):
+            row_k = rows_get(t, rsel_k)
+            row_r = rows_get(t, rsel_r)
+            return t + rsel_k * (row_r - row_k) + rsel_r * (row_k - row_r)
+
+        a_re, a_im = swap(a_re), swap(a_im)
+        inv_re, inv_im = swap(inv_re), swap(inv_im)
+
+        # pivot = a[k, k]; scale row k by 1/pivot (complex reciprocal)
+        p_re = jnp.sum(a_re * rsel_k * csel[:, :m, :], axis=(1, 2))
+        p_im = jnp.sum(a_im * rsel_k * csel[:, :m, :], axis=(1, 2))
+        pm = jnp.maximum(p_re * p_re + p_im * p_im, _TINY)
+        ip_re = (p_re / pm)[:, None, None]
+        ip_im = (-p_im / pm)[:, None, None]
+        rk_re = rows_get(a_re, rsel_k)
+        rk_im = rows_get(a_im, rsel_k)
+        ik_re = rows_get(inv_re, rsel_k)
+        ik_im = rows_get(inv_im, rsel_k)
+        srk_re = rk_re * ip_re - rk_im * ip_im
+        srk_im = rk_re * ip_im + rk_im * ip_re
+        sik_re = ik_re * ip_re - ik_im * ip_im
+        sik_im = ik_re * ip_im + ik_im * ip_re
+
+        # eliminate column k from every other row
+        f_re = jnp.where(rowix == k, 0.0, jnp.sum(a_re * csel, axis=2))
+        f_im = jnp.where(rowix == k, 0.0, jnp.sum(a_im * csel, axis=2))
+        f_re = f_re[:, :, None]
+        f_im = f_im[:, :, None]
+        a_re2 = a_re - (f_re * srk_re - f_im * srk_im)
+        a_im2 = a_im - (f_re * srk_im + f_im * srk_re)
+        inv_re2 = inv_re - (f_re * sik_re - f_im * sik_im)
+        inv_im2 = inv_im - (f_re * sik_im + f_im * sik_re)
+        isrow = _i2(shape, 1) == k
+        a_re2 = jnp.where(isrow, srk_re, a_re2)
+        a_im2 = jnp.where(isrow, srk_im, a_im2)
+        inv_re2 = jnp.where(isrow, sik_re, inv_re2)
+        inv_im2 = jnp.where(isrow, sik_im, inv_im2)
+        return a_re2, a_im2, inv_re2, inv_im2
+
+    _, _, inv_re, inv_im = jax.lax.fori_loop(
+        0, m, body, (a_re, a_im, inv_re, inv_im))
+    return inv_re, inv_im
+
+
+def topk_mask(mag: jnp.ndarray, m: int):
+    """Bool mask of the top-m entries per batch row of mag (bb, n), by
+    pairwise-comparison rank — no sort, no top_k (Mosaic constraint). Ties
+    break toward the lower index (``lax.top_k``'s preference), though the
+    cyclic locator's index-monotone bias makes exact ties unreachable."""
+    gt = (mag[:, None, :] > mag[:, :, None]) | (
+        (mag[:, None, :] == mag[:, :, None])
+        & (_i2((mag.shape[0],) + mag.shape[1:] * 2, 2)
+           < _i2((mag.shape[0],) + mag.shape[1:] * 2, 1)))
+    # f32 count (exact: n ≤ 64) — Mosaic has no integer reductions
+    rank = jnp.sum(gt.astype(jnp.float32), axis=2)  # entries ahead of i
+    return rank < float(m)
+
+
+def select_matrix(mask: jnp.ndarray, m: int):
+    """The (bb, m, n) 0/1 compaction matrix of a (bb, n) bool mask with
+    exactly m set lanes per row: S[r, i] = 1 iff i is the r-th set lane.
+    ``S @ X`` then gathers the selected rows of X as a matmul — the MXU
+    replacement for a gather Mosaic cannot lower. Cumsum comes from a
+    triangular-matrix matmul (built from iota, so no host constant)."""
+    bb, n = mask.shape
+    mf = mask.astype(jnp.float32)
+    tri = (_i2((n, n), 0) <= _i2((n, n), 1)).astype(jnp.float32)
+    pos = jnp.dot(mf, tri,
+                  preferred_element_type=jnp.float32) - 1.0  # (bb, n)
+    shape = (bb, m, n)
+    sel = (jnp.broadcast_to(pos[:, None, :], shape)
+           == _i2(shape, 1).astype(jnp.float32))
+    return jnp.where(jnp.broadcast_to(mask[:, None, :], shape), sel,
+                     False).astype(jnp.float32)
+
+
+def masked_median(x: jnp.ndarray, mask: jnp.ndarray):
+    """Median of x (bb, n) over the lanes where mask (bb, n) is True —
+    rank-selection (average of the two middle order statistics for even
+    counts), matching ``jnp.nanmedian`` over the masked entries. All-False
+    rows return NaN, like nanmedian of an all-NaN slice. Non-finite x
+    lanes must be excluded by the caller's mask; masked-out lanes are
+    value-sanitized so a NaN there cannot leak through the 0·NaN trap."""
+    bb, n = x.shape
+    mf = mask.astype(x.dtype)
+    xs = jnp.where(mask, x, 0.0)
+    shape = (bb, n, n)
+    lt = (xs[:, None, :] < xs[:, :, None]) | (
+        (xs[:, None, :] == xs[:, :, None]) & (_i2(shape, 2) < _i2(shape, 1)))
+    lt = lt & jnp.broadcast_to(mask[:, None, :], shape)
+    # f32 counts (exact: n ≤ 64) — Mosaic has no integer reductions
+    rank = jnp.sum(lt.astype(jnp.float32), axis=2)  # (bb, n) masked rank
+    p = jnp.sum(mf, axis=1, keepdims=True)  # (bb, 1)
+    k1 = jnp.floor((p - 1.0) * 0.5)
+    k2 = jnp.floor(p * 0.5)
+
+    def at_rank(k):
+        hit = (rank == k) & mask
+        return jnp.sum(jnp.where(hit, xs, 0.0), axis=1)
+
+    med = 0.5 * (at_rank(k1) + at_rank(k2))
+    return jnp.where(p[:, 0] > 0, med, jnp.nan)

@@ -115,6 +115,17 @@ class TrainConfig:
     # "layer" re-runs the locator per layer like the reference
     # (cyclic_master.py:126-128).
     decode_granularity: str = "global"
+    # Decode implementation (ISSUE 12; ops/decode_kernels.py). "auto":
+    # the fused Pallas decode kernels on TPU backends, the historical XLA
+    # lowering elsewhere — CI and CPU runs keep today's bitwise path.
+    # "xla": pin the historical lowering everywhere. "pallas": the fused
+    # kernels where a TPU can run them, their reference lowering (the
+    # same fused algorithm through XLA — bounded-err vs xla, identical
+    # honest/flag sets) on other backends. Applies to the cyclic locator
+    # chain and the approx partial-recovery decode on every route; the
+    # shadow-quantized decode (obs/numerics.py) stays on the xla path its
+    # thresholds were calibrated on.
+    decode_impl: str = "auto"
 
     # --- long context / sequence parallelism (TPU-native addition; the
     # reference is CNN-only, SURVEY.md §5.7) ---
@@ -526,6 +537,10 @@ class TrainConfig:
         if self.decode_granularity not in ("global", "layer"):
             raise ValueError(
                 f"decode_granularity must be global|layer, got {self.decode_granularity}"
+            )
+        if self.decode_impl not in ("auto", "xla", "pallas"):
+            raise ValueError(
+                f"decode_impl must be auto|xla|pallas, got {self.decode_impl}"
             )
         if self.redundancy not in ("simulate", "shared"):
             raise ValueError(f"redundancy must be simulate|shared, got {self.redundancy}")

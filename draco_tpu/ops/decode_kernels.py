@@ -1,0 +1,300 @@
+"""Fused coded-decode kernels — the second and third Pallas TPU kernels
+(ISSUE 12, ROADMAP item 4).
+
+PR 9's committed device ledger puts the coded decode at 17–25% of LM
+device time (4.5–13.5% CNN) at CI shapes — the largest non-matmul phase,
+and the one that grows with n as the flat aggregation point ingests more
+codewords. Two kernels attack it:
+
+``cyclic_locator``
+    Steps 2–5 of the cyclic decode — syndrome matmuls → Hankel locator
+    solve → honest-row top-k → recombination-vector solve → fitted-codeword
+    health residual — fused into one kernel, vmapped over per-layer
+    projected columns via the grid: each grid step loads an (8, n) block
+    of the (L, n) projected-column stack into VMEM and runs the whole
+    locator chain on it (``coding/cyclic.locator_core`` — the SAME
+    function the CPU reference path jits, so the two lowerings cannot
+    drift), instead of round-tripping ~6 solver ops per layer through HBM.
+    The in-graph health/forensics columns (residual, flagged, loud,
+    honest) are KERNEL OUTPUTS — observability is part of the contract,
+    not a casualty of fusion.
+
+``approx_decode``
+    The approx family's partial-recovery decode tail: where-mask →
+    combine-matvec → true-mean → residual-vs-bound norms, fused into ONE
+    pass over the (n, d) wire and batch-gradient blocks (the XLA path
+    pays ≥ 4 separate HBM sweeps for the same numbers). The d axis is the
+    grid; per-row presence masking (true zeros — a NaN payload survives
+    multiplicative masking), the decode matvec, the true-mean matvec and
+    both squared-norm accumulators live in VMEM, with 128-lane partial
+    sums accumulated across sequential grid steps (the
+    ``ops/coded._project_kernel`` accumulator pattern).
+
+Dispatch (``resolve_decode_impl``): ``cfg.decode_impl = "auto"`` keeps
+today's XLA lowering off-TPU and selects the kernels on TPU backends;
+``"pallas"`` selects the kernels where they can run and otherwise falls
+back to their reference lowering (the same fused algorithm through XLA —
+coding/cyclic.locator_core / coding/approx._decode_fused), which is what
+the committed CPU-container artifacts measure (PERF.md §14); ``"xla"``
+pins the historical path bit-for-bit. Interpret mode covers the kernel
+bodies in CI without a TPU, and the registered lint rows export the
+pallas_call programs for the TPU platform, so the Python-side Mosaic
+lowering is exercised on every CI run (the tpu_attn_lowering_check
+methodology).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu  # noqa: F401  (parity w/ ops)
+
+from draco_tpu.ops.coded import TILE_D, _pad_d, use_pallas
+
+# Layers per cyclic-locator grid step: the f32 sublane tile. The (L, n)
+# projected-column stack is padded up to a multiple of this; padded layers
+# run the locator on zero columns (harmlessly — the truncated solves are
+# zero-safe) and the wrapper slices them away.
+LAYER_BLOCK = 8
+
+
+def resolve_decode_impl(value: str, backend_pallas=None) -> str:
+    """cfg.decode_impl -> the coding-layer ``impl`` tag (static per
+    process: dispatch depends only on the attached backend, so the jitted
+    step programs close over the result — no retraces).
+
+      auto    pallas on TPU backends, xla elsewhere (the default: CI and
+              CPU fallbacks keep today's bitwise path)
+      xla     the historical lowering, everywhere
+      pallas  the kernels on TPU; their fused reference lowering (same
+              algorithm through XLA) elsewhere — the CPU-container cells
+              of the committed artifacts run this fallback
+    """
+    if backend_pallas is None:
+        backend_pallas = use_pallas()
+    if value == "xla":
+        return "xla"
+    if value == "auto":
+        return "pallas" if backend_pallas else "xla"
+    if value == "pallas":
+        return "pallas" if backend_pallas else "fused"
+    raise ValueError(f"decode_impl must be auto|xla|pallas, got {value!r}")
+
+
+# ---------------------------------------------------------------------------
+# cyclic: fused locator (steps 2-5), grid over per-layer projected columns
+# ---------------------------------------------------------------------------
+
+
+def _cyclic_locator_kernel(s, rel_tol, e_re_ref, e_im_ref, c2h_re_ref,
+                           c2h_im_ref, c1_re_ref, c1_im_ref, est_re_ref,
+                           est_im_ref, pres_ref, v_re_ref, v_im_ref,
+                           honest_ref, flagged_ref, loud_ref, resid_ref):
+    from draco_tpu.coding import cyclic as cyclic_mod
+
+    v_re, v_im, honest, flagged, loud, resid = cyclic_mod.locator_core(
+        e_re_ref[...], e_im_ref[...], c2h_re_ref[...], c2h_im_ref[...],
+        c1_re_ref[...], c1_im_ref[...], est_re_ref[...], est_im_ref[...],
+        pres_ref[...], s, rel_tol)
+    v_re_ref[...] = v_re
+    v_im_ref[...] = v_im
+    honest_ref[...] = honest.astype(jnp.float32)
+    flagged_ref[...] = flagged.astype(jnp.float32)
+    loud_ref[...] = loud.astype(jnp.float32)
+    # per-layer scalar, lane-broadcast to satisfy the block tiling (the
+    # wrapper keeps lane 0) — the flash kernel's lse layout
+    resid_ref[...] = jnp.broadcast_to(resid[:, None], resid_ref.shape)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("s", "rel_tol", "interpret"))
+def _cyclic_locator_pallas(e_re_l, e_im_l, c2h_re, c2h_im, c1_re, c1_im,
+                           est_re, est_im, pres_f, s, rel_tol, interpret):
+    L, n = e_re_l.shape
+    lp = -(-L // LAYER_BLOCK) * LAYER_BLOCK
+    if lp != L:
+        pad = [(0, lp - L), (0, 0)]
+        e_re_l = jnp.pad(e_re_l, pad)
+        e_im_l = jnp.pad(e_im_l, pad)
+    grid = (lp // LAYER_BLOCK,)
+    row = lambda i: (i, 0)  # noqa: E731
+    whole = lambda i: (0, 0)  # noqa: E731
+    blk = (LAYER_BLOCK, n)
+    out = pl.pallas_call(
+        functools.partial(_cyclic_locator_kernel, s, rel_tol),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(blk, row),
+            pl.BlockSpec(blk, row),
+            pl.BlockSpec(c2h_re.shape, whole),
+            pl.BlockSpec(c2h_im.shape, whole),
+            pl.BlockSpec(c1_re.shape, whole),
+            pl.BlockSpec(c1_im.shape, whole),
+            pl.BlockSpec(est_re.shape, whole),
+            pl.BlockSpec(est_im.shape, whole),
+            pl.BlockSpec((1, n), whole),
+        ],
+        out_specs=[pl.BlockSpec(blk, row)] * 6,
+        out_shape=[jax.ShapeDtypeStruct((lp, n), jnp.float32)] * 6,
+        interpret=interpret,
+    )(e_re_l, e_im_l, c2h_re, c2h_im, c1_re, c1_im, est_re, est_im, pres_f)
+    v_re, v_im, honest, flagged, loud, resid = out
+    return (v_re[:L], v_im[:L], honest[:L] > 0.5, flagged[:L] > 0.5,
+            loud[:L] > 0.5, resid[:L, 0])
+
+
+def cyclic_locator(code, e_re_l, e_im_l, pres_f, rel_tol,
+                   interpret: bool = False):
+    """Kernel entry used by ``coding/cyclic._run_locator``: (L, n)
+    projected-column stack -> the locator outputs of
+    ``coding/cyclic.locator_core`` (v pair, honest/flagged/loud masks,
+    per-layer residual). ``pres_f``: (1, n) f32 presence row shared by
+    every layer."""
+    return _cyclic_locator_pallas(
+        e_re_l, e_im_l,
+        jnp.asarray(code.c2h_re), jnp.asarray(code.c2h_im),
+        jnp.asarray(code.c1_re), jnp.asarray(code.c1_im),
+        jnp.asarray(code.est_re), jnp.asarray(code.est_im),
+        jnp.asarray(pres_f), code.s, float(rel_tol), interpret)
+
+
+# ---------------------------------------------------------------------------
+# approx: fused partial-recovery decode tail, grid over d tiles
+# ---------------------------------------------------------------------------
+
+
+def _approx_decode_kernel(d, n, rows_ref, bg_ref, vn_ref, pres_ref,
+                          dec_ref, sqd_ref, sqg_ref):
+    j = pl.program_id(0)
+
+    @pl.when(j == 0)
+    def _init():
+        sqd_ref[...] = jnp.zeros_like(sqd_ref)
+        sqg_ref[...] = jnp.zeros_like(sqg_ref)
+
+    base = j * TILE_D
+    cols = base + jax.lax.broadcasted_iota(jnp.int32, (1, TILE_D), 1)
+    live = (cols < d).astype(jnp.float32)  # ragged edge tile mask
+    pres = pres_ref[...][:, :1]  # (n, 1) — lane 0 of the broadcast block
+    # true zero-fill of absent rows (0·NaN = NaN through the matvec —
+    # multiplicative masking alone would pass a NaN payload)
+    rows = jnp.where(pres > 0, rows_ref[...], 0.0) * live
+    bg = bg_ref[...] * live
+    decoded = jnp.dot(vn_ref[...], rows,
+                      preferred_element_type=jnp.float32)  # (1, T), Σv/n·row
+    true_mean = jnp.dot(jnp.full((1, n), 1.0 / n, jnp.float32), bg,
+                        preferred_element_type=jnp.float32)
+    dec_ref[...] = decoded
+    diff2 = (decoded - true_mean) ** 2
+    sqd_ref[...] += diff2.reshape(TILE_D // 128, 128).sum(
+        axis=0, keepdims=True)
+    sqg_ref[...] += (bg * bg).reshape(n, TILE_D // 128, 128).sum(
+        axis=(0, 1))[None, :]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _approx_decode_pallas(rows, bg, v_over_n, pres_wide, interpret):
+    n, d = rows.shape
+    rows_p = _pad_d(rows, TILE_D)
+    bg_p = _pad_d(bg, TILE_D)
+    dp = rows_p.shape[-1]
+    grid = (dp // TILE_D,)
+    whole = lambda j: (0, 0)  # noqa: E731
+    decoded, sqd, sqg = pl.pallas_call(
+        functools.partial(_approx_decode_kernel, d, n),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((n, TILE_D), lambda j: (0, j)),
+            pl.BlockSpec((n, TILE_D), lambda j: (0, j)),
+            pl.BlockSpec((1, n), whole),
+            pl.BlockSpec((n, 128), whole),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, TILE_D), lambda j: (0, j)),
+            pl.BlockSpec((1, 128), whole),
+            pl.BlockSpec((1, 128), whole),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, dp), jnp.float32),
+            jax.ShapeDtypeStruct((1, 128), jnp.float32),
+            jax.ShapeDtypeStruct((1, 128), jnp.float32),
+        ],
+        interpret=interpret,
+    )(rows_p, bg_p, v_over_n, pres_wide)
+    return decoded[0, :d], jnp.sum(sqd), jnp.sum(sqg)
+
+
+def approx_decode(rows, batch_grads, v, pres_b, interpret: bool = False):
+    """Kernel entry used by ``coding/approx._decode_fused``: one fused
+    pass over the (n, d) wire + gradient blocks. Returns
+    ``(decoded (d,), Σ(decoded − true_mean)², Σ batch_grads²)`` — the
+    caller folds the two scalars into the residual-vs-bound health."""
+    n = rows.shape[0]
+    pres_wide = jnp.broadcast_to(
+        jnp.asarray(pres_b).astype(jnp.float32)[:, None], (n, 128))
+    return _approx_decode_pallas(rows, batch_grads, (v / n)[None, :],
+                                 pres_wide, interpret)
+
+
+# ---------------------------------------------------------------------------
+# program-lint registration (draco_tpu/analysis) — the kernel-bearing rows
+# ---------------------------------------------------------------------------
+
+
+def lint_programs():
+    """The pallas_call-bearing decode programs, linted like the flash
+    kernel's rows (tools/tpu_attn_lowering_check.py): exported for the TPU
+    platform on the CPU host — so the Python-side Mosaic lowering of both
+    kernels runs on every CI lint sweep — with the memory-capture opt-out
+    (tpu_custom_call cannot compile for the CPU backend). No state carry
+    to donate, no collectives; constant-bloat, dtype and host-traffic
+    still apply (a kernel baking a d-sized table or upcasting to f64 must
+    fail here, not on chip)."""
+    from draco_tpu.analysis.registry import (
+        BuiltProgram, LintProgram, Manifest,
+    )
+
+    kernel_manifest = Manifest(require_donated=None, collectives=None)
+
+    def build_cyclic():
+        from draco_tpu.coding import cyclic as cyclic_mod
+
+        code = cyclic_mod.build_cyclic_code(8, 1)
+        L, n = 16, 8
+
+        def fn(e_re_l, e_im_l, pres_f):
+            return cyclic_locator(code, e_re_l, e_im_l, pres_f,
+                                  cyclic_mod.HEALTH_REL_TOL)
+
+        args = (jnp.zeros((L, n), jnp.float32),
+                jnp.zeros((L, n), jnp.float32),
+                jnp.ones((1, n), jnp.float32))
+        return BuiltProgram("kernel_cyclic_locator", jax.jit(fn), args,
+                            None, kernel_manifest,
+                            extra={"layers": L, "n": n, "s": code.s},
+                            capture_memory=False)
+
+    def build_approx():
+        n, d = 8, 4096
+
+        def fn(rows, bg, v, pres):
+            return approx_decode(rows, bg, v, pres)
+
+        args = (jnp.zeros((n, d), jnp.float32),
+                jnp.zeros((n, d), jnp.float32),
+                jnp.ones((n,), jnp.float32) / n,
+                jnp.ones((n,), bool))
+        return BuiltProgram("kernel_approx_decode", jax.jit(fn), args,
+                            None, kernel_manifest,
+                            extra={"n": n, "d": d},
+                            capture_memory=False)
+
+    return [
+        LintProgram(name="kernel_cyclic_locator", build=build_cyclic,
+                    route="decode_kernel"),
+        LintProgram(name="kernel_approx_decode", build=build_approx,
+                    route="decode_kernel"),
+    ]

@@ -50,7 +50,10 @@ def approx_aggregate(code, grads: jnp.ndarray, present=None, constrain=None,
     merge into the metric row. Identity (no added ops) when the watch is
     off."""
     from draco_tpu.obs import forensics as forensics_mod
+    from draco_tpu.ops.decode_kernels import resolve_decode_impl
 
+    decode_impl = resolve_decode_impl(
+        getattr(cfg, "decode_impl", "xla") if cfg is not None else "xla")
     bad_rows = forensics_mod.nonfinite_rows(grads)
     with jax.named_scope("draco_encode"):
         rows = approx_mod.encode_shared(code, grads)
@@ -62,7 +65,7 @@ def approx_aggregate(code, grads: jnp.ndarray, present=None, constrain=None,
     with jax.named_scope("draco_decode"):
         agg, _v, health = approx_mod.decode(
             code, rows, present=present, with_health=True,
-            batch_grads=grads)
+            batch_grads=grads, impl=decode_impl)
     health["bad_rows"] = bad_rows
     if cfg is not None:
         from draco_tpu.obs import numerics as numerics_mod
@@ -146,6 +149,9 @@ def aggregate_flat_grads(grads: jnp.ndarray, adv_mask, cfg, code, rand_factor,
             if present is not None:
                 pw = present[:, None].astype(enc_re.dtype)
                 enc_re, enc_im = enc_re * pw, enc_im * pw
+        from draco_tpu.ops.decode_kernels import resolve_decode_impl
+
+        decode_impl = resolve_decode_impl(cfg.decode_impl)
         with jax.named_scope("draco_decode"):
             if cfg.decode_granularity == "layer":
                 if leaf_offsets is None:
@@ -155,12 +161,12 @@ def aggregate_flat_grads(grads: jnp.ndarray, adv_mask, cfg, code, rand_factor,
                     )
                 agg, _honest, health = cyclic_mod.decode_layers(
                     code, enc_re, enc_im, rand_factor, leaf_offsets,
-                    present=present, with_health=True,
+                    present=present, with_health=True, impl=decode_impl,
                 )
             else:
                 agg, _honest, health = cyclic_mod.decode(
                     code, enc_re, enc_im, rand_factor, present=present,
-                    with_health=True)
+                    with_health=True, impl=decode_impl)
         health["bad_rows"] = bad_rows
         from draco_tpu.obs import numerics as numerics_mod
 

@@ -379,6 +379,22 @@ def lint_programs():
                                          approach="approx", worker_fail=0,
                                          code_redundancy=1.5,
                                          step_guard="on")),
+        # the fused-decode lowering of the same program (ISSUE 12):
+        # decode_impl="pallas" resolves to the kernels' fused reference
+        # path on the CPU host — the restructured O(n·d) decode tail must
+        # keep the identical ring budget, donation and zero host traffic,
+        # and this row is the device-profile join row for the
+        # lm_sp_approx_pallas_k4 cell (tools/device_profile.py).
+        # fast=False: an impl variant of the fast-swept approx row — the
+        # full tool covers it without growing the --fast sweep budget
+        LintProgram("lm_sp_ring_approx_pallas_many_k2", route="sp",
+                    fast=False,
+                    build=lambda: _build("lm_sp_ring_approx_pallas_many_k2",
+                                         True,
+                                         approach="approx", worker_fail=0,
+                                         code_redundancy=1.5,
+                                         step_guard="on",
+                                         decode_impl="pallas")),
         # shadow-watch production program (obs/numerics.py, ISSUE 10): the
         # numerics columns + bf16 shadow decode ride the shared flat-grad
         # tail — the ring's explicit-collective budget and donation must

@@ -395,6 +395,24 @@ def lint_programs():
         # the GSPMD route — still zero explicit collectives, no host traffic
         mk("lm_tp2_many_guard_k2",
            lambda: _tp2("lm_tp2_many_guard_k2", True, step_guard="on")),
+        # the approx family on the real tp mesh, xla + fused decode
+        # lowerings (ISSUE 12): the optimal-decoding tail must stay pure
+        # GSPMD under BOTH impls (zero explicit collectives, donation,
+        # zero host traffic); these are the device-profile join rows for
+        # the lm_tp_approx_k4 / lm_tp_approx_pallas_k4 claim cells.
+        # fast=False: impl/family variants of the fast-swept tp rows —
+        # the full tool covers them without growing the --fast budget
+        mk("lm_tp2_approx_many_k2",
+           lambda: _tp2("lm_tp2_approx_many_k2", True, approach="approx",
+                        worker_fail=0, code_redundancy=1.5,
+                        step_guard="on"),
+           fast=False),
+        mk("lm_tp2_approx_pallas_many_k2",
+           lambda: _tp2("lm_tp2_approx_pallas_many_k2", True,
+                        approach="approx", worker_fail=0,
+                        code_redundancy=1.5, step_guard="on",
+                        decode_impl="pallas"),
+           fast=False),
         mk("lm_fold_big_bf16_many_k2",
            lambda: _fold_big("lm_fold_big_bf16_many_k2"),
            fast=False, export_platforms=("cpu",)),

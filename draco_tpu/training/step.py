@@ -394,6 +394,12 @@ def build_train_setup(cfg: TrainConfig, mesh, dataset_name: Optional[str] = None
         rep_code = None
         batch_ids = jnp.asarray(code.batch_ids)  # (n, hat_s)
         hat_s = code.hat_s
+        # decode lowering (ISSUE 12): resolved ONCE per setup — dispatch
+        # depends only on cfg + the attached backend, so the jitted step
+        # bodies close over a static tag (no retraces)
+        from draco_tpu.ops.decode_kernels import resolve_decode_impl
+
+        decode_impl = resolve_decode_impl(cfg.decode_impl)
 
         if cfg.redundancy == "shared":
 
@@ -491,12 +497,13 @@ def build_train_setup(cfg: TrainConfig, mesh, dataset_name: Optional[str] = None
                     decoded, honest_l, health = cyclic_mod.decode_layers(
                         code, enc_re, enc_im, rand_factor, leaf_offsets,
                         present=present, with_health=True,
+                        impl=decode_impl,
                     )
                     honest = jnp.all(honest_l, axis=0)
                 else:
                     decoded, honest, health = cyclic_mod.decode(
                         code, enc_re, enc_im, rand_factor, present=present,
-                        with_health=True)
+                        with_health=True, impl=decode_impl)
             new_state = apply_update(state, decoded, new_stats)
             out = _metrics(losses, precs, present)
             out["honest_located"] = jnp.sum(honest.astype(jnp.int32))
@@ -664,8 +671,8 @@ def lint_programs():
         return BuiltProgram(name, setup.train_step, args, mesh, manifest,
                             extra=extra)
 
-    mk = lambda name, **kw: LintProgram(  # noqa: E731
-        name=name, route="cnn",
+    mk = lambda name, fast=True, **kw: LintProgram(  # noqa: E731
+        name=name, route="cnn", fast=fast,
         build=lambda name=name, kw=kw: _build(name, **kw))
     return [
         mk("cnn_cyclic_step", cfg=_cfg()),
@@ -705,4 +712,26 @@ def lint_programs():
            cfg=_cfg(approach="approx", worker_fail=0, redundancy="shared",
                     code_redundancy=1.5, numerics_watch="on",
                     shadow_wire="int8", shadow_round="stochastic")),
+        # fused-decode production programs (ISSUE 12): decode_impl="pallas"
+        # resolves to the kernels' fused reference lowering on this CPU
+        # host (ops/decode_kernels.resolve_decode_impl) — a plain XLA
+        # program that must stay green under all six rules exactly like
+        # the xla-path rows (zero explicit collectives, full donation,
+        # zero host traffic, no big constants: the per-layer recombination
+        # assembles from slices, never a d-length id constant). The
+        # layer-granularity pair is the kernel's home regime and the
+        # device-profile cells' join rows (tools/device_profile.py
+        # cnn_cyclic_layer_* cells). fast=False: impl VARIANTS of
+        # already-fast-swept step bodies — the full tool covers them (the
+        # committed-artifact coverage test pins their presence) without
+        # growing the per-commit --fast sweep budget.
+        mk("cnn_cyclic_layer_step", cfg=_cfg(decode_granularity="layer"),
+           fast=False),
+        mk("cnn_cyclic_layer_pallas_step",
+           cfg=_cfg(decode_granularity="layer", decode_impl="pallas"),
+           fast=False),
+        mk("cnn_approx_pallas_step",
+           cfg=_cfg(approach="approx", worker_fail=0, redundancy="shared",
+                    code_redundancy=1.5, decode_impl="pallas"),
+           fast=False),
     ]

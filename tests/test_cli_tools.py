@@ -1000,3 +1000,135 @@ def test_check_artifacts_tool(tmp_path, capsys):
     # metrics fold as missing (non-fatal without --strict-missing)
     assert check_artifacts.main(["--root", str(tmp_path)]) == 1
     assert "FAILED at 'wire_study --check'" in capsys.readouterr().out
+
+
+def test_decode_kernel_bench_check_gates(tmp_path, capsys):
+    """tools/decode_kernel_bench.py --check (jax-free, ISSUE 12): the
+    committed artifact passes; a gated rung whose fused decode went
+    slower than XLA exits 1 naming the rung, and broken ratio arithmetic
+    gates too."""
+    import json
+
+    from tools import decode_kernel_bench
+
+    committed = os.path.join(REPO, "baselines_out",
+                             "decode_kernel_bench.json")
+    assert decode_kernel_bench.main(
+        ["--check", "--artifact", committed]) == 0
+    capsys.readouterr()
+
+    data = json.load(open(committed))
+    row = next(r for r in data["rows"] if r.get("gate"))
+    # the fused path regressing slower than XLA at a committed gated rung
+    row["pallas_ms"] = round(row["xla_ms"] * 1.5, 3)
+    row["pallas_over_xla"] = round(row["pallas_ms"] / row["xla_ms"], 4)
+    row["kernel_not_slower"] = False
+    bad = tmp_path / "decode_kernel_bench.json"
+    bad.write_text(json.dumps(data))
+    assert decode_kernel_bench.main(["--check", "--artifact",
+                                     str(bad)]) == 1
+    out = capsys.readouterr().out
+    assert row["rung"] in out and "slower than XLA" in out
+
+    # ratio arithmetic drifting from the recorded timings gates
+    data = json.load(open(committed))
+    data["rows"][0]["pallas_over_xla"] = 0.123
+    bad.write_text(json.dumps(data))
+    assert decode_kernel_bench.main(["--check", "--artifact",
+                                     str(bad)]) == 1
+    assert "ratio" in capsys.readouterr().out
+
+
+def test_perf_watch_gates_on_flipped_decode_bench(tmp_path):
+    """The decode-bench fold: a gated rung's kernel_not_slower flipping
+    1 -> 0 gates at tolerance 0, and a ratio regression past the time
+    tolerance gates too (ISSUE 12 acceptance: the flipped-row proof that
+    the kernel-slower-than-XLA gate is live)."""
+    import json
+
+    from tools import perf_watch
+
+    root = tmp_path
+    (root / "baselines_out").mkdir()
+
+    def artifact(ratio, not_slower):
+        rows = [{"rung": "cyclic_layer_n8", "family": "cyclic", "n": 8,
+                 "s": 1, "d": 400000, "granularity": "layer", "layers": 10,
+                 "gate": True, "xla_ms": 8.0,
+                 "pallas_ms": round(8.0 * ratio, 3),
+                 "pallas_over_xla": ratio,
+                 "pallas_lowering": "fused_xla",
+                 "kernel_not_slower": not_slower}]
+        return {"schema": 1, "all_ok": not_slower, "rows": rows}
+
+    path = root / "baselines_out" / "decode_kernel_bench.json"
+    path.write_text(json.dumps(artifact(0.9, True)))
+    assert perf_watch.main(["--root", str(root), "--snapshot"]) == 0
+    assert perf_watch.main(["--root", str(root)]) == 0
+
+    # fused decode now slower than xla: the 0-tolerance ok flag gates
+    path.write_text(json.dumps(artifact(1.2, False)))
+    assert perf_watch.main(["--root", str(root)]) == 1
+
+    # ratio creep past the time tolerance gates even while not slower yet
+    # (0.9 -> 1.0 is +11% against the 10% time tolerance)
+    path.write_text(json.dumps(artifact(1.0, True)))
+    assert perf_watch.main(["--root", str(root)]) == 1
+
+
+def test_device_profile_check_gates_on_pallas_claim(tmp_path, capsys):
+    """The ISSUE 12 acceptance gate: every PALLAS_CLAIMS pair in the
+    committed device profile shows the fused-decode cell's decode share
+    STRICTLY below its same-shape xla pair; a flipped pallas cell exits 1
+    naming the pair, and a half-missing pair gates too."""
+    import json
+
+    from tools import device_profile
+
+    committed = os.path.join(REPO, "baselines_out", "device_profile.json")
+    data = json.load(open(committed))
+    cells = {r.get("cell") for r in data["cells"]}
+    for p, x in device_profile.PALLAS_CLAIMS.items():
+        assert {p, x} <= cells, "committed artifact must hold EVERY pair"
+    pal, xla = next(iter(sorted(device_profile.PALLAS_CLAIMS.items())))
+    assert device_profile.main(["--check", "--artifact", committed]) == 0
+    capsys.readouterr()
+
+    # flip the pallas cell's decode share above its xla pair — keep the
+    # phase rows consistent so ONLY the claim gate trips
+    bad_data = json.load(open(committed))
+    pal_row = next(r for r in bad_data["cells"] if r.get("cell") == pal)
+    xla_row = next(r for r in bad_data["cells"] if r.get("cell") == xla)
+    xla_share = xla_row["programs"][0]["decode_share"]
+    prog = pal_row["programs"][0]
+    dec = prog["phases"]["draco_decode"]
+    comp = prog["phases"]["draco_comp"]
+    total = prog["total_device_us"]
+    new_frac = round(xla_share + 0.1, 4)
+    moved = new_frac * total - dec["time_us"]
+    dec["time_us"] = round(dec["time_us"] + moved, 1)
+    comp["time_us"] = round(comp["time_us"] - moved, 1)
+    dec["frac"] = new_frac
+    comp["frac"] = round(comp["time_us"] / total, 4)
+    prog["decode_share"] = new_frac
+    bad = tmp_path / "device_profile.json"
+    bad.write_text(json.dumps(bad_data))
+    assert device_profile.main(["--check", "--artifact", str(bad)]) == 1
+    out = capsys.readouterr().out
+    assert pal in out and "not strictly below" in out
+
+    # a claim pair with its xla half missing is incomplete, never
+    # skipped — and a regeneration that drops BOTH cells of a claimed
+    # pair fails too (the claim may never silently go unenforced)
+    bad_data = json.load(open(committed))
+    bad_data["cells"] = [r for r in bad_data["cells"]
+                         if r.get("cell") != xla]
+    bad.write_text(json.dumps(bad_data))
+    assert device_profile.main(["--check", "--artifact", str(bad)]) == 1
+    assert "claim pair missing/incomplete" in capsys.readouterr().out
+    bad_data = json.load(open(committed))
+    bad_data["cells"] = [r for r in bad_data["cells"]
+                         if r.get("cell") not in (pal, xla)]
+    bad.write_text(json.dumps(bad_data))
+    assert device_profile.main(["--check", "--artifact", str(bad)]) == 1
+    assert pal in capsys.readouterr().out

@@ -62,8 +62,20 @@ def test_registry_covers_every_route():
     # the production chunked drivers with device token-gen and the big-d
     # constant-bloat guard are registered too
     assert "lm_fold_devgen_many_k2" in names
-    big = [p for p in programs if not p.fast]
-    assert [p.name for p in big] == ["lm_fold_big_bf16_many_k2"]
+    # the kernel-bearing rows (ISSUE 12) ride the fast sweep — their TPU
+    # export IS the per-commit Mosaic lowering check
+    assert {"kernel_cyclic_locator", "kernel_approx_decode"} <= {
+        p.name for p in programs if p.fast}
+    # out of the --fast budget: the big-d constant-bloat guard (~3.3M
+    # params) and the ISSUE 12 fused/approx impl VARIANTS of fast-swept
+    # step bodies (the full tool + the committed-artifact coverage test
+    # still guard them)
+    big = {p.name for p in programs if not p.fast}
+    assert big == {"lm_fold_big_bf16_many_k2",
+                   "cnn_cyclic_layer_step", "cnn_cyclic_layer_pallas_step",
+                   "cnn_approx_pallas_step",
+                   "lm_sp_ring_approx_pallas_many_k2",
+                   "lm_tp2_approx_many_k2", "lm_tp2_approx_pallas_many_k2"}
 
 
 @pytest.mark.core
@@ -105,11 +117,22 @@ def test_committed_artifact_is_consistent_with_registry():
     assert {c["expected_fail"] for c in controls} == set(RULE_NAMES)
     # every registered (non-control) row carries the memory/cost ledger
     # columns the memory_budget rule records (ISSUE 5) — the round-over-
-    # round series tools/perf_watch.py diffs
+    # round series tools/perf_watch.py diffs. The pallas_call-bearing
+    # kernel rows (ISSUE 12, route "decode_kernel") are the one legal
+    # exception: tpu_custom_call cannot compile for the CPU host, so they
+    # register with the memory-capture opt-out (capture_memory=False,
+    # like the chip-tier flash rows) and their memory_budget row reports
+    # skipped-with-reason instead of columns.
+    from draco_tpu.analysis.registry import collect as _collect
+
+    kernel_rows = {p.name for p in _collect() if p.route == "decode_kernel"}
     for r in report["rows"]:
         if r.get("control"):
             continue
         mb = r["rules"]["memory_budget"]
+        if r["name"] in kernel_rows:
+            assert mb.get("skipped") and mb.get("ok"), (r["name"], mb)
+            continue
         assert not mb.get("skipped"), (r["name"], mb)
         mem = mb["memory"]
         for col in ("argument_bytes", "output_bytes", "temp_bytes",

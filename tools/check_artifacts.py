@@ -14,6 +14,9 @@ exits nonzero NAMING THE FIRST FAILURE:
                       device-time ledger
   wire_study          --check: ledger arithmetic + bf16 detection pins of
                       the committed shadow-wire matrix
+  decode_kernel_bench --check: ratio arithmetic + gated-rung
+                      kernel-not-slower pins of the committed fused-decode
+                      microbench (ISSUE 12)
   program_lint        committed all_ok roll-up
   chaos_matrix        committed all_ok roll-up
   straggler_study     committed all_ok roll-up
@@ -79,6 +82,15 @@ def _check_wire_study(root):
     return None if rc == 0 else f"wire_study --check exited {rc}"
 
 
+def _check_decode_bench(root):
+    from tools import decode_kernel_bench
+
+    artifact = os.path.join(root, "baselines_out",
+                            "decode_kernel_bench.json")
+    rc = decode_kernel_bench.main(["--check", "--artifact", artifact])
+    return None if rc == 0 else f"decode_kernel_bench --check exited {rc}"
+
+
 def _check_trace_report(root):
     """Schema smoke: the jax-free report must fold a minimal-but-current
     run dir (trace + metrics + a STATUS_SCHEMA-versioned status.json) —
@@ -134,6 +146,7 @@ CHECKS = (
     ("perf_watch", _check_perf_watch),
     ("device_profile --check", _check_device_profile),
     ("wire_study --check", _check_wire_study),
+    ("decode_kernel_bench --check", _check_decode_bench),
     ("program_lint all_ok",
      _flag_check(os.path.join("baselines_out", "program_lint.json"))),
     ("chaos_matrix all_ok",

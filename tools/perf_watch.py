@@ -60,6 +60,15 @@ Folded sources (all optional — a missing artifact folds nothing):
                                 detection P/R + det_preserved as
                                 0-tolerance ok flags, logical wire bytes
                                 at the bytes tolerance
+  baselines_out/decode_kernel_bench.json
+                                the fused-decode microbench
+                                (tools/decode_kernel_bench.py, ISSUE 12):
+                                per-rung xla/pallas decode ms and their
+                                ratio at the time tolerance, plus the
+                                gated rungs' kernel_not_slower flag at
+                                tolerance 0 — the fused path regressing
+                                slower than the XLA path at a committed
+                                rung fails the round
   baselines_out/device_profile.json
                                 the device-time attribution ledger
                                 (tools/device_profile.py, ISSUE 9):
@@ -371,6 +380,37 @@ def fold_wire_study(root: str, metrics: dict) -> None:
                 "value": float(per[dtype]), "kind": "bytes", "source": src}
 
 
+def fold_decode_bench(root: str, metrics: dict) -> None:
+    """Fused-decode microbench (tools/decode_kernel_bench.py, ISSUE 12):
+    absolute per-impl decode times and the pallas/xla ratio ride at the
+    time tolerance; gated rungs additionally pin ``kernel_not_slower``
+    (ratio ≤ 1) as a 0-tolerance ok flag — the flipped-row test in
+    tests/test_cli_tools.py proves that gate live."""
+    path = os.path.join(root, "baselines_out", "decode_kernel_bench.json")
+    data = _read_json(path)
+    if not isinstance(data, dict):
+        return
+    src = "baselines_out/decode_kernel_bench.json"
+    if "all_ok" in data:
+        metrics["decode_bench.all_ok"] = {
+            "value": float(bool(data["all_ok"])), "kind": "ok",
+            "source": src}
+    for row in data.get("rows", []):
+        rung = row.get("rung")
+        if not rung:
+            continue
+        key = f"decode_bench.{rung}"
+        for col in ("xla_ms", "pallas_ms", "pallas_over_xla"):
+            if isinstance(row.get(col), (int, float)):
+                metrics[f"{key}.{col}"] = {
+                    "value": float(row[col]), "kind": "time_ms",
+                    "source": src}
+        if "kernel_not_slower" in row:
+            metrics[f"{key}.kernel_not_slower"] = {
+                "value": float(bool(row["kernel_not_slower"])),
+                "kind": "ok", "source": src}
+
+
 def fold_device_profile(root: str, metrics: dict) -> None:
     """Device-time attribution artifact (tools/device_profile.py, ISSUE 9):
     per-cell phase SHARES at the ordinary time tolerance — a decode-share
@@ -437,6 +477,7 @@ def fold_all(root: str) -> dict:
     fold_chaos(root, metrics)
     fold_straggler(root, metrics)
     fold_wire_study(root, metrics)
+    fold_decode_bench(root, metrics)
     fold_device_profile(root, metrics)
     return metrics
 
