@@ -220,6 +220,23 @@ def add_fit_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     p.add_argument("--shadow-block", type=int, default=256,
                    help="int8 shadow per-block scale granularity "
                         "(elements per f32 scale along the wire row)")
+    p.add_argument("--incident-watch", type=str, default="off",
+                   choices=["off", "on"],
+                   help="incident engine (obs/incidents.py, ISSUE 13): "
+                        "fold the telemetry column families + heartbeat "
+                        "beats into typed, attributed run-health "
+                        "incidents (throughput/residual-drift/trust-"
+                        "collapse/guard-burn/numerics/compile-storm/"
+                        "prefetch-starvation) with onset/offset "
+                        "hysteresis — streamed to train_dir/"
+                        "incidents.jsonl + the status.json incidents "
+                        "block; host-side only, bitwise-transparent "
+                        "(tools/incident_report.py replays it jax-free)")
+    p.add_argument("--incident-thresholds", type=str, default="",
+                   help="per-detector threshold overrides, comma-"
+                        "separated '<detector>.<key>=<float>' (e.g. "
+                        "'trust.floor=0.4'); keys validated against the "
+                        "declarative registry (PERF.md §15 table)")
     p.add_argument("--compile-warmup", type=int, default=1,
                    help="XLA builds allowed per registered program (per "
                         "chunk shape) before the compile guard treats a "
@@ -342,6 +359,8 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         shadow_wire=args.shadow_wire,
         shadow_round=args.shadow_round,
         shadow_block=args.shadow_block,
+        incident_watch=args.incident_watch,
+        incident_thresholds=args.incident_thresholds,
         step_guard=args.step_guard,
         guard_residual_tol=args.guard_residual_tol,
         fault_spec=args.fault_spec,

@@ -258,6 +258,22 @@ class TrainConfig:
     # elements along the wire row (also the blocking the numerics columns'
     # int8 underflow threshold uses).
     shadow_block: int = 256
+    # Incident engine (obs/incidents.py; ISSUE 13). "on" folds the
+    # per-step column families + the heartbeat beat extras into typed,
+    # attributed run-health incidents (throughput regression, decode-
+    # residual drift, trust collapse, guard budget burn, numerics drift,
+    # compile storms, prefetch starvation) with onset/offset hysteresis —
+    # streamed to train_dir/incidents.jsonl and the ``incidents`` block of
+    # status.json (STATUS_SCHEMA 4). Host-side only: zero extra device
+    # fetches, zero retraces, bitwise-transparent to training. Needs a
+    # train_dir (the stream and the heartbeat live there). Any approach:
+    # detectors silently skip column families the route does not emit.
+    incident_watch: str = "off"
+    # Per-detector threshold overrides, comma-separated
+    # "<detector>.<key>=<float>" (e.g. "trust.floor=0.4,guard.off_count=2")
+    # — keys validated against the declarative detector registry at config
+    # time. "" keeps every registered default (PERF.md §15 table).
+    incident_thresholds: str = ""
 
     # --- resilience (draco_tpu/resilience; ISSUE 6) ---
     # In-graph step guard: fold the decode-health signals (loud
@@ -486,6 +502,17 @@ class TrainConfig:
                 "numerics_watch/shadow_wire require a coded approach "
                 f"(cyclic|maj_vote|approx), got {self.approach!r}"
             )
+        if self.incident_watch not in ("off", "on"):
+            raise ValueError(
+                f"incident_watch must be off|on, got {self.incident_watch!r}"
+            )
+        if self.incident_thresholds:
+            # unknown detector/threshold names surface at config time, not
+            # mid-run (the registry is the contract); parse result is
+            # rebuilt where it is consumed (obs/incidents.make_engine)
+            from draco_tpu.obs.incidents import parse_thresholds
+
+            parse_thresholds(self.incident_thresholds)
         if self.step_guard not in ("off", "on"):
             raise ValueError(
                 f"step_guard must be off|on, got {self.step_guard!r}"

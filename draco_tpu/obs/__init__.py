@@ -36,6 +36,17 @@ device fetches in steady state** and zero overhead when disabled.
                 Manifest counts (mismatch = hard error), and the merged
                 host+device Perfetto timeline. Driven by
                 tools/device_profile.py; folded by tools/trace_report.py.
+  incidents.py  IncidentEngine (ISSUE 13): typed, attributed, stateful
+                run-health incidents folded from the per-step column
+                families at the heartbeat observer hook — declaratively
+                registered detectors (throughput / residual drift / trust
+                collapse / guard burn / numerics / compile storm /
+                prefetch starvation) with onset/offset hysteresis,
+                streamed to ``train_dir/incidents.jsonl`` and the
+                ``incidents`` status block; replayed jax-free by
+                tools/incident_report.py.
+  replay.py     The shared torn-tail-tolerant JSONL reader every jax-free
+                replay tool folds metrics.jsonl / incidents.jsonl through.
   forensics.py  Per-worker Byzantine forensics (ISSUE 7): the coded steps'
                 (n,) accusation/present/seeded-adversary masks packed into
                 f32-carried uint32 bitmask columns riding the (K, m) metric
@@ -59,11 +70,17 @@ from draco_tpu.obs.compile_watch import (
     make_compile_watch,
 )
 from draco_tpu.obs.forensics import AccusationLedger
-from draco_tpu.obs.heartbeat import STATUS_SCHEMA, RunHeartbeat
+from draco_tpu.obs.heartbeat import (
+    STATUS_SCHEMA,
+    RunHeartbeat,
+    check_status_schema,
+)
+from draco_tpu.obs.incidents import IncidentEngine, make_engine
 from draco_tpu.obs.profiling import NULL_PROFILER_WINDOW, profiler_window
 from draco_tpu.obs.tracer import NULL_TRACER, SpanTracer, make_tracer
 
 __all__ = ["NULL_PROFILER_WINDOW", "NULL_TRACER", "STATUS_SCHEMA",
-           "AccusationLedger", "CompileWatch", "RetraceError",
-           "RetraceWarning", "RunHeartbeat", "SpanTracer",
-           "make_compile_watch", "make_tracer", "profiler_window"]
+           "AccusationLedger", "CompileWatch", "IncidentEngine",
+           "RetraceError", "RetraceWarning", "RunHeartbeat", "SpanTracer",
+           "check_status_schema", "make_compile_watch", "make_engine",
+           "make_tracer", "profiler_window"]

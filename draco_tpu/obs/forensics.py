@@ -249,9 +249,13 @@ class AccusationLedger:
         self._open: Dict[int, dict] = {}  # worker -> open episode
 
     # ---- fold ------------------------------------------------------------
-    def observe(self, record: dict) -> bool:
-        """Fold one record; returns True iff it carried forensics columns."""
-        masks = record_masks(record, self.n)
+    def observe(self, record: dict, masks: Optional[dict] = None) -> bool:
+        """Fold one record; returns True iff it carried forensics columns.
+        ``masks``: the record's already-unpacked mask dict, when the caller
+        holds one (the incident engine's per-record cache) — skips the
+        redundant bit-unpack on the hot observer path."""
+        if masks is None:
+            masks = record_masks(record, self.n)
         if masks is None:
             return False
         step = int(record.get("step", self.steps + 1))

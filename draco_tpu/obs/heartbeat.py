@@ -63,7 +63,51 @@ from draco_tpu.obs.forensics import AccusationLedger
 #      ``shadow_sentinel_steps``, the count of fault-poisoned shadow
 #      comparisons), which appears only on watch-enabled runs. Consumers
 #      tolerate either block missing, assert shape when present.
-STATUS_SCHEMA = 3
+#   4: the incident engine (ISSUE 13): an ``incidents`` block (open
+#      episodes, per-type totals, last onset — obs/incidents.py) on
+#      watch-enabled runs (``cfg.incident_watch="on"``), carried by the
+#      terminal crash/preempted write too.
+STATUS_SCHEMA = 4
+
+# The ONE schema contract table (ISSUE 13 satellite): optional status.json
+# block name -> the schema version that introduced it. Every jax-free
+# consumer (tools/trace_report.py, tools/incident_report.py,
+# tools/forensics_report.py, tools/chaos_run.py, tools/check_artifacts.py)
+# validates against THIS table via :func:`check_status_schema` instead of
+# carrying its own accepted-set literal, so a schema bump cannot silently
+# strand a tool.
+STATUS_BLOCKS = {
+    "decode_health": 2, "guard": 2, "forensics": 2, "device": 2,
+    "wire": 3, "numerics": 3,
+    "incidents": 4,
+}
+KNOWN_STATUS_SCHEMAS = tuple(range(2, STATUS_SCHEMA + 1))
+
+
+def check_status_schema(status: dict, path: str = "status.json",
+                        tool: str = "this tool") -> dict:
+    """Validate a loaded status.json payload against the central contract:
+    a ``schema`` field, when present, must be a version this tree knows
+    (pre-versioning files carry none and are accepted), and no optional
+    block may appear under a schema older than the one that introduced it.
+    Raises SystemExit naming the mismatch — silently folding an unknown
+    payload shape would misreport the run. Returns ``status`` unchanged."""
+    if not isinstance(status, dict):
+        return status
+    schema = status.get("schema")
+    if schema is not None and schema not in KNOWN_STATUS_SCHEMAS:
+        raise SystemExit(
+            f"{path}: status.json schema {schema!r} not in known "
+            f"{KNOWN_STATUS_SCHEMAS} — update {tool} alongside "
+            f"obs/heartbeat.STATUS_SCHEMA")
+    if schema is not None:
+        for block, introduced in STATUS_BLOCKS.items():
+            if block in status and schema < introduced:
+                raise SystemExit(
+                    f"{path}: block {block!r} requires status schema >= "
+                    f"{introduced}, payload claims {schema} — a writer and "
+                    f"obs/heartbeat.STATUS_BLOCKS disagree")
+    return status
 
 # per-step detection-count columns (in-graph, coding/cyclic.py +
 # coding/repetition.py): tp = flagged ∧ adversarial ∧ present,
@@ -96,7 +140,7 @@ class RunHeartbeat:
     return immediately."""
 
     def __init__(self, train_dir: Optional[str], enabled: bool = True,
-                 num_workers: Optional[int] = None):
+                 num_workers: Optional[int] = None, incidents=None):
         self.path = (os.path.join(train_dir, "status.json")
                      if (train_dir and enabled) else None)
         if self.path:
@@ -132,6 +176,9 @@ class RunHeartbeat:
         # forensics entirely
         self.ledger = (AccusationLedger(num_workers)
                        if (self.path and num_workers) else None)
+        # incident engine (obs/incidents.py, ISSUE 13): rides the same
+        # observer hook + the beat — zero extra fetches; None = watch off
+        self.incidents = incidents if self.path else None
 
     # ---- accumulation ----------------------------------------------------
     def observe(self, record: dict) -> None:
@@ -192,8 +239,20 @@ class RunHeartbeat:
                     continue
                 key = f"{k}_min"
                 self._nx[key] = min(self._nx.get(key, float("inf")), v)
+        # engine first: it unpacks the record's forensics masks once into
+        # its cache, which the heartbeat's own ledger fold then reuses —
+        # one bit-unpack per record on the watch-enabled observer path
+        if self.incidents is not None:
+            self.incidents.observe(record)
         if self.ledger is not None:
-            self.ledger.observe(record)
+            # reuse only when the engine unpacked for the SAME worker
+            # count (the loops wire both from cfg.num_workers; a bare
+            # mismatched construction falls back to its own unpack)
+            masks = (self.incidents.current_masks
+                     if self.incidents is not None
+                     and self.incidents.num_workers == self.ledger.n
+                     else None)
+            self.ledger.observe(record, masks=masks)
         self._last = record
 
     def set_wire(self, ledger: Optional[dict]) -> None:
@@ -292,6 +351,12 @@ class RunHeartbeat:
             # last profiled window's device-time attribution (ISSUE 9);
             # consumers tolerate the key missing, assert it when present
             payload["device"] = self._device
+        if self.incidents is not None:
+            # the beat IS the engine's beat-source observation (throughput
+            # wall-rate, compile counters, prefetch depth/restarts all
+            # arrive in ``extra``), then the folded block rides the payload
+            self.incidents.observe_beat(step, extra)
+            payload["incidents"] = self.incidents.status_block()
         if extra:
             payload.update(extra)
         self._write(payload)
@@ -320,6 +385,14 @@ class RunHeartbeat:
             # a capture window that stops on the run's LAST work unit has
             # no later beat — the terminal write is the block's only ride
             payload["device"] = self._device
+        if self.incidents is not None:
+            # the FINAL incidents state must ride the terminal write: an
+            # incident that opened after the last beat (a crash step, a
+            # SIGTERM-boundary guard trip) would otherwise vanish from the
+            # run's last word — the same bug PR 9 fixed for ``device``
+            # (ISSUE 13 satellite, pinned by the SIGTERM-path test)
+            payload["incidents"] = self.incidents.status_block()
+            self.incidents.finalize()
         if cause is not None:
             payload["cause"] = str(cause)[:500]
         if resumable_step is not None:

@@ -1,0 +1,116 @@
+"""Shared JSONL replay scaffold for the jax-free artifact tools.
+
+``tools/forensics_report.py``, ``tools/trace_report.py``,
+``tools/incident_report.py`` and ``tools/chaos_run.py`` all replay a run's
+``metrics.jsonl`` (and now ``incidents.jsonl``) on the host, and each used
+to hand-roll the same partial-artifact tolerance: a run killed mid-write
+leaves a missing file, an empty file, or a torn final line, and none of
+those states may take a report down. This module is the ONE reader they
+share (ISSUE 13 satellite), so the tolerance rules cannot drift between
+tools:
+
+  * missing / unreadable file  -> yields nothing
+  * blank lines                -> skipped
+  * torn (non-JSON) tail line  -> skipped
+  * non-dict JSON line         -> skipped
+
+Stdlib-only and jax-free — the same discipline as the rest of
+draco_tpu/obs: every consumer runs on a laptop against artifacts scp'd
+from a chip job.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Iterator, List, Optional
+
+
+def iter_jsonl(path: str) -> Iterator[dict]:
+    """Yield every dict record of a JSONL file, tolerating the partial
+    states a killed run leaves behind (module docstring)."""
+    try:
+        fh = open(path)
+    except OSError:
+        return
+    with fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue  # torn tail line of an interrupted run
+            if isinstance(rec, dict):
+                yield rec
+
+
+def train_records(path: str, require_loss: bool = True) -> List[dict]:
+    """The run's TRAIN records from metrics.jsonl: eval records dropped,
+    and (by default) records without a ``loss`` — the same stream the
+    heartbeat's observer hook sees live, so a host ledger replayed over
+    these records reproduces the live fold whenever every step was logged
+    (``log_every=1``, the chaos/report discipline)."""
+    out = []
+    for rec in iter_jsonl(path):
+        if rec.get("split") == "eval":
+            continue
+        if require_loss and "loss" not in rec:
+            continue
+        out.append(rec)
+    return out
+
+
+def record_at_step(path: str, step: int) -> Optional[dict]:
+    """The LAST train record at ``step`` (re-runs in a shared train_dir
+    append; the newest wins), or None."""
+    rec = None
+    for r in train_records(path, require_loss=True):
+        if r.get("step") == step:
+            rec = r
+    return rec
+
+
+def metrics_path(path: str) -> str:
+    """Resolve a train_dir (or a direct file path) to its metrics.jsonl."""
+    if os.path.isdir(path):
+        return os.path.join(path, "metrics.jsonl")
+    return path
+
+
+def infer_num_workers(records: List[dict], status_path: str,
+                      tool: str = "obs/replay.py") -> int:
+    """The worker-count fallback chain the per-worker replay tools share
+    (forensics_report / incident_report): the run's status.json forensics
+    block (schema-validated against the central contract table), else the
+    highest worker ever marked present in the packed masks + 1 — the
+    inference only under-counts workers that never sent a single row,
+    which contribute nothing to any counter."""
+    import json
+
+    from draco_tpu.obs.forensics import MASK_PREFIX, unpack_bits
+    from draco_tpu.obs.heartbeat import check_status_schema
+
+    try:
+        with open(status_path) as fh:
+            status = json.load(fh)
+        if isinstance(status, dict):
+            check_status_schema(status, status_path, tool)
+            n = (status.get("forensics") or {}).get("num_workers")
+            if n:
+                return int(n)
+    except (OSError, ValueError):
+        pass
+    hi = 0
+    for rec in records:
+        words = []
+        w = 0
+        while f"{MASK_PREFIX}present{w}" in rec:
+            words.append(int(rec[f"{MASK_PREFIX}present{w}"]))
+            w += 1
+        if words:
+            bits = unpack_bits(words, len(words) * 32)
+            if any(bits):
+                hi = max(hi, max(i for i, b in enumerate(bits) if b) + 1)
+    return max(hi, 1)

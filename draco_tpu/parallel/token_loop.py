@@ -177,9 +177,16 @@ def run_token_loop(setup, cfg: TrainConfig, steps: Optional[int] = None,
     writer = MetricWriter(cfg.train_dir or None, quiet=quiet)
     tracer = make_tracer(cfg.trace_dir, is_main)
     # num_workers keys the heartbeat's per-worker accusation ledger
-    # (obs/forensics.AccusationLedger), fed by the same observer hook
+    # (obs/forensics.AccusationLedger), fed by the same observer hook; the
+    # incident engine (obs/incidents.py, ISSUE 13) rides the same hook +
+    # the beat when cfg.incident_watch is on — host-side only, bitwise-
+    # transparent to training
+    from draco_tpu.obs import incidents as incidents_mod
+
     heartbeat = RunHeartbeat(cfg.train_dir or None, enabled=is_main,
-                             num_workers=cfg.num_workers)
+                             num_workers=cfg.num_workers,
+                             incidents=incidents_mod.make_engine(cfg,
+                                                                 is_main))
     # static logical wire-bytes ledger (obs/numerics.wire_ledger, ISSUE
     # 10): the ``wire`` status block, from the route's flat-grad dimension
     from draco_tpu.obs import numerics as numerics_mod
@@ -412,10 +419,19 @@ def _run_chunked(setup, cfg, state, start, last_step, adv, straggle, writer,
                 # no wall-clock read between barrier and flush here.
                 with tracer.span("flush", at_step=end):
                     deferred.flush(should_log)
-                    heartbeat.beat(end, total_end, extra={
-                        "prefetch_depth": (prefetch.depth
-                                           if prefetch is not None else 0),
-                        **watch.snapshot()})
+                    # prefetch extras only when a prefetcher EXISTS: the
+                    # device token-gen mode has no host prefetch path, and
+                    # reporting a constant depth 0 there would read as
+                    # starvation to the incident engine (ISSUE 13)
+                    pf_extra = {}
+                    if prefetch is not None:
+                        pf_extra["prefetch_depth"] = prefetch.depth
+                        if hasattr(prefetch, "stats"):
+                            # supervision restart counter — the incident
+                            # engine's starvation signal
+                            pf_extra.update(prefetch.stats())
+                    heartbeat.beat(end, total_end,
+                                   extra={**pf_extra, **watch.snapshot()})
                     tracer.flush()
             win.maybe_stop(end, state.params)
             if boundary:

@@ -88,6 +88,12 @@ class SupervisedPrefetcher:
                 self._p = self._factory()
                 self.restarts_used += 1
 
+    def stats(self) -> dict:
+        """The supervision counters the heartbeat beat carries (and the
+        incident engine's starvation detector consumes, ISSUE 13): how
+        many times a prefetcher was abandoned + rebuilt this run."""
+        return {"prefetch_restarts": self.restarts_used}
+
     def _abandon(self) -> None:
         """Drop the broken instance without ever blocking on it (a hung
         worker thread must not hang the supervisor too)."""

@@ -76,10 +76,16 @@ class Trainer:
         self.tracer = make_tracer(cfg.trace_dir, self._is_main)
         # num_workers keys the heartbeat's per-worker accusation ledger
         # (obs/forensics.AccusationLedger) — it folds the packed forensics
-        # mask columns at the same observer hook, zero extra fetches
-        self.heartbeat = RunHeartbeat(cfg.train_dir or None,
-                                      enabled=self._is_main,
-                                      num_workers=cfg.num_workers)
+        # mask columns at the same observer hook, zero extra fetches.
+        # The incident engine (obs/incidents.py, ISSUE 13) rides the same
+        # hook + the beat when cfg.incident_watch is on: host-side only,
+        # bitwise-transparent to training
+        from draco_tpu.obs import incidents as incidents_mod
+
+        self.heartbeat = RunHeartbeat(
+            cfg.train_dir or None, enabled=self._is_main,
+            num_workers=cfg.num_workers,
+            incidents=incidents_mod.make_engine(cfg, self._is_main))
         # static logical wire-bytes ledger (obs/numerics.wire_ledger,
         # ISSUE 10): the ``wire`` status block — derived from the program's
         # registered shapes, stamped once per run
@@ -504,10 +510,19 @@ class Trainer:
 
     def _prefetch_depth(self) -> dict:
         """Heartbeat extra: in-flight prefetch requests of whichever
-        prefetcher the active regime runs."""
+        prefetcher the active regime runs, plus the supervision restart
+        counter when wrapped (resilience/supervisor.py — the incident
+        engine's starvation signal, ISSUE 13)."""
         p = self._chunk_prefetch if self._chunk_prefetch is not None \
             else self._prefetch
-        return {"prefetch_depth": p.depth if p is not None else 0}
+        if p is None:
+            # no prefetcher, no depth claim: a constant 0 would read as
+            # starvation to the incident engine (same rule as token_loop)
+            return {}
+        out = {"prefetch_depth": p.depth}
+        if hasattr(p, "stats"):
+            out.update(p.stats())
+        return out
 
     # ---- eval ------------------------------------------------------------
     def evaluate(self, step: int, batch_size: Optional[int] = None) -> dict:

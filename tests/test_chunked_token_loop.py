@@ -43,6 +43,10 @@ def make_cfg(**kw):
         # be bitwise-transparent on clean runs — the equivalence tests
         # additionally pin guard_trips == 0 per record
         step_guard="on",
+        # incident engine enabled suite-wide (ISSUE 13): host-side only,
+        # so K∈{1,4} must stay bitwise with the watch ON and a clean run
+        # must raise ZERO incidents (_assert_route_telemetry)
+        incident_watch="on",
     )
     base.update(kw)
     return TrainConfig(**base)
@@ -196,7 +200,7 @@ def _assert_route_telemetry(route, kw, run_dir):
         fxb = status["forensics"]
         assert fxb["num_workers"] == n and fxb["accused_total"] > 0
         assert fxb["top_suspects"]
-        assert status["schema"] == 3
+        assert status["schema"] == 4
     elif kw.get("approach") == "approx":
         from draco_tpu.obs import forensics as fx
 
@@ -230,7 +234,7 @@ def _assert_route_telemetry(route, kw, run_dir):
         fxb = status["forensics"]
         assert fxb["accused_total"] == 0 and fxb["episodes_total"] == 0
         assert fxb["trust"] == [1.0] * n
-        assert status["schema"] == 3
+        assert status["schema"] == 4
     else:
         assert all("det_tp" not in r for r in train)
         assert all("wmask_accused0" not in r for r in train)
@@ -259,6 +263,13 @@ def _assert_route_telemetry(route, kw, run_dir):
     status = json.load(open(os.path.join(run_dir, "status.json")))
     assert status["compiles"] >= 1 and status["compile_s"] > 0
     assert status["steady_recompiles"] == 0
+    # the incident engine (ISSUE 13) ran on every cell of this suite and a
+    # CLEAN run — live adversary + stragglers all inside budget — raises
+    # ZERO incidents (no-flapping contract), while the bitwise assertions
+    # above prove the watch perturbs nothing; no event → no incidents.jsonl
+    inc = status["incidents"]
+    assert inc["total"] == 0 and inc["open"] == [] and inc["by_type"] == {}
+    assert not os.path.exists(os.path.join(run_dir, "incidents.jsonl"))
     ledger = [json.loads(l)
               for l in open(os.path.join(run_dir, "compiles.jsonl"))]
     labels = {r["program"] for r in ledger if r["program"]}

@@ -57,6 +57,10 @@ def make_cfg(**kw):
         # be bitwise-transparent on clean runs — the equivalence tests
         # additionally pin guard_trips == 0 per record
         step_guard="on",
+        # incident engine enabled suite-wide (ISSUE 13): host-side only,
+        # so K∈{1,4} must stay bitwise with the watch ON and a clean run
+        # must raise ZERO incidents (_assert_telemetry_artifacts)
+        incident_watch="on",
     )
     base.update(kw)
     return TrainConfig(**base)
@@ -299,6 +303,14 @@ def _assert_telemetry_artifacts(run_dir, approach):
     # the heartbeat surfaces the compile counters (ISSUE 5)
     assert status["compiles"] >= 1 and status["compile_s"] > 0
     assert status["steady_recompiles"] == 0
+    # the incident engine (ISSUE 13) ran on every cell of this suite and a
+    # CLEAN run — live adversary + stragglers all inside budget — raises
+    # ZERO incidents: the no-flapping/no-false-positive contract, at the
+    # same time the bitwise assertions above prove the watch perturbs
+    # nothing. No event ever fired, so no incidents.jsonl exists either.
+    inc = status["incidents"]
+    assert inc["total"] == 0 and inc["open"] == [] and inc["by_type"] == {}
+    assert not os.path.exists(run_dir / "incidents.jsonl")
     # ... and the compile ledger sits next to the trace, attributing the
     # chunked program's builds (main chunk k=4 + remainder k=2)
     ledger = [json.loads(l) for l in open(run_dir / "compiles.jsonl")]
@@ -333,7 +345,7 @@ def _assert_telemetry_artifacts(run_dir, approach):
         assert fxb["accused_total"] == 0 and fxb["episodes_total"] == 0
         assert fxb["top_suspects"] == []
         assert fxb["trust"] == [1.0] * 8
-        assert status["schema"] == 3
+        assert status["schema"] == 4
     else:
         health = status["decode_health"]
         assert health["precision"] == 1.0 and health["recall"] == 1.0
@@ -345,7 +357,7 @@ def _assert_telemetry_artifacts(run_dir, approach):
         assert fxb["accused_total"] > 0 and fxb["episodes_total"] > 0
         assert fxb["top_suspects"] and all(
             t["trust"] < 1.0 for t in fxb["top_suspects"])
-        assert status["schema"] == 3
+        assert status["schema"] == 4
         # the folded numerics block (ISSUE 10): worst-case shadow error
         # bounded, flag agreement never dipped below 1.0
         nx = status["numerics"]

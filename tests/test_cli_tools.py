@@ -558,6 +558,56 @@ def test_perf_watch_gates_on_flipped_chaos_attribution(tmp_path):
     assert "chaos.cnn_k4.nan_grad.attributed" in regs
 
 
+def test_perf_watch_gates_on_flipped_chaos_incident(tmp_path):
+    """ISSUE 13 acceptance control: a chaos cell whose expected incident
+    goes absent or mis-attributed (``incident.ok`` flips false) must gate
+    perf_watch nonzero at tolerance 0 and name cell + metric — the proof
+    the incident gate is live, not decorative."""
+    import json
+
+    from tools import perf_watch
+
+    root = tmp_path
+    (root / "baselines_out").mkdir()
+    matrix = {"all_ok": True, "rows": [
+        {"loop": "cnn_k4", "fault": "nan_grad", "ok": True,
+         "outcome": "guarded", "injected": [3], "accused": [3],
+         "attributed": True,
+         "incident": {"ok": True, "raised": ["guard", "nonfinite"],
+                      "required": ["nonfinite"]}},
+        {"loop": "approx_k4", "fault": "straggle", "ok": True,
+         "outcome": "degraded_bounded",
+         "incident": {"ok": True, "raised": [], "required": []}},
+    ]}
+    path = root / "baselines_out" / "chaos_matrix.json"
+    path.write_text(json.dumps(matrix))
+    assert perf_watch.main(["--root", str(root), "--snapshot"]) == 0
+    snap = json.loads(
+        (root / "baselines_out" / "perf_watch.json").read_text())
+    assert "chaos.cnn_k4.nan_grad.incident_ok" in snap["metrics"]
+    assert "chaos.approx_k4.straggle.incident_ok" in snap["metrics"]
+    assert perf_watch.main(["--root", str(root)]) == 0  # clean
+
+    # the detector goes blind: the expected incident is no longer raised
+    matrix["rows"][0]["incident"] = {
+        "ok": False, "raised": ["guard"], "required": ["nonfinite"],
+        "detail": "expected incident 'nonfinite' not raised"}
+    path.write_text(json.dumps(matrix))
+    out = root / "report.json"
+    assert perf_watch.main(["--root", str(root), "--json", str(out)]) == 1
+    regs = [r["metric"] for r in json.loads(out.read_text())["regressions"]]
+    assert "chaos.cnn_k4.nan_grad.incident_ok" in regs
+    # ...and a SPURIOUS incident on a clean-telemetry cell gates too
+    matrix["rows"][0]["incident"]["ok"] = True
+    matrix["rows"][1]["incident"] = {
+        "ok": False, "raised": ["throughput"], "required": [],
+        "detail": "spurious incident(s): ['throughput']"}
+    path.write_text(json.dumps(matrix))
+    assert perf_watch.main(["--root", str(root), "--json", str(out)]) == 1
+    regs = [r["metric"] for r in json.loads(out.read_text())["regressions"]]
+    assert "chaos.approx_k4.straggle.incident_ok" in regs
+
+
 def test_straggler_study_tool(tmp_path):
     """tools/straggler_study.py smoke (ISSUE 8): approx cells at e ∈ {0, 2}
     train on the chunked production loop, carry the residual-vs-bound

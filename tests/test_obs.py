@@ -1092,7 +1092,7 @@ def test_heartbeat_numerics_and_wire_blocks(tmp_path):
     """The heartbeat folds nx_/shadow_ columns into the ``numerics``
     status block (last values, running max of the danger fractions,
     running MIN of the flag agreement) and carries the static ``wire``
-    ledger stamped via set_wire — both under schema 3."""
+    ledger stamped via set_wire — both under the current schema."""
     from draco_tpu.obs import STATUS_SCHEMA
 
     hb = RunHeartbeat(str(tmp_path))
@@ -1107,7 +1107,7 @@ def test_heartbeat_numerics_and_wire_blocks(tmp_path):
                 "nx_grad_nonfinite": 0.0, "shadow_err": 0.002,
                 "shadow_flag_agree": 0.5})
     payload = hb.beat(2, 4)
-    assert payload["schema"] == STATUS_SCHEMA == 3
+    assert payload["schema"] == STATUS_SCHEMA == 4
     assert payload["wire"]["bytes_per_worker"]["bf16"] == 40
     nxb = payload["numerics"]
     assert nxb["nx_wire_absmax"] == 4.0  # last value

@@ -569,6 +569,21 @@ def test_committed_chaos_matrix_covers_every_fault_class():
         assert approx[(k, "nan_grad")]["outcome"] == "guarded"
         assert approx[(k, "nan_grad")]["attributed"]
         assert approx[(k, "sigterm")]["outcome"] == "preempted_resumed"
+    # every committed cell carries an incident verdict with ok true
+    # (obs/incidents.py, ISSUE 13): the expected incident type raised with
+    # the right worker attribution, nothing spurious — and the attributed
+    # fault classes really raised their attributed incident
+    for r in data["rows"]:
+        assert isinstance(r.get("incident"), dict), r
+        assert r["incident"]["ok"], r
+    for r in data["rows"]:
+        if r["fault"] == "nan_grad":
+            assert "nonfinite" in r["incident"]["raised"], r
+        if r["fault"] == "over_budget":
+            assert "guard" in r["incident"]["raised"], r
+        if r["fault"] in ("straggle", "sigterm", "ckpt_corrupt",
+                          "ckpt_truncate"):
+            assert r["incident"]["raised"] == [], r
     # perf_watch folds the matrix: a masked->crashed flip gates nonzero
     from tools import perf_watch
 

@@ -45,9 +45,19 @@ survival is a FAILED cell, because "the guard saved the run but nobody
 knows whose fault it was" is exactly the observability gap this layer
 closes.
 
+Every cell also runs the incident engine (``incident_watch="on"``,
+obs/incidents.py, ISSUE 13) and carries an ``incident`` verdict: the
+injected fault class must raise EXACTLY the expected incident type(s) —
+nan_grad the attributed ``nonfinite`` incident, over_budget the attributed
+``guard`` incident, prefetch faults ``starvation`` where the supervision
+restart is observable — and fault classes the resilience layer absorbs
+with clean telemetry (straggle inside budget, sigterm, checkpoint
+corruption) must raise NONE. An unraised, mis-typed, mis-attributed, or
+spurious incident is a FAILED cell.
+
 ``tools/perf_watch.py`` folds the committed matrix, so a fault class
-silently flipping from masked/guarded to FAILED — or an ``attributed``
-flag flipping false — gates nonzero.
+silently flipping from masked/guarded to FAILED — or an ``attributed`` /
+``incident.ok`` flag flipping false — gates nonzero.
 
 Usage (CPU, ~10 min):
   python tools/chaos_run.py --cpu-mesh 8
@@ -108,6 +118,10 @@ def _base_cfg_kw():
         # 10): the columns must stay finite-sentineled under each fault
         # class — the nan_grad cells assert it (_numerics_verdict)
         numerics_watch="on",
+        # incident engine on in EVERY cell (obs/incidents.py, ISSUE 13):
+        # each fault class must raise exactly its expected incident type
+        # with the right worker attribution (_incident_verdict)
+        incident_watch="on",
     )
 
 
@@ -192,20 +206,15 @@ def _status(train_dir):
             status = json.load(fh)
     except Exception:
         return {}
-    # versioned payloads (obs/heartbeat.STATUS_SCHEMA) must be a schema this
-    # harness understands; pre-versioning files carry no field (tolerated).
-    # A real exception (not assert: survives -O) — an unknown schema means
-    # the harness and the loops disagree on the payload shape, and folding
-    # it silently would misclassify every cell
-    from draco_tpu.obs.heartbeat import STATUS_SCHEMA
+    # versioned payloads must satisfy the central schema contract table
+    # (obs/heartbeat.check_status_schema); pre-versioning files carry no
+    # field (tolerated). An unknown schema means the harness and the loops
+    # disagree on the payload shape, and folding it silently would
+    # misclassify every cell
+    from draco_tpu.obs.heartbeat import check_status_schema
 
-    schema = status.get("schema")
-    if schema is not None and schema != STATUS_SCHEMA:
-        raise SystemExit(
-            f"{train_dir}/status.json schema {schema!r} != known "
-            f"{STATUS_SCHEMA} — update tools/chaos_run.py alongside "
-            f"obs/heartbeat.STATUS_SCHEMA")
-    return status
+    return check_status_schema(status, f"{train_dir}/status.json",
+                               "tools/chaos_run.py")
 
 
 def _accusation(train_dir, fault, step):
@@ -216,21 +225,11 @@ def _accusation(train_dir, fault, step):
     step's live adversary row (packed in-graph as the seeded ground truth)
     for over_budget. ``attributed``: every injected worker is in the
     step's accused set."""
+    from draco_tpu.obs import replay
     from draco_tpu.obs.forensics import record_masks
 
-    rec = None
-    try:
-        with open(os.path.join(train_dir, "metrics.jsonl")) as fh:
-            for line in fh:
-                try:
-                    r = json.loads(line)
-                except ValueError:
-                    continue
-                if r.get("step") == step and r.get("split") != "eval" \
-                        and "loss" in r:
-                    rec = r
-    except OSError:
-        pass
+    rec = replay.record_at_step(os.path.join(train_dir, "metrics.jsonl"),
+                                step)
     masks = record_masks(rec, NUM_WORKERS) if rec else None
     if masks is None:
         return None, None, False
@@ -252,20 +251,10 @@ def _straggle_verdict(train_dir, worker, step):
     decode_residual_bound (the ISSUE 8 certificate); ``never_accused`` —
     the scheduled straggler's accused bit never fires (absence is an
     erasure, not evidence; obs/forensics)."""
+    from draco_tpu.obs import replay
     from draco_tpu.obs.forensics import record_masks
 
-    recs = []
-    try:
-        with open(os.path.join(train_dir, "metrics.jsonl")) as fh:
-            for line in fh:
-                try:
-                    r = json.loads(line)
-                except ValueError:
-                    continue
-                if r.get("split") != "eval" and "loss" in r:
-                    recs.append(r)
-    except OSError:
-        pass
+    recs = replay.train_records(os.path.join(train_dir, "metrics.jsonl"))
     if not recs:
         return {"dropped": False, "bounded": False, "never_accused": False}
     dropped = bounded = never_accused = True
@@ -294,19 +283,10 @@ def _numerics_verdict(train_dir, step):
     block. Returns {numerics_finite, fault_visible}."""
     import math
 
-    rec = None
-    try:
-        with open(os.path.join(train_dir, "metrics.jsonl")) as fh:
-            for line in fh:
-                try:
-                    r = json.loads(line)
-                except ValueError:
-                    continue
-                if r.get("step") == step and r.get("split") != "eval" \
-                        and "loss" in r:
-                    rec = r
-    except OSError:
-        pass
+    from draco_tpu.obs import replay
+
+    rec = replay.record_at_step(os.path.join(train_dir, "metrics.jsonl"),
+                                step)
     if rec is None or "nx_grad_nonfinite" not in rec:
         return {"numerics_finite": False, "fault_visible": False}
     # the observatory columns + the training metrics must be finite; the
@@ -320,6 +300,80 @@ def _numerics_verdict(train_dir, step):
              or k in ("loss", "prec1")))
     return {"numerics_finite": bool(finite),
             "fault_visible": bool(rec["nx_grad_nonfinite"] > 0.0)}
+
+
+def _expected_incidents(loop, fault):
+    """The cell's incident contract (obs/incidents.py, ISSUE 13):
+    ``required`` = [(type, attribution)] that MUST be raised — attribution
+    is a worker list, "injected" (the cell's injected set must be a subset
+    of the incident's workers), or None (no attribution expected);
+    ``allowed`` = extra types tolerated alongside. Any raised type outside
+    required ∪ allowed is a spurious incident and FAILS the cell."""
+    if fault == "nan_grad":
+        # the non-finite ingest incident, attributed to the named victim;
+        # the guard trip + loud residual + a trust dip ride along
+        return ([("nonfinite", [NAN_WORKER])],
+                {"guard", "decode_residual", "trust"})
+    if fault == "over_budget":
+        # the guard skips the poisoned update: the incident must name (at
+        # least) every injected adversary; the loud residual rides along
+        return ([("guard", "injected")],
+                {"decode_residual", "nonfinite", "trust"})
+    if fault == "prefetch_crash":
+        # supervised restart (resilience/supervisor.py) surfaces at the
+        # next beat as the starvation incident — no worker to name
+        return [("starvation", None)], set()
+    if fault == "prefetch_hang":
+        # the LM token prefetcher stalls (PrefetchStallError → restart);
+        # the CNN chunk gather pays the sleep inline on the main thread,
+        # so there is no restart and nothing to detect
+        if loop.startswith("lm"):
+            return [("starvation", None)], set()
+        return [], {"starvation", "throughput"}
+    # straggle (a within-budget erasure — the approx family's NORMAL
+    # regime), sigterm (graceful preemption), ckpt_* (offline recovery):
+    # the resilience layer absorbs these with clean telemetry, and a
+    # spurious incident is exactly the flapping the hysteresis exists to
+    # prevent
+    return [], set()
+
+
+def _incident_verdict(train_dir, loop, fault, injected=None):
+    """Diff the cell's incidents.jsonl onsets against the contract. The
+    ledger is torn/empty/missing tolerated (obs/replay) — an expected
+    incident that never made it to disk is exactly a FAILED verdict."""
+    from draco_tpu.obs import replay
+
+    onsets = [e for e in replay.iter_jsonl(
+        os.path.join(train_dir, "incidents.jsonl"))
+        if e.get("event") == "onset" and e.get("type")]
+    raised = sorted({e["type"] for e in onsets})
+    required, allowed = _expected_incidents(loop, fault)
+    ok, details = True, []
+    for typ, attr in required:
+        ons = [e for e in onsets if e["type"] == typ]
+        if not ons:
+            ok = False
+            details.append(f"expected incident {typ!r} not raised")
+            continue
+        if attr is not None:
+            want = set(injected or []) if attr == "injected" else set(attr)
+            got = set()
+            for e in ons:
+                got |= set(e.get("workers") or [])
+            if not want or not want <= got:
+                ok = False
+                details.append(f"{typ} attributed {sorted(got)}, expected "
+                               f"superset of {sorted(want)}")
+    unexpected = set(raised) - {t for t, _ in required} - allowed
+    if unexpected:
+        ok = False
+        details.append(f"spurious incident(s): {sorted(unexpected)}")
+    verdict = {"ok": ok, "raised": raised,
+               "required": [t for t, _ in required]}
+    if details:
+        verdict["detail"] = "; ".join(details)
+    return verdict
 
 
 def _attempt(run, cfg, steps=None):
@@ -342,6 +396,13 @@ def run_case(loop: str, fault: str, make_cfg, run, clean_vec, workdir):
 
     d = os.path.join(workdir, f"{loop}_{fault}")
     row = {"loop": loop, "fault": fault, "ok": False, "outcome": "FAILED"}
+    # a REUSED --workdir must not let a previous invocation's onsets
+    # satisfy (or violate) this run's incident contract — the verdict
+    # folds every onset in the cell's incidents.jsonl
+    try:
+        os.remove(os.path.join(d, "incidents.jsonl"))
+    except OSError:
+        pass
 
     if fault in ("ckpt_corrupt", "ckpt_truncate"):
         # victim run (no injection during training), then corrupt the
@@ -540,9 +601,22 @@ def main(argv=None) -> int:
             raise SystemExit(f"chaos_run: clean {loop} run failed: {err}")
         for fault in faults:
             row = run_case(loop, fault, make_cfg, run, clean_vec, workdir)
+            # incident contract (ISSUE 13): exactly the expected incident
+            # type(s), correctly attributed, nothing spurious — checked on
+            # the cell's own incidents.jsonl (resume runs append to it)
+            verdict = _incident_verdict(
+                os.path.join(workdir, f"{loop}_{fault}"), loop, fault,
+                row.get("injected"))
+            row["incident"] = verdict
+            if row["ok"] and not verdict["ok"]:
+                row.update(ok=False, outcome="FAILED",
+                           detail=f"incident verdict: "
+                                  f"{verdict.get('detail', '?')}")
             rows.append(row)
+            inc = "+".join(verdict["raised"]) or "-"
             print(f"chaos_run: {loop:9s} {fault:15s} -> "
-                  f"{row['outcome']}{'' if row['ok'] else '  ** FAILED'}",
+                  f"{row['outcome']:18s} incidents: {inc}"
+                  f"{'' if row['ok'] else '  ** FAILED'}",
                   flush=True)
 
     by_fault = {}

@@ -20,10 +20,17 @@ exits nonzero NAMING THE FIRST FAILURE:
   program_lint        committed all_ok roll-up
   chaos_matrix        committed all_ok roll-up
   straggler_study     committed all_ok roll-up
+  chaos incident      every committed chaos cell carries an ``incident``
+      coverage        verdict with ok true (expected type raised +
+                      attributed, nothing spurious — ISSUE 13)
   trace_report smoke  folds a synthesized trace.json + metrics.jsonl +
-                      schema-current status.json without error
+                      schema-current status.json (incl. the ``incidents``
+                      block) without error
   forensics_report    folds a synthesized packed-mask metrics.jsonl and
       smoke           reproduces the expected per-worker fold
+  incident_report     live engine over a synthesized trust collapse →
+      smoke           incidents.jsonl; the jax-free replay must reproduce
+                      the ledger exactly, torn tail tolerated
 
 Pure artifact folding — runs on a laptop against an scp'd checkout, no
 accelerator stack. Wired into tests/test_cli_tools.py.
@@ -117,7 +124,12 @@ def _check_trace_report(root):
                                                 "int8": 14}},
                   "numerics": {"nx_wire_absmax": 1.0,
                                "shadow_err_max": 0.001,
-                               "shadow_flag_agree_min": 1.0}}
+                               "shadow_flag_agree_min": 1.0},
+                  "incidents": {"total": 1, "open": [],
+                                "by_type": {"guard": 1},
+                                "last": {"type": "guard", "severity":
+                                         "critical", "onset_step": 1,
+                                         "workers": [2], "open": False}}}
         with open(os.path.join(d, "status.json"), "w") as fh:
             json.dump(status, fh)
         rc = trace_report.main([d])
@@ -142,6 +154,76 @@ def _check_forensics_report(root):
         return None
 
 
+def _check_chaos_incidents(root):
+    """ISSUE 13: every committed chaos cell must carry an ``incident``
+    verdict with ok true (the expected incident type raised, attributed,
+    nothing spurious) — a matrix regenerated without the incident watch,
+    or with a blind detector, trips here jax-free."""
+    path = os.path.join(root, "baselines_out", "chaos_matrix.json")
+    try:
+        with open(path) as fh:
+            data = json.load(fh)
+    except (OSError, ValueError) as e:
+        return f"cannot read chaos_matrix.json: {e}"
+    rows = data.get("rows") or []
+    if not rows:
+        return "chaos_matrix.json has no rows"
+    for row in rows:
+        verdict = row.get("incident")
+        if not isinstance(verdict, dict):
+            return (f"cell ({row.get('loop')}, {row.get('fault')}) carries "
+                    f"no incident verdict — regenerate the matrix with "
+                    f"tools/chaos_run.py (incident_watch is on in every "
+                    f"cell)")
+        if not verdict.get("ok"):
+            return (f"cell ({row.get('loop')}, {row.get('fault')}) incident "
+                    f"verdict failed: {verdict.get('detail', verdict)}")
+    return None
+
+
+def _check_incident_report(root):
+    """Schema smoke: the live engine writes incidents.jsonl over a
+    synthesized trust-collapse stream, and the jax-free replay
+    (tools/incident_report.py) must reproduce the ledger EXACTLY — then a
+    torn tail line must be tolerated. One engine implementation for the
+    live fold and the replay, so a divergence here is a real defect."""
+    from draco_tpu.obs import incidents as incidents_mod
+    from tools import incident_report
+
+    with tempfile.TemporaryDirectory(prefix="check_inc_") as d:
+        recs = []
+        for step in range(1, 11):
+            accused = 0b0100 if step <= 6 else 0
+            recs.append({"step": step, "loss": 1.0,
+                         "wmask_accused0": accused,
+                         "wmask_present0": 0b1111,
+                         "wmask_adv0": accused})
+        with open(os.path.join(d, "metrics.jsonl"), "w") as fh:
+            fh.write("\n".join(json.dumps(r) for r in recs) + "\n")
+        engine = incidents_mod.IncidentEngine(
+            num_workers=4, out_path=os.path.join(d, "incidents.jsonl"))
+        for r in recs:
+            engine.observe(r)
+        engine.finalize()
+        if engine.total_onsets != 1:
+            return (f"synthesized trust collapse raised "
+                    f"{engine.total_onsets} incidents, expected 1")
+        rc = incident_report.main([d, "--num-workers", "4"])
+        if rc != 0:
+            return f"incident_report replay diverged (exit {rc})"
+        rep = json.load(open(os.path.join(d, "incidents_report.json")))
+        if not rep["diff"]["match"]:
+            return f"incident_report diff mismatch: {rep['diff']}"
+        if rep["replayed"][0]["type"] != "trust" \
+                or rep["replayed"][0]["workers"] != [2]:
+            return f"replay mis-attributed: {rep['replayed'][0]}"
+        # torn tail: killed mid-write must not take the report down
+        with open(os.path.join(d, "incidents.jsonl"), "a") as fh:
+            fh.write('{"v": 1, "event": "ons')
+        rc = incident_report.main([d, "--num-workers", "4"])
+        return None if rc == 0 else f"torn-tail replay exited {rc}"
+
+
 CHECKS = (
     ("perf_watch", _check_perf_watch),
     ("device_profile --check", _check_device_profile),
@@ -151,10 +233,12 @@ CHECKS = (
      _flag_check(os.path.join("baselines_out", "program_lint.json"))),
     ("chaos_matrix all_ok",
      _flag_check(os.path.join("baselines_out", "chaos_matrix.json"))),
+    ("chaos incident coverage", _check_chaos_incidents),
     ("straggler_study all_ok",
      _flag_check(os.path.join("baselines_out", "straggler_study.json"))),
     ("trace_report smoke", _check_trace_report),
     ("forensics_report smoke", _check_forensics_report),
+    ("incident_report smoke", _check_incident_report),
 )
 
 

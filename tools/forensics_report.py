@@ -39,69 +39,26 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 # draco_tpu.obs is importable without jax (packing imports it lazily and
 # this tool never packs) — one ledger implementation for the live heartbeat
-# and this offline fold, so the two cannot drift
-from draco_tpu.obs.forensics import (  # noqa: E402
-    MASK_PREFIX,
-    AccusationLedger,
-    unpack_bits,
-)
-from draco_tpu.obs.heartbeat import STATUS_SCHEMA  # noqa: E402
+# and this offline fold, so the two cannot drift; the torn-tolerant JSONL
+# reading is the shared replay scaffold (obs/replay.py, ISSUE 13 satellite)
+from draco_tpu.obs import replay  # noqa: E402
+from draco_tpu.obs.forensics import AccusationLedger  # noqa: E402
 
 
 def load_records(path: str) -> list:
     """Train records from metrics.jsonl; blank/torn lines skipped, eval
     records dropped. [] when the file is missing or empty — a killed run
-    must not take the report down with it."""
-    out = []
-    try:
-        fh = open(path)
-    except OSError:
-        return out
-    with fh:
-        for line in fh:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                rec = json.loads(line)
-            except ValueError:
-                continue  # torn tail line of an interrupted run
-            if not isinstance(rec, dict) or rec.get("split") == "eval":
-                continue
-            out.append(rec)
-    return out
+    must not take the report down with it (obs/replay.py). Mask-only
+    records without a loss still fold (require_loss=False: the ledger
+    ignores whatever lacks masks anyway)."""
+    return replay.train_records(path, require_loss=False)
 
 
 def infer_num_workers(records: list, status_path: str) -> int:
-    """--num-workers fallback chain (module docstring)."""
-    try:
-        with open(status_path) as fh:
-            status = json.load(fh)
-        if isinstance(status, dict):
-            schema = status.get("schema")
-            if schema is not None and schema != STATUS_SCHEMA:
-                raise SystemExit(
-                    f"{status_path}: status schema {schema} != known "
-                    f"{STATUS_SCHEMA} — update tools/forensics_report.py "
-                    f"alongside obs/heartbeat.py")
-            n = (status.get("forensics") or {}).get("num_workers")
-            if n:
-                return int(n)
-    except (OSError, ValueError):
-        pass
-    # highest present bit across the run + 1
-    hi = 0
-    for rec in records:
-        words = []
-        w = 0
-        while f"{MASK_PREFIX}present{w}" in rec:
-            words.append(int(rec[f"{MASK_PREFIX}present{w}"]))
-            w += 1
-        if words:
-            bits = unpack_bits(words, len(words) * 32)
-            if any(bits):
-                hi = max(hi, max(i for i, b in enumerate(bits) if b) + 1)
-    return max(hi, 1)
+    """--num-workers fallback chain — the ONE shared implementation
+    (obs/replay.infer_num_workers; incident_report uses it too)."""
+    return replay.infer_num_workers(records, status_path,
+                                    "tools/forensics_report.py")
 
 
 def make_report(metrics_path: str, num_workers: int = 0) -> dict:

@@ -265,7 +265,13 @@ def fold_chaos(root: str, metrics: dict) -> None:
     roll-up — masked→crashed is a 1→0 flip on a 0-tolerance "ok" metric.
     Worker-targeted cells additionally carry a forensics ``attributed``
     flag (the accused set named every injected worker, tools/chaos_run.py):
-    an attribution silently flipping false gates at tolerance 0 too."""
+    an attribution silently flipping false gates at tolerance 0 too.
+    Every cell now also carries an ``incident`` verdict (obs/incidents.py,
+    ISSUE 13 — the expected incident type raised with the right worker
+    attribution, nothing spurious): the per-cell ``incident.ok`` folds at
+    tolerance 0, so a detector silently going blind (or flapping) on a
+    committed fault class gates nonzero — the flipped-row control test in
+    tests/test_cli_tools.py proves that gate live."""
     path = os.path.join(root, "baselines_out", "chaos_matrix.json")
     data = _read_json(path)
     if not isinstance(data, dict):
@@ -293,6 +299,12 @@ def fold_chaos(root: str, metrics: dict) -> None:
                 metrics[f"chaos.{loop}.{fault}.{flag}"] = {
                     "value": float(bool(row[flag])), "kind": "ok",
                     "source": src}
+        # ISSUE 13 incident verdict: the cell's expected incident type
+        # raised + attributed, nothing spurious — 0-tolerance gate
+        if isinstance(row.get("incident"), dict):
+            metrics[f"chaos.{loop}.{fault}.incident_ok"] = {
+                "value": float(bool(row["incident"].get("ok"))),
+                "kind": "ok", "source": src}
 
 
 def fold_straggler(root: str, metrics: dict) -> None:

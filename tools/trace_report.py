@@ -95,44 +95,32 @@ def fold_metrics(path: str) -> dict:
     cumulative guard totals and the run's final decode-health detection
     precision/recall folded from the per-step columns (the PR 6 guard
     columns and PR 4 health counts used to be invisible to this jax-free
-    path). Blank or torn lines are skipped — a run killed mid-write must
-    not take the report down with it."""
+    path). Torn/empty/missing states are the shared replay scaffold's job
+    (draco_tpu/obs/replay.py — one tolerance rule for every report tool)."""
     steps = 0
     sums = collections.defaultdict(float)
     first = last = None
     guard_seen = health_seen = False
-    with open(path) as fh:
-        for line in fh:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                rec = json.loads(line)
-            except ValueError:
-                continue  # torn tail line of an interrupted run
-            if not isinstance(rec, dict):
-                continue
-            if "loss" not in rec or rec.get("split") == "eval":
-                continue
-            steps += 1
-            last = rec
-            if first is None:
-                first = rec
-            for key in ("t_fetch", "t_comp"):
-                if key in rec:
-                    sums[key] += float(rec[key])
-            if "guard_trips" in rec:
-                guard_seen = True
-                sums["guard_trips"] += float(rec["guard_trips"])
-                sums["skipped_steps"] += float(rec.get("skipped_steps", 0.0))
-            if "det_tp" in rec:
-                health_seen = True
-                sums["det_tp"] += float(rec["det_tp"])
-                sums["det_adv"] += float(rec.get("det_adv", 0.0))
-                for k in ("located_errors", "det_flagged"):
-                    if k in rec:
-                        sums["det_flagged"] += float(rec[k])
-                        break
+    for rec in _train_records(path):
+        steps += 1
+        last = rec
+        if first is None:
+            first = rec
+        for key in ("t_fetch", "t_comp"):
+            if key in rec:
+                sums[key] += float(rec[key])
+        if "guard_trips" in rec:
+            guard_seen = True
+            sums["guard_trips"] += float(rec["guard_trips"])
+            sums["skipped_steps"] += float(rec.get("skipped_steps", 0.0))
+        if "det_tp" in rec:
+            health_seen = True
+            sums["det_tp"] += float(rec["det_tp"])
+            sums["det_adv"] += float(rec.get("det_adv", 0.0))
+            for k in ("located_errors", "det_flagged"):
+                if k in rec:
+                    sums["det_flagged"] += float(rec[k])
+                    break
     out = {"train_records": steps}
     out.update({f"{k}_total_s": round(v, 4) for k, v in sums.items()
                 if k in ("t_fetch", "t_comp")})
@@ -151,13 +139,38 @@ def fold_metrics(path: str) -> dict:
     return out
 
 
-# status.json schema versions this report knows how to read — mirrors
-# obs/heartbeat.STATUS_SCHEMA (hardcoded: this tool is jax-free AND
-# draco_tpu-free, usable from a bare checkout of tools/). Pre-versioning
-# files carry no field and are accepted. Schema 3 adds the additive
-# ``wire``/``numerics`` blocks (ISSUE 10); schema-2 payloads stay
-# readable (the blocks just never appear).
-KNOWN_STATUS_SCHEMAS = (2, 3)
+# The status.json schema contract lives in ONE table now —
+# obs/heartbeat.STATUS_BLOCKS / check_status_schema (ISSUE 13 satellite:
+# previously this tool carried its own accepted-set literal, and a schema
+# bump could strand it). draco_tpu/obs imports without jax; only a BARE
+# tools/ checkout (no package at all) degrades to unvalidated folding with
+# a visible note, the same discipline as fold_device's capture probe.
+try:
+    from draco_tpu.obs.heartbeat import check_status_schema
+    from draco_tpu.obs.replay import train_records as _train_records
+except ImportError:  # bare tools/ checkout
+    check_status_schema = None
+
+    def _train_records(path):
+        out = []
+        try:
+            fh = open(path)
+        except OSError:
+            return out
+        with fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue  # torn tail line of an interrupted run
+                if not isinstance(rec, dict) or "loss" not in rec \
+                        or rec.get("split") == "eval":
+                    continue
+                out.append(rec)
+        return out
 
 
 def fold_status(path: str) -> dict:
@@ -165,8 +178,9 @@ def fold_status(path: str) -> dict:
     done/preempted/crashed/running (+ cause / resumable_step) — how an
     operator tells a crash from a preemption from a finished run without a
     traceback. {} when no status.json exists. A ``schema`` field, when
-    present, must be one this report understands — silently folding an
-    unknown payload shape would misreport the run."""
+    present, must satisfy the central contract table
+    (obs/heartbeat.check_status_schema) — silently folding an unknown
+    payload shape would misreport the run."""
     try:
         with open(path) as fh:
             status = json.load(fh)
@@ -174,17 +188,15 @@ def fold_status(path: str) -> dict:
         return {}
     if not isinstance(status, dict):
         return {}
-    schema = status.get("schema")
-    if schema is not None and schema not in KNOWN_STATUS_SCHEMAS:
-        raise SystemExit(
-            f"{path}: status.json schema {schema!r} not in known "
-            f"{KNOWN_STATUS_SCHEMAS} — update tools/trace_report.py "
-            f"alongside obs/heartbeat.STATUS_SCHEMA")
+    if check_status_schema is not None:
+        check_status_schema(status, path, "tools/trace_report.py")
     out = {}
     for key in ("schema", "state", "cause", "resumable_step", "step",
-                "updated_at", "wire", "numerics"):
+                "updated_at", "wire", "numerics", "incidents"):
         if key in status:
             out[key] = status[key]
+    if check_status_schema is None and "schema" in status:
+        out["schema_unvalidated"] = True  # bare checkout: note, don't guess
     return out
 
 
@@ -321,6 +333,20 @@ def print_table(report: dict, out=None) -> None:
                 bits.append(f"{k.replace('nx_', '')}={nx[k]:.4g}")
         if bits:
             print("numerics: " + "  ".join(bits), file=out)
+    # incident engine roll-up (obs/incidents.py, ISSUE 13): the status
+    # block a watch-enabled run stamps — open episodes are the headline
+    inc = (status or {}).get("incidents")
+    if inc:
+        line = f"incidents: {inc.get('total', 0)} total"
+        by_type = inc.get("by_type") or {}
+        if by_type:
+            line += " (" + ", ".join(f"{k}:{v}" for k, v
+                                     in sorted(by_type.items())) + ")"
+        for ep in inc.get("open") or []:
+            workers = ",".join(map(str, ep.get("workers") or ())) or "-"
+            line += (f"   OPEN {ep.get('type')}@{ep.get('onset_step')} "
+                     f"workers={workers}")
+        print(line, file=out)
     # guard + decode-health header (folded from the per-step columns —
     # previously invisible to this jax-free path)
     m = report.get("metrics") or {}
