@@ -6,12 +6,18 @@ CONST_=-100):
   * plain paths (baseline / repetition):
       rev_grad : g -> -100 * g
       constant : g -> -100 * ones
-      random   : passthrough (a TODO in the reference, kept for parity)
+      random   : g -> -100 * N(0, 1) noise, seeded per (seed, step) —
+                 implemented here (the reference left it a TODO and passed
+                 the gradient through untouched); the draw folds the same
+                 deterministic (seed, step) discipline as every schedule,
+                 so all devices and both regimes agree bit-for-bit, and
+                 per-ROW noise keeps repetition-group collusion impossible
   * cyclic path (``cyclic=True``) the attack is *additive* on top of the
     honest encoded value:
       rev_grad : g -> g + (-100 * g)      (i.e. -99 * g)
       constant : g -> g + (-100 * ones)   (adds to the real part only, since
                   the reference adds a float array to a complex one)
+      random   : g -> g + (-100 * noise)  (independent re/im draws)
 
 Attacks are applied inside the jitted step with jnp.where over a per-step
 per-worker boolean mask (the schedule from draco_tpu.rng.adversary_schedule),
@@ -25,10 +31,35 @@ import jax.numpy as jnp
 
 ADVERSARY = -100.0
 CONST = -100.0
+# the random attack's key salt (seed + _RANDOM_SALT), alongside the
+# augment/dropout/vote-fingerprint salts in training/step.py (+2/+3/+4)
+_RANDOM_SALT = 7
 _ALIE_INERT_WARNED = set()  # one warning per inert (n, n_mal) pair
 
 
-def attack_plain(grads: jnp.ndarray, err_mode: str, magnitude: float = ADVERSARY) -> jnp.ndarray:
+def random_key(seed, step):
+    """The random attack's per-step key — folded from (seed, step) exactly
+    like every other schedule draw, so all devices and both execution
+    regimes (eager / K-fused scan with a traced step) agree bit-for-bit."""
+    import jax
+
+    return jax.random.fold_in(jax.random.key(seed + _RANDOM_SALT),
+                              jnp.asarray(step, jnp.int32))
+
+
+def _require_key(key):
+    if key is None:
+        raise ValueError(
+            "err_mode='random' needs the per-step key (attacks.random_key"
+            "(seed, step)) — the seeded random-gradient attack rides the "
+            "same deterministic (seed, step) schedule discipline as "
+            "everything else; a keyless call has no stream to draw from"
+        )
+    return key
+
+
+def attack_plain(grads: jnp.ndarray, err_mode: str,
+                 magnitude: float = ADVERSARY, key=None) -> jnp.ndarray:
     """Adversarial transform of raw per-worker gradients, shape (n, d).
 
     ``magnitude`` is the reference's --adversarial knob (distributed_nn.py:66;
@@ -38,11 +69,15 @@ def attack_plain(grads: jnp.ndarray, err_mode: str, magnitude: float = ADVERSARY
     if err_mode == "constant":
         return jnp.full_like(grads, magnitude)
     if err_mode == "random":
-        return grads
+        import jax
+
+        return magnitude * jax.random.normal(_require_key(key), grads.shape,
+                                             grads.dtype)
     raise ValueError(f"unknown err_mode: {err_mode}")
 
 
-def attack_cyclic(enc_re: jnp.ndarray, enc_im: jnp.ndarray, err_mode: str, magnitude: float = ADVERSARY):
+def attack_cyclic(enc_re: jnp.ndarray, enc_im: jnp.ndarray, err_mode: str,
+                  magnitude: float = ADVERSARY, key=None):
     """Adversarial transform of encoded rows, real/imag parts, shape (n, d)."""
     if err_mode == "rev_grad":
         return enc_re + magnitude * enc_re, enc_im + magnitude * enc_im
@@ -50,7 +85,13 @@ def attack_cyclic(enc_re: jnp.ndarray, enc_im: jnp.ndarray, err_mode: str, magni
         # complex + real array: only the real part shifts
         return enc_re + magnitude, enc_im
     if err_mode == "random":
-        return enc_re, enc_im
+        import jax
+
+        kr, ki = jax.random.split(_require_key(key))
+        return (enc_re + magnitude * jax.random.normal(kr, enc_re.shape,
+                                                       enc_re.dtype),
+                enc_im + magnitude * jax.random.normal(ki, enc_im.shape,
+                                                       enc_im.dtype))
     raise ValueError(f"unknown err_mode: {err_mode}")
 
 
@@ -80,7 +121,7 @@ def _alie_z(n: int, n_mal: int) -> float:
 
 def inject_plain(
     grads: jnp.ndarray, mask: jnp.ndarray, err_mode: str,
-    magnitude: float = ADVERSARY, n_mal: int = 1,
+    magnitude: float = ADVERSARY, n_mal: int = 1, step=None, seed=None,
 ) -> jnp.ndarray:
     """grads: (n, d); mask: (n,) bool — True rows are Byzantine.
 
@@ -124,13 +165,20 @@ def inject_plain(
         else:
             bad = -0.5 * scale * mu
         return jnp.where(mask[:, None], bad[None, :], grads)
-    return jnp.where(mask[:, None], attack_plain(grads, err_mode, magnitude), grads)
+    key = (random_key(seed, step) if err_mode == "random"
+           and step is not None and seed is not None else None)
+    return jnp.where(mask[:, None],
+                     attack_plain(grads, err_mode, magnitude, key=key),
+                     grads)
 
 
 def inject_cyclic(
     enc_re: jnp.ndarray, enc_im: jnp.ndarray, mask: jnp.ndarray, err_mode: str,
-    magnitude: float = ADVERSARY,
+    magnitude: float = ADVERSARY, step=None, seed=None,
 ):
-    bad_re, bad_im = attack_cyclic(enc_re, enc_im, err_mode, magnitude)
+    key = (random_key(seed, step) if err_mode == "random"
+           and step is not None and seed is not None else None)
+    bad_re, bad_im = attack_cyclic(enc_re, enc_im, err_mode, magnitude,
+                                   key=key)
     m = mask[:, None]
     return jnp.where(m, bad_re, enc_re), jnp.where(m, bad_im, enc_im)

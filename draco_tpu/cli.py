@@ -237,6 +237,24 @@ def add_fit_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                         "separated '<detector>.<key>=<float>' (e.g. "
                         "'trust.floor=0.4'); keys validated against the "
                         "declarative registry (PERF.md §15 table)")
+    p.add_argument("--autopilot", type=str, default="off",
+                   choices=["off", "on"],
+                   help="adaptive coding autopilot (draco_tpu/control): "
+                        "consume the incident stream at chunk boundaries "
+                        "and emit remediations — quarantine trust-"
+                        "collapsed workers, dial cyclic redundancy down "
+                        "to approx under sustained straggle/starvation "
+                        "(and back up on clean evidence), drop the "
+                        "shadow dtype on numerics_drift; warm cached "
+                        "program swaps, every decision an attributed "
+                        "remediation event + control status block. Needs "
+                        "--incident-watch on, a --train-dir and "
+                        "--steps-per-call > 1")
+    p.add_argument("--autopilot-policy", type=str, default="",
+                   help="autopilot policy overrides, comma-separated "
+                        "'<key>=<float>' (e.g. 'r_low=1.2,"
+                        "clean_boundaries=3'); keys validated against "
+                        "control.autopilot.DEFAULT_POLICY (PERF.md §16)")
     p.add_argument("--compile-warmup", type=int, default=1,
                    help="XLA builds allowed per registered program (per "
                         "chunk shape) before the compile guard treats a "
@@ -361,6 +379,8 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         shadow_block=args.shadow_block,
         incident_watch=args.incident_watch,
         incident_thresholds=args.incident_thresholds,
+        autopilot=args.autopilot,
+        autopilot_policy=args.autopilot_policy,
         step_guard=args.step_guard,
         guard_residual_tol=args.guard_residual_tol,
         fault_spec=args.fault_spec,

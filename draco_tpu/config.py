@@ -275,6 +275,26 @@ class TrainConfig:
     # time. "" keeps every registered default (PERF.md §15 table).
     incident_thresholds: str = ""
 
+    # --- adaptive coding autopilot (draco_tpu/control; ROADMAP item 5) ---
+    # "on": a host-side policy engine consumes the incident stream at
+    # chunk boundaries and emits remediations — quarantine a
+    # trust-collapsed worker (present-mask exclusion), dial exact cyclic
+    # redundancy down to the approx family under sustained
+    # straggle/starvation episodes (and back up on sustained clean
+    # evidence), drop the shadow wire dtype on numerics_drift. Family
+    # swaps are warm cached program swaps (0 steady retraces within a
+    # regime); every decision is an attributed `remediation` event in
+    # incidents.jsonl + a `control` status.json block. Requires
+    # incident_watch="on" (the sensing layer), a train_dir, the chunked
+    # regime (steps_per_call > 1 — chunk boundaries are the actuation
+    # points; the LM device-token-gen driver runs chunked at any K), and
+    # a cyclic/approx starting family.
+    autopilot: str = "off"
+    # "key=value,..." overrides of control.autopilot.DEFAULT_POLICY
+    # (hysteresis boundary counts, trust floor, r_low, budgets) —
+    # validated against the policy table at config time.
+    autopilot_policy: str = ""
+
     # --- resilience (draco_tpu/resilience; ISSUE 6) ---
     # In-graph step guard: fold the decode-health signals (loud
     # decode_residual, located rows beyond the s budget, vote disagreement
@@ -513,6 +533,44 @@ class TrainConfig:
             from draco_tpu.obs.incidents import parse_thresholds
 
             parse_thresholds(self.incident_thresholds)
+        if self.autopilot not in ("off", "on"):
+            raise ValueError(
+                f"autopilot must be off|on, got {self.autopilot!r}"
+            )
+        if self.autopilot == "on":
+            if self.incident_watch != "on":
+                raise ValueError(
+                    "autopilot='on' requires incident_watch='on' — the "
+                    "incident stream IS the sensing layer the policy "
+                    "engine actuates on (control/autopilot.py)"
+                )
+            if not self.train_dir:
+                raise ValueError(
+                    "autopilot='on' needs a train_dir (the incident "
+                    "stream and the control status block live there)"
+                )
+            if self.steps_per_call <= 1 and not (
+                    self.network == "TransformerLM"
+                    and self.token_gen == "device"):
+                raise ValueError(
+                    "autopilot='on' requires the chunked regime "
+                    "(steps_per_call > 1): chunk boundaries are the "
+                    "actuation points — remediations apply between "
+                    "dispatched chunks, never inside one"
+                )
+            if self.approach not in ("cyclic", "approx"):
+                raise ValueError(
+                    "autopilot='on' supports the algebraic code families "
+                    f"(cyclic|approx), got approach={self.approach!r} — "
+                    "the redundancy dial swaps between exactly those two"
+                )
+        if self.autopilot_policy:
+            # unknown policy keys surface at config time (DEFAULT_POLICY
+            # is the contract); the parsed dict is rebuilt where it is
+            # consumed (control.autopilot.make_autopilot)
+            from draco_tpu.control.autopilot import parse_policy
+
+            parse_policy(self.autopilot_policy)
         if self.step_guard not in ("off", "on"):
             raise ValueError(
                 f"step_guard must be off|on, got {self.step_guard!r}"
@@ -548,16 +606,17 @@ class TrainConfig:
 
             plan = FaultPlan.parse(self.fault_spec, self.seed,
                                    self.num_workers)
-            if self.approach == "approx" and plan.of_kind("over_budget"):
-                # over_budget marks schedule rows as live adversaries, but
+            if self.approach == "approx" \
+                    and plan.of_kind("over_budget", "adversary"):
+                # both kinds mark schedule rows as live adversaries, but
                 # the approx family injects no attacks (no Byzantine
                 # certificate) — the event would be silently inert while
                 # still flipping the packed adversary-mask telemetry
                 raise ValueError(
-                    "fault kind over_budget is not expressible under "
-                    "approach=approx (the family injects no adversaries); "
-                    "use straggle/nan_grad/host kinds, or cyclic/maj_vote "
-                    "for Byzantine-budget faults"
+                    "fault kinds over_budget/adversary are not expressible "
+                    "under approach=approx (the family injects no "
+                    "adversaries); use straggle/nan_grad/host kinds, or "
+                    "cyclic/maj_vote for Byzantine-budget faults"
                 )
         if self.straggle_mode not in ("none", "drop"):
             raise ValueError(f"unknown straggle_mode: {self.straggle_mode}")

@@ -1,0 +1,7 @@
+"""Runtime control (ROADMAP item 5): the unified chunked host engine both
+production loops run on (:mod:`draco_tpu.control.engine`) and the adaptive
+coding autopilot that re-selects (code family, redundancy, wire dtype) at
+chunk boundaries from the live incident stream
+(:mod:`draco_tpu.control.autopilot`)."""
+
+from draco_tpu.control.engine import ChunkedEngine  # noqa: F401

@@ -330,6 +330,15 @@ class AccusationLedger:
             })
         return rows
 
+    def forgive(self, worker: int, trust: float = 0.75) -> None:
+        """Re-admission parole (control/autopilot.py): reset the worker's
+        EW trust to ``trust`` so a readmitted worker is judged on fresh
+        evidence instead of its pre-quarantine collapse — without this the
+        trust detector re-fires on the first present step and the
+        quarantine/readmit pair would flap forever. Accusation counters
+        are NOT reset: the history stays in the ledger."""
+        self.trust[worker] = float(trust)
+
     def summary(self, top: int = 3) -> dict:
         """The compact ``forensics`` block for status.json: top suspects by
         accusation count (ties broken toward lower trust), the per-worker

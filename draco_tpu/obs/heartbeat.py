@@ -80,6 +80,10 @@ STATUS_BLOCKS = {
     "decode_health": 2, "guard": 2, "forensics": 2, "device": 2,
     "wire": 3, "numerics": 3,
     "incidents": 4,
+    # the autopilot's ``control`` block (control/autopilot.py — current
+    # regime, swaps, quarantined workers, last remediation) is ADDITIVE
+    # under schema 4: consumers tolerate it missing, assert when present
+    "control": 4,
 }
 KNOWN_STATUS_SCHEMAS = tuple(range(2, STATUS_SCHEMA + 1))
 
@@ -163,6 +167,8 @@ class RunHeartbeat:
         # — set by observe_device, wired as the profiler window's on_stop
         # hook; rides every subsequent beat
         self._device: Optional[dict] = None
+        # autopilot ``control`` block (control/autopilot.py, set_control)
+        self._control: Optional[dict] = None
         # newest record that actually carried detection columns — kept
         # separately from _last so a mixed-route train_dir (a trailing
         # record WITHOUT the optional health family, e.g. a baseline run
@@ -265,6 +271,16 @@ class RunHeartbeat:
             return
         self._wire = dict(ledger)
 
+    def set_control(self, block: Optional[dict]) -> None:
+        """Stamp the autopilot's ``control`` status block (current regime,
+        swaps, quarantined workers, last remediation — control/autopilot
+        status_block). Refreshed at every autopilot decision pass; rides
+        every subsequent beat AND the terminal write, so the run's last
+        word records the regime it ended in."""
+        if self.path is None or block is None:
+            return
+        self._control = dict(block)
+
     def observe_device(self, profile_dir: str) -> None:
         """Fold the just-stopped profiler capture into the ``device`` status
         block (phase fractions, decode share, attribution coverage — ISSUE
@@ -351,6 +367,9 @@ class RunHeartbeat:
             # last profiled window's device-time attribution (ISSUE 9);
             # consumers tolerate the key missing, assert it when present
             payload["device"] = self._device
+        if self._control is not None:
+            # the autopilot's runtime-control state (control/autopilot.py)
+            payload["control"] = self._control
         if self.incidents is not None:
             # the beat IS the engine's beat-source observation (throughput
             # wall-rate, compile counters, prefetch depth/restarts all
@@ -385,6 +404,11 @@ class RunHeartbeat:
             # a capture window that stops on the run's LAST work unit has
             # no later beat — the terminal write is the block's only ride
             payload["device"] = self._device
+        if self._control is not None:
+            # the regime the run ENDED in (a post-last-beat remediation
+            # must survive into the run's last word — same rule as the
+            # incidents block below)
+            payload["control"] = self._control
         if self.incidents is not None:
             # the FINAL incidents state must ride the terminal write: an
             # incident that opened after the last beat (a crash step, a

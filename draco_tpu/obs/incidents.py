@@ -236,8 +236,13 @@ class TrustDetector(_Detector):
         if ledger is None or ctx.current_masks is None:
             return None
         floor = self.th["floor"]
+        # a QUARANTINED worker's trust is frozen at its collapse (absent
+        # workers earn no evidence either way) — excluding it lets the
+        # episode close once the remediation lands, so the autopilot's
+        # clean-evidence window can actually accumulate
         low = tuple(w for w in range(ledger.n)
-                    if ledger.trust[w] < floor)
+                    if ledger.trust[w] < floor
+                    and w not in ctx.quarantined)
         return (bool(low),
                 {"min_trust": round(min(ledger.trust), 4)},
                 low or None)
@@ -331,6 +336,45 @@ class NumericsDriftDetector(_Detector):
                   or shift > self.th["hist_shift_max"])
         return (firing, {"uf_bf16": float(uf), "of_bf16": of,
                          "hist_shift": round(shift, 4)}, None)
+
+
+@register_detector(
+    "straggle", severity="warn", source="record",
+    thresholds={"streak": 4, "on_count": 1, "off_count": 2})
+class StraggleDetector(_Detector):
+    """Sustained per-worker absence: some worker's present bit has been
+    off for ``streak`` consecutive observed records — the churn /
+    preempted-worker / feasibility-pressure signal (the regime the
+    committed straggler study prices, and the evidence the autopilot's
+    redundancy dial acts on). Scheduled one-off drops rotate workers and
+    never build a streak, so a clean straggle_mode="drop" run stays
+    silent; a spot-instance drop or a churn episode fires within
+    ``streak`` steps, attributed to the absent worker(s). Workers the
+    autopilot QUARANTINED are excluded — their absence is policy, not
+    telemetry (``IncidentEngine.quarantined``)."""
+
+    def __init__(self, th, num_workers):
+        super().__init__(th, num_workers)
+        self._streaks: Optional[list] = None
+
+    def update(self, record, ctx):
+        masks = ctx.current_masks
+        if masks is None:
+            return None
+        present = masks["present"]
+        n = len(present)
+        if self._streaks is None or len(self._streaks) != n:
+            self._streaks = [0] * n
+        for w in range(n):
+            if w in ctx.quarantined or present[w]:
+                self._streaks[w] = 0
+            else:
+                self._streaks[w] += 1
+        k = int(self.th["streak"])
+        hot = tuple(w for w in range(n) if self._streaks[w] >= k)
+        return (bool(hot),
+                {"max_absent_streak": max(self._streaks, default=0)},
+                hot or None)
 
 
 @register_detector(
@@ -502,6 +546,10 @@ class IncidentEngine:
         self._last_step: Optional[int] = None
         # per-record unpacked forensics masks (observe() refreshes)
         self.current_masks: Optional[dict] = None
+        # workers the autopilot (control/autopilot.py) has excluded via
+        # the present-mask schedule: their absence is POLICY, so the
+        # straggle detector must not read it as telemetry
+        self.quarantined: set = set()
 
     # ---- folding ---------------------------------------------------------
     def observe(self, record: dict) -> None:
@@ -575,22 +623,45 @@ class IncidentEngine:
                     self._emit("offset", ep)
 
     # ---- emission --------------------------------------------------------
-    def _emit(self, event: str, ep: dict) -> None:
+    def _line(self, event: str) -> Optional[dict]:
+        """Start an event line on the (lazily opened) stream, or None when
+        the engine has no out_path."""
         if self._out_path is None:
-            return
+            return None
         if self._fh is None:
             os.makedirs(os.path.dirname(self._out_path) or ".",
                         exist_ok=True)
             self._fh = open(self._out_path, "a")
         line = {"v": INCIDENT_SCHEMA, "event": event, "seq": self._seq}
+        self._seq += 1
+        return line
+
+    def _emit(self, event: str, ep: dict) -> None:
+        line = self._line(event)
+        if line is None:
+            return
         line.update({k: ep[k] for k in
                      ("type", "severity", "source", "onset_step",
                       "last_step", "steps", "workers", "evidence")})
         if event == "offset":
             line["offset_step"] = ep["offset_step"]
-        self._seq += 1
         # one fsync-free write+flush per event: incidents are rare, and a
         # torn tail (killed mid-write) is tolerated by every reader
+        self._fh.write(json.dumps(line) + "\n")
+        self._fh.flush()
+
+    def remediation(self, rem: dict) -> None:
+        """Append an autopilot remediation (control/autopilot.py) to the
+        SAME event stream, same seq counter: every runtime-control
+        decision is an attributed line in the run's incident ledger,
+        interleaved in decision order with the episodes that triggered
+        it. Offline consumers (tools/incident_report.py) carry these
+        through — runtime control state is not recomputable from metric
+        columns alone."""
+        line = self._line("remediation")
+        if line is None:
+            return
+        line.update(rem)
         self._fh.write(json.dumps(line) + "\n")
         self._fh.flush()
 

@@ -144,7 +144,8 @@ def aggregate_flat_grads(grads: jnp.ndarray, adv_mask, cfg, code, rand_factor,
                 # (cfg.redundancy == "shared", the TPU-native fast path)
                 enc_re, enc_im = cyclic_mod.encode_shared(code, grads)
             enc_re, enc_im = attacks.inject_cyclic(
-                enc_re, enc_im, adv_mask, cfg.err_mode, cfg.adversarial
+                enc_re, enc_im, adv_mask, cfg.err_mode, cfg.adversarial,
+                step=step, seed=cfg.seed
             )
             if present is not None:
                 pw = present[:, None].astype(enc_re.dtype)
@@ -188,7 +189,8 @@ def aggregate_flat_grads(grads: jnp.ndarray, adv_mask, cfg, code, rand_factor,
     with jax.named_scope("draco_decode"):
         grads = attacks.inject_plain(grads, adv_mask, cfg.err_mode,
                                      cfg.adversarial,
-                                     n_mal=cfg.num_adversaries)
+                                     n_mal=cfg.num_adversaries,
+                                     step=step, seed=cfg.seed)
         agg = aggregation.aggregate(
             grads, cfg.mode, s=cfg.worker_fail,
             geomedian_iters=cfg.geomedian_iters, present=present,

@@ -418,4 +418,7 @@ def train_sp(cfg: TrainConfig, mesh, steps: Optional[int] = None,
     from draco_tpu.parallel.token_loop import run_token_loop
 
     return run_token_loop(build_sp_train_setup(cfg, mesh), cfg, steps, quiet,
-                          tag="sp", profile_dir=profile_dir)
+                          tag="sp", profile_dir=profile_dir,
+                          # autopilot family swaps rebuild the route setup
+                          # for the new regime cfg (warm-cached per regime)
+                          rebuild=lambda c: build_sp_train_setup(c, mesh))

@@ -61,6 +61,7 @@ from draco_tpu.obs.forensics import record_value
 from draco_tpu.resilience import faults as faults_mod
 from draco_tpu.resilience.supervisor import (
     GracefulStop,
+    ImmediateStopError,
     SupervisedPrefetcher,
     restore_with_walkback,
 )
@@ -82,6 +83,9 @@ class _LoopTelemetry(NamedTuple):
     # the graceful-stop holder run_token_loop installs (ISSUE 6)
     injector: Any = faults_mod.NULL_INJECTOR
     stop: Optional[GracefulStop] = None
+    # mutable {"state", "step"} holder the eager loop refreshes per step —
+    # the escalated-stop (ImmediateStopError) checkpoint source there
+    latest: Any = None
 
 
 def _stop_requested(obs: _LoopTelemetry, step: int) -> bool:
@@ -114,7 +118,7 @@ def _snap_stop(cfg, state, step: int, obs: _LoopTelemetry,
 def run_token_loop(setup, cfg: TrainConfig, steps: Optional[int] = None,
                    quiet: bool = False, tag: str = "mp",
                    profile_dir: Optional[str] = None,
-                   profile_steps: tuple = (3, 8)):
+                   profile_steps: tuple = (3, 8), rebuild=None):
     """Train ``steps or cfg.max_steps`` steps on the synthetic token stream.
 
     Same operational contract as the CNN Trainer: step-indexed Orbax
@@ -159,11 +163,12 @@ def run_token_loop(setup, cfg: TrainConfig, steps: Optional[int] = None,
     # fault plan's over_budget events (cfg.fault_spec) push their steps'
     # rows past the s budget — deterministically, like everything else
     fault_plan = faults_mod.plan_from_cfg(cfg)
-    adv = faults_mod.apply_over_budget(
-        drng.adversary_schedule(cfg.seed, start + total + 1,
-                                cfg.num_workers, cfg.num_adversaries),
-        fault_plan, cfg.worker_fail,
-    )
+    adv = faults_mod.apply_adversary(
+        faults_mod.apply_over_budget(
+            drng.adversary_schedule(cfg.seed, start + total + 1,
+                                    cfg.num_workers, cfg.num_adversaries),
+            fault_plan, cfg.worker_fail,
+        ), fault_plan)
     # straggle events (sustained per-worker drops, faults.apply_straggle)
     # overlay the seeded schedule — or materialize one from scratch
     straggle = faults_mod.apply_straggle(
@@ -173,6 +178,11 @@ def run_token_loop(setup, cfg: TrainConfig, steps: Optional[int] = None,
         else None,
         fault_plan, cfg.num_workers, start + total + 1,
     )
+    if getattr(cfg, "autopilot", "off") == "on" and straggle is None:
+        # autopilot quarantine actuates through the present-mask schedule:
+        # materialize an all-present table so exclusion is a host array
+        # write, never a program-signature change (same rule as Trainer)
+        straggle = np.zeros((start + total + 1, cfg.num_workers), dtype=bool)
     is_main = jax.process_index() == 0
     writer = MetricWriter(cfg.train_dir or None, quiet=quiet)
     tracer = make_tracer(cfg.trace_dir, is_main)
@@ -216,7 +226,11 @@ def run_token_loop(setup, cfg: TrainConfig, steps: Optional[int] = None,
     # resilience envelope (ISSUE 6), mirroring Trainer.run: SIGTERM/SIGINT
     # become a cooperative stop honored at step/chunk boundaries (boundary
     # checkpoint + "preempted" terminal heartbeat state); an unhandled
-    # exception stamps a "crashed" terminal status.json before re-raising
+    # exception stamps a "crashed" terminal status.json before re-raising.
+    # ``engine_ref``/``latest`` track the newest dispatched state + step so
+    # a second signal (ImmediateStopError) can checkpoint immediately
+    engine_ref: list = []
+    latest = {"state": state, "step": None}
     try:
         with GracefulStop() as stop:
             obs = _LoopTelemetry(tracer=tracer, heartbeat=heartbeat,
@@ -227,7 +241,7 @@ def run_token_loop(setup, cfg: TrainConfig, steps: Optional[int] = None,
                                  compile_watch=compile_watch,
                                  injector=faults_mod.HostFaultInjector(
                                      fault_plan),
-                                 stop=stop)
+                                 stop=stop, latest=latest)
             K = max(cfg.steps_per_call, 1)
             if K > 1 or cfg.token_gen == "device":
                 # the device-generated stream exists only inside the
@@ -236,7 +250,8 @@ def run_token_loop(setup, cfg: TrainConfig, steps: Optional[int] = None,
                 state, metrics = _run_chunked(setup, cfg, state, start,
                                               last_step, adv, straggle,
                                               writer, boundary_eval_ckpt,
-                                              tag, obs)
+                                              tag, obs, rebuild=rebuild,
+                                              engine_ref=engine_ref)
             else:
                 state, metrics = _run_eager(setup, cfg, state, start,
                                             last_step, adv, straggle,
@@ -259,6 +274,26 @@ def run_token_loop(setup, cfg: TrainConfig, steps: Optional[int] = None,
                                 else None))
         else:
             heartbeat.terminal("done")
+    except ImmediateStopError as e:
+        # second SIGTERM during a chunk (resilience/supervisor.py):
+        # checkpoint the newest dispatched state NOW — blocking on the
+        # in-flight chunk if one is executing — and end with the terminal
+        # "preempted" status instead of finishing the chunk grid
+        eng = engine_ref[0] if engine_ref else None
+        if eng is not None and eng.state is not None:
+            state, step_now = eng.state, eng.last_end
+        else:
+            state, step_now = latest["state"], latest["step"]
+        if cfg.train_dir and step_now is not None:
+            with tracer.span("ckpt", at_step=step_now):
+                ckpt_mod.save(cfg.train_dir, step_now, state,
+                              compress=cfg.compress_ckpt,
+                              keep=cfg.keep_checkpoints)
+        heartbeat.terminal(
+            "preempted", cause=str(e),
+            resumable_step=(step_now if cfg.train_dir
+                            and step_now is not None else None))
+        metrics = {}
     except BaseException as e:
         heartbeat.terminal("crashed", cause=f"{type(e).__name__}: {e}")
         raise
@@ -300,6 +335,8 @@ def _run_eager(setup, cfg, state, start, last_step, adv, straggle, writer,
                     jnp.asarray(~straggle[step]),
                 )
         win.maybe_stop(step, state.params)
+        if obs.latest is not None:  # escalated-stop checkpoint cursor
+            obs.latest["state"], obs.latest["step"] = state, step
         # materialize metrics at log boundaries only — the eager loop's
         # historical device-sync cadence; fetching every step for the
         # heartbeat would re-serialize the async-dispatch pipeline. The
@@ -332,15 +369,17 @@ def _run_eager(setup, cfg, state, start, last_step, adv, straggle, writer,
 
 
 def _run_chunked(setup, cfg, state, start, last_step, adv, straggle, writer,
-                 boundary_eval_ckpt, tag="mp", obs=_LoopTelemetry()):
-    """One dispatch per chunk of up to K steps; metrics deferred to flush
-    boundaries; next chunk assembled while the device runs the current one."""
+                 boundary_eval_ckpt, tag="mp", obs=_LoopTelemetry(),
+                 rebuild=None, engine_ref=None):
+    """One dispatch per chunk of up to K steps, driven by the shared
+    ``ChunkedEngine`` (control/engine.py — one implementation with the CNN
+    Trainer loop): metrics deferred to flush boundaries, next chunk
+    assembled while the device runs the current one."""
+    from draco_tpu.control.clients import TokenChunkClient
+    from draco_tpu.control.engine import ChunkedEngine
     from draco_tpu.data.prefetch import TokenChunkPrefetcher
     from draco_tpu.parallel.sp_step import synthetic_text
-    from draco_tpu.utils.metrics import DeferredMetricWriter
 
-    tracer, heartbeat, watch = obs.tracer, obs.heartbeat, obs.compile_watch
-    total_end = obs.total_end
     if setup.train_token_many is None:
         raise ValueError(
             f"{tag} route setup lacks train_token_many — rebuild it with "
@@ -349,9 +388,8 @@ def _run_chunked(setup, cfg, state, start, last_step, adv, straggle, writer,
     ranges = chunk_ranges(start, last_step, cfg.steps_per_call, cfg.eval_freq)
     if not ranges:
         return state, {}
-    device_gen = cfg.token_gen == "device"
     prefetch = None
-    if not device_gen:
+    if cfg.token_gen != "device":
         # generation fn wrapped by the fault injector (inert by default),
         # prefetcher wrapped by restart supervision with a bounded queue
         # wait — a dead/hung worker thread is retried with backoff, then
@@ -361,94 +399,25 @@ def _run_chunked(setup, cfg, state, start, last_step, adv, straggle, writer,
                                         cfg.batch_size, cfg.seq_len,
                                         cfg.vocab))
         factory = lambda: TokenChunkPrefetcher(  # noqa: E731
-            gen_fn, tracer=tracer, timeout_s=cfg.prefetch_timeout_s)
+            gen_fn, tracer=obs.tracer, timeout_s=cfg.prefetch_timeout_s)
         prefetch = (SupervisedPrefetcher(factory,
                                          restarts=cfg.prefetch_restarts,
-                                         tracer=tracer)
+                                         tracer=obs.tracer)
                     if cfg.prefetch_restarts > 0 else factory())
-    deferred = DeferredMetricWriter(writer, observer=heartbeat.observe)
+    client = TokenChunkClient(setup, cfg, adv, straggle, prefetch, obs,
+                              boundary_eval_ckpt, rebuild=rebuild)
+    autopilot = None
+    if getattr(cfg, "autopilot", "off") == "on":
+        from draco_tpu.control.autopilot import make_autopilot
 
-    def should_log(step):
-        return step % cfg.log_every == 0
-
-    def assemble(i):
-        s0, k = ranges[i]
-        with tracer.span("gather", chunk_start=s0, k=k):
-            if device_gen:
-                # the program regenerates the batches in-graph: upload K
-                # scalars
-                toks = np.arange(s0, s0 + k, dtype=np.int32)
-            else:
-                toks = prefetch.get(
-                    ranges[i], ranges[i + 1] if i + 1 < len(ranges) else None
-                )
-            # numpy (uncommitted) so jit treats the schedules as replicated
-            masks = np.asarray(adv[s0 : s0 + k])
-            presents = (
-                np.asarray(~straggle[s0 : s0 + k])
-                if straggle is not None
-                else None
-            )
-        return toks, masks, presents
-
-    # shared capture window (obs/profiling.py): chunk-snapped start/stop +
-    # drain-before-stop + the merged-timeline anchor, same rule as
-    # Trainer._run_chunked (ISSUE 9); on stop the capture folds into the
-    # heartbeat's ``device`` status block
-    win = profiler_window(obs.profile_dir, obs.profile_steps, tracer=tracer,
-                          on_stop=heartbeat.observe_device)
-    try:
-        chunk = assemble(0)
-        for i, (s0, k) in enumerate(ranges):
-            end = s0 + k - 1
-            win.maybe_start(end, first_step=s0)
-            toks, masks, presents = chunk
-            with tracer.span("dispatch", chunk_start=s0, k=k), \
-                    watch.expect("train_token_many", key=k):
-                state, block = setup.train_token_many(state, toks, masks,
-                                                      presents)
-            deferred.defer(range(s0, end + 1), setup.metric_names, block)
-            if i + 1 < len(ranges):  # overlap: assemble i+1 during chunk i
-                chunk = assemble(i + 1)
-            boundary = bool(cfg.eval_freq) and end % cfg.eval_freq == 0
-            if boundary or i + 1 == len(ranges) or deferred.depth >= 4:
-                # flush materializes every pending block (np.asarray — a
-                # true device→host execution barrier even on remote
-                # backends, PERF.md §0) and writes the window's records.
-                # No separate sync(): unlike trainer._run_chunked there is
-                # no wall-clock read between barrier and flush here.
-                with tracer.span("flush", at_step=end):
-                    deferred.flush(should_log)
-                    # prefetch extras only when a prefetcher EXISTS: the
-                    # device token-gen mode has no host prefetch path, and
-                    # reporting a constant depth 0 there would read as
-                    # starvation to the incident engine (ISSUE 13)
-                    pf_extra = {}
-                    if prefetch is not None:
-                        pf_extra["prefetch_depth"] = prefetch.depth
-                        if hasattr(prefetch, "stats"):
-                            # supervision restart counter — the incident
-                            # engine's starvation signal
-                            pf_extra.update(prefetch.stats())
-                    heartbeat.beat(end, total_end,
-                                   extra={**pf_extra, **watch.snapshot()})
-                    tracer.flush()
-            win.maybe_stop(end, state.params)
-            if boundary:
-                boundary_eval_ckpt(end, state)
-            if _stop_requested(obs, end):
-                # chunk boundary = legal stop point: drain pending metric
-                # blocks, then snap the resumable checkpoint exactly here
-                with tracer.span("flush", at_step=end):
-                    deferred.flush(should_log)
-                _snap_stop(cfg, state, end, obs,
-                           already_saved=bool(boundary))
-                break
-    finally:
-        try:
-            win.stop(state.params)  # loop ended inside the window
-        finally:
-            if prefetch is not None:
-                prefetch.close()
-    last = deferred.last
+        autopilot = make_autopilot(cfg, obs.heartbeat, dim=setup.dim)
+    engine = ChunkedEngine(
+        client, eval_freq=cfg.eval_freq, total_end=obs.total_end,
+        tracer=obs.tracer, heartbeat=obs.heartbeat,
+        compile_watch=obs.compile_watch, writer=writer,
+        autopilot=autopilot, profile_dir=obs.profile_dir,
+        profile_steps=obs.profile_steps)
+    if engine_ref is not None:
+        engine_ref.append(engine)  # the escalated-stop checkpoint source
+    state, last = engine.run(state, ranges)
     return state, ({"loss": last["loss"]} if "loss" in last else {})

@@ -14,24 +14,43 @@ terminal heartbeat state) instead of "something happened".
 
 Event grammar (``FaultPlan.parse``)::
 
-    kind@step[:w<worker>][:d<seconds>]
+    kind@step[-end][:w<worker>][:d<seconds>][:every<k>]
 
     nan_grad@5          worker (seeded draw) emits a NaN gradient at step 5
     inf_grad@5:w2       worker 2 emits an Inf gradient at step 5
     over_budget@7       step 7's adversary row is pushed to s+1 live
                         adversaries (beyond the code's locator budget)
+    adversary@5:w2      worker 2 is a LIVE adversary at step 5 (within the
+                        code budget — the schedule row is set, the step's
+                        cfg.err_mode attack fires through the normal
+                        injection path); the declarative time-varying-
+                        adversary knob the autopilot scenarios use
+    adversary@5-40:w2   ... a sustained adversary EPISODE (steps 5..40)
     straggle@5:w3       worker 3 drops (sustained) from step 5 to the end
                         of the run — the heterogeneous-fleet / preempted-
                         worker fault the approx code family (ISSUE 8)
                         absorbs as scheduled erasures, NOT a one-shot
                         crash: the worker's rows simply stop arriving
     straggle@5:w3:d4    ... and recovers after 4 steps (absent 5..8)
+    straggle@26-44:w5   ... absent exactly during the window (26..44)
+    straggle@20-60:w3:d4:every10
+                        CHURN: a recurring episode — a 4-step drop
+                        starting at every 10th step of the window
+                        (absent 20-23, 30-33, 40-43, 50-53, 60-63)
     prefetch_crash@5    the prefetcher host fn raises InjectedFaultError
                         the first time step 5's data is requested
     prefetch_hang@5:d6  ... sleeps 6 s instead (a stalled worker thread)
     sigterm@5           SIGTERM is raised in-process once step 5 completes
+                        (a SECOND due sigterm event while the stop is
+                        pending escalates — supervisor.ImmediateStopError)
     ckpt_corrupt@8      consumed by tools/chaos_run.py: flip bytes in the
     ckpt_truncate@8     step-8 checkpoint / truncate it, then resume
+
+Windowed/recurring forms (``@a-b`` + ``:every<k>``) make time-varying
+scenarios *declarative*: an event occurs at steps a, a+k, ..., ≤ b
+(``:every`` requires a window; a bare ``@a-b`` recurs every step). Every
+occurrence behaves exactly like a point event of its kind; host kinds
+fire once per occurrence.
 
 In-graph kinds are applied with the same branch-free ``jnp.where`` masking
 as ``attacks.inject_plain`` — the fault is part of the compiled program
@@ -56,13 +75,20 @@ import numpy as np
 # straggle → straggler/present rows); host kinds fire in the host loop /
 # prefetcher; ckpt kinds are consumed by tools/chaos_run.py
 INGRAPH_KINDS = ("nan_grad", "inf_grad")
-SCHEDULE_KINDS = ("over_budget", "straggle")
+SCHEDULE_KINDS = ("over_budget", "straggle", "adversary")
 HOST_KINDS = ("prefetch_crash", "prefetch_hang", "sigterm")
 CKPT_KINDS = ("ckpt_corrupt", "ckpt_truncate")
 FAULT_KINDS = INGRAPH_KINDS + SCHEDULE_KINDS + HOST_KINDS + CKPT_KINDS
 
+# kinds whose :d payload is an integer STEP count (dwell), not seconds
+_STEP_DWELL_KINDS = ("straggle", "adversary")
+# kinds whose target worker is drawn from the seeded stream when no :w
+_DRAWN_WORKER_KINDS = INGRAPH_KINDS + ("straggle", "adversary")
+
 _EVENT_RE = re.compile(r"^(?P<kind>[a-z_]+)@(?P<step>\d+)"
-                       r"(?::w(?P<worker>\d+))?(?::d(?P<dur>[\d.]+))?$")
+                       r"(?:-(?P<hi>\d+))?"
+                       r"(?::w(?P<worker>\d+))?(?::d(?P<dur>[\d.]+))?"
+                       r"(?::every(?P<every>\d+))?$")
 
 
 class InjectedFaultError(RuntimeError):
@@ -74,12 +100,53 @@ class InjectedFaultError(RuntimeError):
 @dataclasses.dataclass(frozen=True)
 class FaultEvent:
     kind: str
-    step: int  # 1-based training step the event targets
-    worker: Optional[int] = None  # in-graph/straggle kinds: the target row
+    step: int  # 1-based training step the event (window) starts at
+    worker: Optional[int] = None  # in-graph/straggle/adversary target row
     # ``:d<n>`` payload. prefetch_hang: seconds the worker thread sleeps
-    # (None → 30 s). straggle: dwell in STEPS before the worker recovers
-    # (None → sustained to the end of the run — the spot-instance shape).
+    # (None → 30 s). straggle/adversary: dwell in STEPS per occurrence
+    # (None → sustained to the end of the run / a single step).
     duration_s: Optional[float] = None
+    # window end (``@a-b``; None = the point event a) and recurrence
+    # stride within it (``:every<k>``; 1 = every step of the window)
+    step_hi: Optional[int] = None
+    every: int = 1
+    # position in the parsed spec — keys the one-shot host firing and the
+    # seeded worker draw; excluded from equality so a round-tripped spec
+    # (with blanks dropped) still compares equal
+    index: int = dataclasses.field(default=0, compare=False)
+
+    @property
+    def last_step(self) -> int:
+        return self.step if self.step_hi is None else self.step_hi
+
+    def occurrences(self, lo: int, hi: int):
+        """Occurrence steps within [lo, hi] — a, a+every, ..., <= b."""
+        first = self.step
+        if lo > first:
+            # first occurrence at or after lo on the event's stride grid
+            first += ((lo - self.step + self.every - 1)
+                      // self.every) * self.every
+        return range(first, min(self.last_step, hi) + 1, self.every)
+
+    def occurs_at(self, step: int) -> bool:
+        return (self.step <= step <= self.last_step
+                and (step - self.step) % self.every == 0)
+
+    def spec(self) -> str:
+        """The event's canonical spec token — ``FaultPlan.parse`` of it
+        reproduces this event (worker resolved, so the seeded draw is
+        pinned explicit on the way out)."""
+        tok = f"{self.kind}@{self.step}"
+        if self.step_hi is not None:
+            tok += f"-{self.step_hi}"
+        if self.worker is not None:
+            tok += f":w{self.worker}"
+        if self.duration_s is not None:
+            d = self.duration_s
+            tok += f":d{int(d) if float(d).is_integer() else d}"
+        if self.every != 1:
+            tok += f":every{self.every}"
+        return tok
 
 
 @dataclasses.dataclass(frozen=True)
@@ -100,7 +167,8 @@ class FaultPlan:
             if not m:
                 raise ValueError(
                     f"fault_spec event {tok!r} does not match "
-                    f"'kind@step[:w<worker>][:d<seconds>]'"
+                    f"'kind@step[-end][:w<worker>][:d<seconds>]"
+                    f"[:every<k>]'"
                 )
             kind, step = m.group("kind"), int(m.group("step"))
             if kind not in FAULT_KINDS:
@@ -110,6 +178,30 @@ class FaultPlan:
                 )
             if step < 1:
                 raise ValueError(f"fault step must be >= 1 in {tok!r}")
+            hi = m.group("hi")
+            if hi is not None:
+                hi = int(hi)
+                if hi < step:
+                    raise ValueError(
+                        f"fault window end {hi} precedes start {step} in "
+                        f"{tok!r}"
+                    )
+                if kind in CKPT_KINDS:
+                    raise ValueError(
+                        f"{kind} targets one checkpoint; a window makes "
+                        f"no sense in {tok!r}"
+                    )
+            every = m.group("every")
+            if every is not None:
+                every = int(every)
+                if every < 1:
+                    raise ValueError(f"every must be >= 1 in {tok!r}")
+                if hi is None:
+                    raise ValueError(
+                        f"':every' without a step window 'a-b' is inert "
+                        f"in {tok!r} — recurrence needs a window to recur "
+                        f"over"
+                    )
             worker = m.group("worker")
             if worker is not None:
                 worker = int(worker)
@@ -118,25 +210,31 @@ class FaultPlan:
                         f"fault worker {worker} out of range "
                         f"(num_workers={num_workers}) in {tok!r}"
                     )
-            elif kind in INGRAPH_KINDS + ("straggle",):
+            elif kind in _DRAWN_WORKER_KINDS:
                 # seeded per-event draw — the same "every participant can
                 # recompute it" property as rng.adversary_schedule
                 r = np.random.RandomState((seed ^ 0x4641554C) + 7919 * i)
                 worker = int(r.randint(num_workers))
             dur = m.group("dur")
-            if dur is not None and kind == "straggle" \
+            if dur is not None and kind in _STEP_DWELL_KINDS \
                     and float(dur) != int(float(dur)):
                 # :d is float SECONDS for host kinds but integer STEPS for
-                # straggle — reject here rather than silently flooring
+                # straggle/adversary — reject rather than silently floor
                 raise ValueError(
-                    f"straggle dwell is a whole number of steps, got "
+                    f"{kind} dwell is a whole number of steps, got "
                     f"d{dur} in {tok!r}"
                 )
             events.append(FaultEvent(
                 kind=kind, step=step, worker=worker,
                 duration_s=float(dur) if dur is not None else None,
+                step_hi=hi, every=every or 1, index=i,
             ))
         return cls(events=tuple(events), seed=seed, num_workers=num_workers)
+
+    def spec(self) -> str:
+        """Canonical round-trippable spec: ``FaultPlan.parse(plan.spec(),
+        seed, n) == plan`` (workers pinned explicit, blanks dropped)."""
+        return ",".join(ev.spec() for ev in self.events)
 
     def of_kind(self, *kinds: str) -> Tuple[FaultEvent, ...]:
         return tuple(e for e in self.events if e.kind in kinds)
@@ -179,8 +277,14 @@ def corrupt_grads(grads, cfg, step):
     mask = jnp.zeros((n,), bool)
     payload = jnp.zeros((n,), grads.dtype)
     for ev in plan.ingraph_events:
-        hit = (jnp.asarray(ev.step, jnp.int32) ==
-               jnp.asarray(step, jnp.int32))
+        s = jnp.asarray(step, jnp.int32)
+        if ev.step_hi is None:
+            hit = jnp.asarray(ev.step, jnp.int32) == s
+        else:
+            # windowed/recurring form: occurrence iff inside [a, b] on the
+            # event's stride grid — still branch-free, still config-static
+            hit = ((s >= ev.step) & (s <= ev.step_hi)
+                   & ((s - ev.step) % ev.every == 0))
         row = jnp.arange(n) == ev.worker
         mask = mask | (hit & row)
         val = jnp.nan if ev.kind == "nan_grad" else jnp.inf
@@ -207,16 +311,38 @@ def apply_over_budget(adv_schedule: np.ndarray, plan: Optional[FaultPlan],
     n = adv.shape[1]
     want = min(worker_fail + 1, n)
     for ev in events:
-        if ev.step >= adv.shape[0]:
-            continue  # beyond the run's schedule table — inert
-        row = adv[ev.step]
-        r = np.random.RandomState((plan.seed ^ 0x0B0D6E7) + ev.step)
-        order = r.permutation(n)
-        for w in order:
-            if row.sum() >= want:
-                break
-            row[w] = True
-        adv[ev.step] = row
+        for o in ev.occurrences(1, adv.shape[0] - 1):
+            row = adv[o]
+            r = np.random.RandomState((plan.seed ^ 0x0B0D6E7) + o)
+            order = r.permutation(n)
+            for w in order:
+                if row.sum() >= want:
+                    break
+                row[w] = True
+            adv[o] = row
+    return adv
+
+
+def apply_adversary(adv_schedule: np.ndarray,
+                    plan: Optional[FaultPlan]) -> np.ndarray:
+    """Host-side schedule mutation for ``adversary`` events: the targeted
+    worker's row goes live-adversarial at every occurrence (for ``:d``
+    dwell steps each — default 1), WITHIN the code budget: this is the
+    declarative time-varying-adversary knob (an attack EPISODE a fleet
+    actually sees), not the beyond-budget ``over_budget`` stressor. The
+    step's cfg.err_mode attack then fires through the exact same masked
+    injection path as the seeded schedule. Returns the (possibly copied)
+    schedule; the input is never mutated."""
+    if plan is None:
+        return adv_schedule
+    events = plan.of_kind("adversary")
+    if not events:
+        return adv_schedule
+    adv = np.array(adv_schedule, copy=True)
+    for ev in events:
+        dwell = 1 if ev.duration_s is None else int(ev.duration_s)
+        for o in ev.occurrences(1, adv.shape[0] - 1):
+            adv[o:min(o + dwell, adv.shape[0]), ev.worker] = True
     return adv
 
 
@@ -248,11 +374,18 @@ def apply_straggle(straggle_schedule: Optional[np.ndarray],
     else:
         out = np.array(straggle_schedule, copy=True)
     for ev in events:
-        if ev.step >= out.shape[0]:
-            continue  # beyond the run's schedule table — inert
-        hi = (out.shape[0] if ev.duration_s is None
-              else min(out.shape[0], ev.step + int(ev.duration_s)))
-        out[ev.step:hi, ev.worker] = True
+        for o in ev.occurrences(1, out.shape[0] - 1):
+            if ev.duration_s is not None:
+                hi = min(out.shape[0], o + int(ev.duration_s))
+            elif ev.step_hi is not None:
+                # windowed form without :d — absent exactly DURING the
+                # window (each occurrence covers its own step), recovering
+                # at window end; only the point form means "to the end of
+                # the run" (the spot-instance shape)
+                hi = o + 1
+            else:
+                hi = out.shape[0]
+            out[o:hi, ev.worker] = True
     return out
 
 
@@ -275,16 +408,20 @@ class HostFaultInjector:
         return self._plan is not None and bool(self._plan.events)
 
     def _fire(self, kinds, lo: int, hi: Optional[int] = None):
-        """First unfired event of ``kinds`` with step in [lo, hi] (hi
-        defaults to lo), marked fired."""
+        """First unfired OCCURRENCE of an event of ``kinds`` within
+        [lo, hi] (hi defaults to lo), marked fired. Keyed by (event index,
+        occurrence step): recurring events fire once per occurrence, and
+        two identical point events (e.g. ``sigterm@5,sigterm@5`` — the
+        pinned escalation sequence) each fire."""
         if self._plan is None:
             return None
         hi = lo if hi is None else hi
         for ev in self._plan.of_kind(*kinds):
-            key = (ev.kind, ev.step, ev.worker)
-            if key not in self._fired and lo <= ev.step <= hi:
-                self._fired.add(key)
-                return ev
+            for o in ev.occurrences(lo, hi):
+                key = (ev.index, o)
+                if key not in self._fired:
+                    self._fired.add(key)
+                    return ev
         return None
 
     def wrap_step_fn(self, fn):

@@ -160,31 +160,51 @@ def restore_with_walkback(train_dir: str, step: int, abstract_state,
 # ---- preemption-safe stop --------------------------------------------------
 
 
+class ImmediateStopError(Exception):
+    """A REPEAT SIGTERM/SIGINT while a graceful stop was already pending:
+    the operator (or the platform's escalating kill sequence) is not
+    willing to wait for the chunk boundary. Raised from the signal handler
+    so it surfaces wherever the main thread currently is — mid-chunk, in a
+    metric fetch, in an upload — and the production loops catch it to snap
+    an IMMEDIATE resumable checkpoint (the newest dispatched state) and
+    write the terminal ``preempted`` status, instead of finishing the
+    chunk grid. A third signal falls through to the previously-installed
+    handler (the handlers are restored before this raises), so a stuck
+    escalation can still be killed the ordinary way."""
+
+
 class GracefulStop:
     """Context manager converting SIGTERM/SIGINT into a cooperative stop
     request the training loops poll at chunk boundaries.
 
     Installs handlers on ``__enter__`` (main thread only — elsewhere, e.g.
     under a test runner thread, it degrades to an inert flag holder) and
-    restores the previous handlers on ``__exit__``. A second signal while a
-    stop is already pending restores the previous handler and re-raises it,
-    so a stuck shutdown can still be killed the ordinary way."""
+    restores the previous handlers on ``__exit__``. A second signal while
+    a stop is already pending ESCALATES: the previous handlers are
+    restored and :class:`ImmediateStopError` is raised from the handler,
+    which the loops turn into an immediate resumable checkpoint + terminal
+    ``preempted`` status (no waiting for the chunk boundary); a third
+    signal then hits the restored handler and kills the ordinary way."""
 
     def __init__(self, signals=(signal.SIGTERM, signal.SIGINT)):
         self._signals = signals
         self._previous: dict = {}
         self.requested = False
+        self.escalated = False
         self.signame: Optional[str] = None
         # the loop that honored the stop records where it snapped the
         # resumable checkpoint, for the terminal status.json
         self.stopped_step: Optional[int] = None
 
     def _handler(self, signum, frame):
-        if self.requested:  # second signal: give up gracefulness
+        if self.requested:  # second signal: escalate to an immediate stop
             for sig, prev in self._previous.items():
                 signal.signal(sig, prev)
-            signal.raise_signal(signum)
-            return
+            self._previous = {}
+            self.escalated = True
+            raise ImmediateStopError(
+                f"second {signal.Signals(signum).name} while a graceful "
+                f"stop was pending — immediate checkpoint requested")
         self.requested = True
         self.signame = signal.Signals(signum).name
 
@@ -199,9 +219,16 @@ class GracefulStop:
         (the genuine preemption flow — what the fault plan's sigterm event
         uses), degrading to a direct stop request when handlers could not
         be installed (non-main-thread runners, e.g. under a test
-        harness)."""
+        harness). The degraded path keeps the escalation semantics: a
+        second delivery while a stop is pending raises
+        :class:`ImmediateStopError` exactly like the live handler."""
         if self.installed:
             signal.raise_signal(sig)
+        elif self.requested:
+            self.escalated = True
+            raise ImmediateStopError(
+                f"second {signal.Signals(sig).name} while a graceful "
+                f"stop was pending — immediate checkpoint requested")
         else:
             self.requested = True
             self.signame = signal.Signals(sig).name
@@ -221,10 +248,14 @@ class GracefulStop:
 
 def stop_requested(stop: Optional[GracefulStop], injector,
                    step: int) -> bool:
-    """The one stop-poll both production loops share: fire the fault
-    plan's pending sigterm event (delivered through the real handler
-    path), then report whether a graceful stop is pending. ``stop`` may be
-    None (driver called without the resilience envelope)."""
-    if injector.sigterm_due(step) and stop is not None:
+    """The one stop-poll both production loops share: fire EVERY pending
+    fault-plan sigterm event due by ``step`` (delivered through the real
+    handler path — a second due event while the first is pending escalates
+    to :class:`ImmediateStopError`, the pinned SIGTERM→SIGTERM sequence),
+    then report whether a graceful stop is pending. ``stop`` may be None
+    (driver called without the resilience envelope)."""
+    while injector.sigterm_due(step):
+        if stop is None:
+            break
         stop.deliver_signal(signal.SIGTERM)
     return stop is not None and stop.requested

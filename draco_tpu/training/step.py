@@ -265,7 +265,8 @@ def build_train_setup(cfg: TrainConfig, mesh, dataset_name: Optional[str] = None
             grads = jax.lax.with_sharding_constraint(grads, shard_w)
             grads = faults_mod.corrupt_grads(grads, cfg, state.step)
             grads = attacks.inject_plain(grads, adv_mask, cfg.err_mode, adv_mag,
-                                         n_mal=cfg.num_adversaries)
+                                         n_mal=cfg.num_adversaries,
+                                         step=state.step, seed=cfg.seed)
             with jax.named_scope("draco_decode"):
                 agg = aggregation.aggregate(grads, cfg.mode,
                                             s=cfg.worker_fail,
@@ -302,7 +303,8 @@ def build_train_setup(cfg: TrainConfig, mesh, dataset_name: Optional[str] = None
             grads = jax.lax.with_sharding_constraint(grads, shard_w)
             grads = faults_mod.corrupt_grads(grads, cfg, state.step)
             grads = attacks.inject_plain(grads, adv_mask, cfg.err_mode, adv_mag,
-                                         n_mal=cfg.num_adversaries)
+                                         n_mal=cfg.num_adversaries,
+                                         step=state.step, seed=cfg.seed)
             # per-step fingerprint salt, identical on every device (folded
             # from replicated state.step). Being seed-derived it is NOT
             # secret from a participant that knows the experiment seed —
@@ -476,7 +478,9 @@ def build_train_setup(cfg: TrainConfig, mesh, dataset_name: Optional[str] = None
              grad_watch) = compute_encoded(state, x, y)
             with jax.named_scope("draco_encode"):
                 enc_re, enc_im = attacks.inject_cyclic(enc_re, enc_im, adv_mask,
-                                                       cfg.err_mode, adv_mag)
+                                                       cfg.err_mode, adv_mag,
+                                                       step=state.step,
+                                                       seed=cfg.seed)
                 if present is not None:
                     # straggler rows never arrive: zero-fill (erasures at known
                     # positions; decode recovers exactly within the budget —

@@ -24,7 +24,6 @@ Two execution regimes, selected by ``cfg.steps_per_call``:
 
 from __future__ import annotations
 
-import time
 from typing import Optional
 
 import jax
@@ -47,13 +46,14 @@ from draco_tpu.obs.forensics import record_value
 from draco_tpu.resilience import faults as faults_mod
 from draco_tpu.resilience.supervisor import (
     GracefulStop,
+    ImmediateStopError,
     SupervisedPrefetcher,
     restore_with_walkback,
 )
 from draco_tpu.runtime import WORKER_AXIS, make_mesh, put_global
 from draco_tpu.training.step import build_train_setup
 from draco_tpu.utils import checkpoint as ckpt
-from draco_tpu.utils.metrics import DeferredMetricWriter, MetricWriter, Segments
+from draco_tpu.utils.metrics import MetricWriter, Segments
 
 
 class Trainer:
@@ -108,11 +108,16 @@ class Trainer:
         self._injector = faults_mod.HostFaultInjector(self._fault_plan)
         self._stop: Optional[GracefulStop] = None
         self._stopped_step: Optional[int] = None
-        self._adv_schedule = faults_mod.apply_over_budget(
-            drng.adversary_schedule(cfg.seed, cfg.max_steps, cfg.num_workers,
-                                    cfg.num_adversaries),
-            self._fault_plan, cfg.worker_fail,
-        )
+        # fault-plan overlays: over_budget pushes rows past the s budget,
+        # adversary events mark declarative within-budget attack episodes
+        # (time-varying adversaries, faults.apply_adversary)
+        self._adv_schedule = faults_mod.apply_adversary(
+            faults_mod.apply_over_budget(
+                drng.adversary_schedule(cfg.seed, cfg.max_steps,
+                                        cfg.num_workers,
+                                        cfg.num_adversaries),
+                self._fault_plan, cfg.worker_fail,
+            ), self._fault_plan)
         # the fault plan's straggle events (sustained per-worker drops)
         # overlay the seeded straggler schedule — or materialize one when
         # the config ran with none (faults.apply_straggle)
@@ -123,6 +128,18 @@ class Trainer:
             else None,
             self._fault_plan, cfg.num_workers, cfg.max_steps,
         )
+        if getattr(cfg, "autopilot", "off") == "on" \
+                and self._straggle_schedule is None:
+            # the autopilot's quarantine actuates through the present-mask
+            # schedule: materialize an all-present table up front so
+            # exclusion is a host array write, never a program-signature
+            # change (presents None→array would retrace the chunk program
+            # under compile_guard="raise")
+            self._straggle_schedule = np.zeros(
+                (cfg.max_steps + 1, cfg.num_workers), dtype=bool)
+        self._engine = None  # live ChunkedEngine while _run_chunked runs
+        self._autopilot = None  # cached control/autopilot.Autopilot
+        self._eager_step = None  # newest completed eager step (escalation)
         self._sched_steps = cfg.max_steps  # rows precomputed in the schedules
         self._group_seeds = drng.group_seeds(cfg.seed, max(cfg.num_groups, 1))
         # both prefetchers are lazy: the chunked path never touches the
@@ -189,11 +206,12 @@ class Trainer:
         if n_steps <= self._sched_steps:
             return
         cfg = self.cfg
-        self._adv_schedule = faults_mod.apply_over_budget(
-            drng.adversary_schedule(cfg.seed, n_steps, cfg.num_workers,
-                                    cfg.num_adversaries),
-            self._fault_plan, cfg.worker_fail,
-        )
+        self._adv_schedule = faults_mod.apply_adversary(
+            faults_mod.apply_over_budget(
+                drng.adversary_schedule(cfg.seed, n_steps, cfg.num_workers,
+                                        cfg.num_adversaries),
+                self._fault_plan, cfg.worker_fail,
+            ), self._fault_plan)
         if self._straggle_schedule is not None:
             self._straggle_schedule = faults_mod.apply_straggle(
                 drng.straggler_schedule(
@@ -202,6 +220,16 @@ class Trainer:
                 else None,
                 self._fault_plan, cfg.num_workers, n_steps,
             )
+            if self._straggle_schedule is None:
+                # keep the autopilot's materialized all-present table live
+                # at the new length
+                self._straggle_schedule = np.zeros(
+                    (n_steps + 1, cfg.num_workers), dtype=bool)
+            if self._autopilot is not None:
+                # a regenerated table must not silently re-admit workers
+                # the policy still holds excluded (block-wise run() calls
+                # past the precomputed length)
+                self._autopilot.reapply_quarantines(self._straggle_schedule)
         self._sched_steps = n_steps
 
     # ---- chunking --------------------------------------------------------
@@ -275,6 +303,12 @@ class Trainer:
                 else:
                     last = self._run_eager(n_steps, profile_dir,
                                            profile_steps)
+        except ImmediateStopError as e:
+            # second SIGTERM during a chunk (resilience/supervisor.py):
+            # checkpoint the newest dispatched state NOW and end with the
+            # terminal "preempted" status instead of finishing the grid
+            self._stop = None
+            return self._escalated_stop(e)
         except BaseException as e:
             self.heartbeat.terminal("crashed",
                                     cause=f"{type(e).__name__}: {e}")
@@ -297,6 +331,31 @@ class Trainer:
         if self._stopped_step is None:
             self._start_step = max(self._start_step, n_steps + 1)
         return last
+
+    def _escalated_stop(self, e: ImmediateStopError) -> dict:
+        """The second-signal escalation path: save a resumable checkpoint
+        of the NEWEST dispatched state right now — blocking on the
+        in-flight chunk if one is executing — and stamp the terminal
+        ``preempted`` status. Un-flushed deferred metric records are lost
+        (the operator asked for immediate teardown); the checkpoint and
+        status.json are not."""
+        eng = self._engine
+        if eng is not None and eng.state is not None:
+            self.state, step = eng.state, eng.last_end
+        else:
+            step = self._eager_step
+        if self.cfg.train_dir and step is not None:
+            with self.tracer.span("ckpt", at_step=step):
+                ckpt.save(self.cfg.train_dir, step, self.state,
+                          compress=self.cfg.compress_ckpt,
+                          keep=self.cfg.keep_checkpoints)
+        if step is not None:
+            self._start_step = step + 1
+        self.heartbeat.terminal(
+            "preempted", cause=str(e),
+            resumable_step=(step if self.cfg.train_dir and step is not None
+                            else None))
+        return {}
 
     def _check_stop(self, step: int) -> bool:
         """True when the run should stop after ``step``: a SIGTERM/SIGINT
@@ -369,6 +428,7 @@ class Trainer:
             seg.end()
 
             win.maybe_stop(step, self.state.params)
+            self._eager_step = step  # escalated-stop checkpoint cursor
             record = {"step": step, **metrics, **seg.as_dict()}
             last = record
             self.heartbeat.observe(record)
@@ -399,12 +459,11 @@ class Trainer:
         return last
 
     def _run_chunked(self, n_steps: int, profile_dir, profile_steps) -> dict:
-        """The scan-fused loop: dispatch train_many per chunk, upload the
-        next chunk while the device runs the current one, defer metrics to
-        flush boundaries. The only host syncs are the metric-block fetches
-        at those boundaries (plus eval/checkpoint, which need the state)."""
+        """The scan-fused loop, driven by the shared ``ChunkedEngine``
+        (control/engine.py — one implementation with the LM token loop):
+        dispatch train_many per chunk, upload the next chunk while the
+        device runs the current one, defer metrics to flush boundaries."""
         cfg = self.cfg
-        setup = self.setup
         ranges = self._chunk_ranges(self._start_step, n_steps)
         if not ranges:
             return {}
@@ -414,99 +473,33 @@ class Trainer:
                 self.ds, range_fn, cfg.num_workers, cfg.batch_size,
                 tracer=self.tracer
             ))
-        deferred = DeferredMetricWriter(self.writer,
-                                        observer=self.heartbeat.observe)
+        from draco_tpu.control.clients import TrainerChunkClient
+        from draco_tpu.control.engine import ChunkedEngine
 
-        def should_log(step):
-            return step % cfg.log_every == 0 or step == 1
+        self._engine = ChunkedEngine(
+            TrainerChunkClient(self), eval_freq=cfg.eval_freq,
+            total_end=n_steps, tracer=self.tracer, heartbeat=self.heartbeat,
+            compile_watch=self.compile_watch, writer=self.writer,
+            autopilot=self._make_autopilot(), timed=True,
+            profile_dir=profile_dir, profile_steps=profile_steps,
+            is_main=self._is_main)
+        self.state, last = self._engine.run(self.state, ranges)
+        return last
 
-        win = profiler_window(profile_dir, profile_steps, self._is_main,
-                              self.tracer,
-                              on_stop=self.heartbeat.observe_device)
-        # t_fetch = this chunk's host assemble + upload wall; t_comp = the
-        # flush window's remaining wall (device execution + drain) amortized
-        # over its steps — same record keys as the eager loop's segments
-        window_t0 = time.perf_counter()
-        window_fetch = 0.0
-        window_steps = 0
+    def _make_autopilot(self):
+        """The adaptive coding autopilot (control/autopilot.py) when
+        ``cfg.autopilot == "on"`` — None otherwise (the engine then runs
+        the historical loop bit-for-bit). Built once and cached: regime
+        and quarantine state outlive individual run() calls (block-wise
+        callers), re-attached to each run's fresh client by the engine."""
+        if getattr(self.cfg, "autopilot", "off") != "on":
+            return None
+        if self._autopilot is None:
+            from draco_tpu.control.autopilot import make_autopilot
 
-        def upload(i):
-            nonlocal window_fetch
-            t0 = time.perf_counter()
-            c = self._device_chunk(
-                ranges[i], ranges[i + 1] if i + 1 < len(ranges) else None
-            )
-            dt = time.perf_counter() - t0
-            window_fetch += dt
-            return c, dt
-
-        chunk, fetch_s = upload(0)
-        for i, (start, k) in enumerate(ranges):
-            end = start + k - 1
-            # capture snaps to whole chunks; the chunk start rides along so
-            # the anchor's steps_profiled reflects the snapped window
-            win.maybe_start(end, first_step=start)
-            xs, ys, masks, presents = chunk
-            with self.tracer.span("dispatch", chunk_start=start, k=k), \
-                    self.compile_watch.expect("train_many", key=k):
-                self.state, block = setup.train_many(self.state, xs, ys,
-                                                     masks, presents)
-            extras = {"t_fetch": round(fetch_s / k, 6)}
-            if presents is not None:
-                extras["present"] = presents.sum(axis=1)
-            deferred.defer(range(start, end + 1), setup.metric_names, block,
-                           extras)
-            window_steps += k
-            if i + 1 < len(ranges):  # overlap: upload i+1 during chunk i
-                chunk, fetch_s = upload(i + 1)
-            boundary = bool(cfg.eval_freq) and end % cfg.eval_freq == 0
-            if boundary or i + 1 == len(ranges) or deferred.depth >= 4:
-                # drain the window's chunks BEFORE reading the clock so the
-                # device-execution wall lands in t_comp, not in no-window
-                # limbo (flush's np.asarray would otherwise absorb it after
-                # window_t0 resets); this is the boundary's one true sync.
-                # A device→host fetch, NOT block_until_ready: the latter is
-                # only a dispatch barrier on remote-dispatch backends
-                # (utils/timing.py, PERF.md §0)
-                with self.tracer.span("sync", at_step=end):
-                    deferred.sync()
-                t_comp = max(time.perf_counter() - window_t0 - window_fetch,
-                             0.0)
-                with self.tracer.span("flush", at_step=end):
-                    deferred.flush(should_log,
-                                   {"t_comp": round(t_comp / window_steps,
-                                                    6)})
-                    self.heartbeat.beat(end, n_steps,
-                                        extra={**self._prefetch_depth(),
-                                               **self.compile_watch
-                                               .snapshot()})
-                    self.tracer.flush()
-                window_t0 = time.perf_counter()
-                window_fetch = 0.0
-                window_steps = 0
-            win.maybe_stop(end, self.state.params)
-            if boundary:
-                self.evaluate(end)
-                if cfg.train_dir:
-                    with self.tracer.span("ckpt", at_step=end):
-                        ckpt.save(cfg.train_dir, end, self.state,
-                                  compress=cfg.compress_ckpt,
-                                  keep=cfg.keep_checkpoints)
-                # eval/checkpoint wall must not leak into the next window's
-                # t_comp (the eager loop's Segments exclude them too)
-                window_t0 = time.perf_counter()
-            if self._check_stop(end):
-                # a chunk boundary is a legal stop point mid-window: drain
-                # the pending metric blocks first, then snap the resumable
-                # checkpoint exactly here
-                with self.tracer.span("sync", at_step=end):
-                    deferred.sync()
-                with self.tracer.span("flush", at_step=end):
-                    deferred.flush(should_log)
-                self._snap_stop(end, already_saved=bool(boundary))
-                break
-        win.stop(self.state.params)
-        return deferred.last
+            self._autopilot = make_autopilot(self.cfg, self.heartbeat,
+                                             dim=self.setup.dim)
+        return self._autopilot
 
     def _prefetch_depth(self) -> dict:
         """Heartbeat extra: in-flight prefetch requests of whichever

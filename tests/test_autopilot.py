@@ -1,0 +1,348 @@
+"""Adaptive coding autopilot (draco_tpu/control, ISSUE 14): policy units
+(regime algebra, policy grammar, config validation), the live
+quarantine → readmit → dial_down → dial_up lifecycle on BOTH production
+loops driven through the shared ChunkedEngine, the warm-program-swap
+contract (a family switch compiles exactly the expected new program ONCE
+— its own compile-sentinel label — and returning to a previously-run
+regime reuses the jitted executable, all under compile_guard="raise"),
+remediation attribution (every decision names its triggering incident),
+and the second-SIGTERM escalation path (resilience/supervisor.py).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from draco_tpu.config import TrainConfig
+from draco_tpu.control import autopilot as ap
+
+# compressed hysteresis for the short test scenarios (production defaults
+# are sized for long runs); straggle.streak=2 fires the detector after a
+# 2-step absence streak
+POLICY = ("dial_down_boundaries=1,clean_boundaries=1,"
+          "dial_up_boundaries=2,readmit_boundaries=2")
+THRESHOLDS = "straggle.streak=2"
+
+
+# --------------------------------------------------------------------------
+# policy + regime units (no training)
+# --------------------------------------------------------------------------
+
+@pytest.mark.core
+def test_policy_grammar_and_validation():
+    assert ap.parse_policy("r_low=1.2, clean_boundaries=3") == {
+        "r_low": 1.2, "clean_boundaries": 3.0}
+    assert ap.parse_policy("") == {}
+    with pytest.raises(ValueError, match="unknown autopilot policy"):
+        ap.parse_policy("bogus=1")
+    with pytest.raises(ValueError, match="not"):
+        ap.parse_policy("r_low")
+    # config.validate owns the dependency chain
+    with pytest.raises(ValueError, match="incident_watch"):
+        TrainConfig(approach="cyclic", worker_fail=1, num_workers=8,
+                    autopilot="on", steps_per_call=4,
+                    train_dir="/tmp/x").validate()
+    with pytest.raises(ValueError, match="train_dir"):
+        TrainConfig(approach="cyclic", worker_fail=1, num_workers=8,
+                    autopilot="on", incident_watch="on", steps_per_call=4,
+                    train_dir="").validate()
+    with pytest.raises(ValueError, match="chunked regime"):
+        TrainConfig(approach="cyclic", worker_fail=1, num_workers=8,
+                    autopilot="on", incident_watch="on", steps_per_call=1,
+                    train_dir="/tmp/x").validate()
+    with pytest.raises(ValueError, match="cyclic\\|approx"):
+        TrainConfig(approach="baseline", autopilot="on",
+                    incident_watch="on", steps_per_call=4,
+                    train_dir="/tmp/x").validate()
+    with pytest.raises(ValueError, match="unknown autopilot policy"):
+        TrainConfig(autopilot_policy="nope=1").validate()
+
+
+@pytest.mark.core
+def test_regime_cfg_algebra():
+    """regime_cfg: the approx regime drops the Byzantine knobs, sizes the
+    straggler design point for the quarantined fleet, and strips the
+    schedule/host fault kinds (applied at launch) while keeping in-graph
+    kinds (compiled into every step body)."""
+    base = TrainConfig(
+        approach="cyclic", worker_fail=1, adversary_count=0,
+        num_workers=8, redundancy="shared", steps_per_call=4,
+        incident_watch="on", autopilot="on", train_dir="/tmp/x",
+        fault_spec="adversary@5-20:w2,nan_grad@7:w3,straggle@26-40:w5",
+    ).validate()
+    assert ap.base_regime(base).tag == "cyclic_r3"
+    target = ap.Regime("approx", 1.5, "off")
+    cfg2 = ap.regime_cfg(base, target, quarantined=1)
+    assert cfg2.approach == "approx" and cfg2.code_redundancy == 1.5
+    assert cfg2.worker_fail == 0 and cfg2.adversary_count == 0
+    assert cfg2.fault_spec == "nan_grad@7:w3"  # in-graph kind survives
+    # budget covers the quarantined worker + configured load + headroom
+    assert cfg2.straggler_alpha * 8 >= 2
+    cfg2.validate()  # the swapped-to cfg is itself a legal config
+    # dialing back up restores the base point exactly
+    cfg3 = ap.regime_cfg(base, ap.base_regime(base))
+    assert cfg3.approach == "cyclic" and cfg3.worker_fail == 1
+
+
+@pytest.mark.core
+def test_straggle_detector_streaks_and_quarantine_exclusion():
+    """The straggle detector (obs/incidents.py, the dial-down evidence):
+    fires on a sustained per-worker absence streak, attributed to the
+    absent worker; rotating one-off drops never fire; a QUARANTINED
+    worker's absence is policy, not telemetry."""
+    from draco_tpu.obs import incidents as inc
+    from tests.test_incidents import rec
+
+    eng = inc.IncidentEngine(num_workers=8)
+    # rotating single-step drops: no streak, no episode
+    for s, absent in enumerate((1, 3, 5, 7, 2, 4, 6, 0), start=1):
+        eng.observe(rec(s, present=0xFF & ~(1 << absent)))
+    assert eng.open_episodes() == [] and eng.total_onsets == 0
+    # worker 5 sustained: fires at the 4th consecutive absent record
+    for s in range(9, 14):
+        eng.observe(rec(s, present=0xFF & ~(1 << 5)))
+    eps = eng.open_episodes()
+    assert [e["type"] for e in eps] == ["straggle"]
+    assert eps[0]["workers"] == [5] and eps[0]["onset_step"] == 12
+    # quarantined worker: same absence pattern raises nothing
+    eng2 = inc.IncidentEngine(num_workers=8)
+    eng2.quarantined.add(5)
+    for s in range(1, 10):
+        eng2.observe(rec(s, present=0xFF & ~(1 << 5)))
+    assert eng2.total_onsets == 0
+
+
+@pytest.mark.core
+def test_ledger_forgive_resets_trust_only():
+    from draco_tpu.obs.forensics import AccusationLedger
+    from tests.test_incidents import rec
+
+    led = AccusationLedger(4)
+    for s in range(1, 6):
+        led.observe(rec(s, accused=0b0100, present=0b1111))
+    assert led.trust[2] < 0.5 and led.accused[2] == 5
+    led.forgive(2, 0.75)
+    assert led.trust[2] == 0.75
+    assert led.accused[2] == 5  # history stays
+
+
+# --------------------------------------------------------------------------
+# live lifecycle — CNN Trainer loop
+# --------------------------------------------------------------------------
+
+def _ledger_labels(train_dir):
+    rows = [json.loads(l) for l in open(os.path.join(train_dir,
+                                                     "compiles.jsonl"))]
+    out = {}
+    for r in rows:
+        if r["program"]:
+            out[r["program"]] = out.get(r["program"], 0) + 1
+    return out, rows
+
+
+def _events(train_dir):
+    return [json.loads(l) for l in
+            open(os.path.join(train_dir, "incidents.jsonl"))]
+
+
+def test_autopilot_lifecycle_cnn(tmp_path):
+    """The full remediation lifecycle on the coded-DP Trainer: trust
+    collapse → quarantine (attributed, schedule-only, aggregate never
+    corrupted), sustained straggle → dial_down to approx r=1.5 (NEW
+    program compiled exactly once under its own sentinel label), clean
+    window → readmit + dial_up (executable REUSED — zero new compiles),
+    all under compile_guard='raise' with zero guard trips."""
+    from draco_tpu.data.datasets import load_dataset
+    from draco_tpu.obs.forensics import record_masks
+    from draco_tpu.training.trainer import Trainer
+
+    d = str(tmp_path / "cnn")
+    cfg = TrainConfig(
+        network="FC", dataset="synthetic-mnist", batch_size=4, lr=0.02,
+        momentum=0.9, num_workers=8, max_steps=32, eval_freq=4,
+        train_dir=d, log_every=1, steps_per_call=4, approach="cyclic",
+        worker_fail=1, adversary_count=0, err_mode="rev_grad",
+        redundancy="shared", step_guard="on", incident_watch="on",
+        compile_guard="raise", autopilot="on", autopilot_policy=POLICY,
+        incident_thresholds=THRESHOLDS,
+        fault_spec="adversary@3-8:w2,straggle@13-20:w5",
+    )
+    ds = load_dataset("synthetic-mnist", synthetic_train=512,
+                      synthetic_test=64)
+    tr = Trainer(cfg, dataset=ds, quiet=True)
+    last = tr.run()
+    snap = tr.compile_watch.snapshot()
+    tr.close()
+    assert np.isfinite(last["loss"]) and last["step"] == 32
+
+    # remediation lifecycle, in order, each attributed to an incident
+    rems = [e for e in _events(d) if e["event"] == "remediation"]
+    actions = [e["action"] for e in rems]
+    assert actions == ["quarantine", "dial_down", "readmit", "dial_up"] \
+        or actions == ["quarantine", "readmit", "dial_down", "dial_up"], \
+        actions
+    for e in rems:
+        assert e["trigger"] and e["trigger"]["type"], e
+        assert e["trigger"]["onset_step"] is not None, e
+    byact = {e["action"]: e for e in rems}
+    assert byact["quarantine"]["worker"] == 2
+    assert byact["quarantine"]["trigger"]["type"] == "trust"
+    assert byact["quarantine"]["trigger"]["workers"] == [2]
+    assert byact["dial_down"]["regime"]["tag"] == "approx_r1.5"
+    assert byact["dial_down"]["trigger"]["type"] in ("straggle",
+                                                     "starvation")
+    assert byact["dial_down"]["evidence"]["executable"] == "compiled"
+    assert byact["dial_up"]["regime"]["tag"] == "cyclic_r3"
+    assert byact["dial_up"]["evidence"]["executable"] == "reused"
+
+    # warm-swap compile contract: the approx program built EXACTLY once
+    # under its own label; returning to cyclic compiled nothing new; and
+    # the raise-guard saw zero steady recompiles end to end
+    labels, rows = _ledger_labels(d)
+    assert labels.get("train_many@approx_r1.5[4]") == 1, labels
+    assert labels.get("train_many[4]", 0) >= 1
+    assert snap["steady_recompiles"] == 0
+    assert not any(r["steady_recompile"] for r in rows)
+
+    # the quarantined worker's rows really stopped arriving (one-chunk
+    # assembly lag after the effective step), and the aggregate was never
+    # corrupted: zero guard trips over the whole run
+    q_eff = byact["quarantine"]["effective_step"] + cfg.steps_per_call
+    readmit_step = byact["readmit"]["step"]
+    recs = [json.loads(l) for l in open(os.path.join(d, "metrics.jsonl"))]
+    recs = [r for r in recs if "guard_trips" in r]
+    assert sum(r["guard_trips"] for r in recs) == 0.0
+    for r in recs:
+        masks = record_masks(r, 8)
+        assert masks is not None
+        if q_eff <= r["step"] <= readmit_step:
+            assert not masks["present"][2], r["step"]
+
+    # control block rides status.json (additive under schema 4) and the
+    # run ends back in the base regime
+    st = json.load(open(os.path.join(d, "status.json")))
+    assert st["state"] == "done" and st["schema"] == 4
+    c = st["control"]
+    assert c["autopilot"] == "on"
+    assert c["regime"]["tag"] == "cyclic_r3" == c["base_regime"]
+    assert c["swaps"] == 2 and c["quarantined"] == []
+    assert c["remediations"] == 4 and c["last"]["action"] == "dial_up"
+
+
+# --------------------------------------------------------------------------
+# live lifecycle — LM token loop (sp route)
+# --------------------------------------------------------------------------
+
+@pytest.mark.slow  # two route-setup builds + K=4 scan compiles (same
+# budget class as the decode-kernel production-step suite)
+def test_autopilot_dial_lm_sp(tmp_path):
+    """The same dial on the LM token loop through the SAME ChunkedEngine:
+    sustained straggle dials cyclic down to approx (new
+    train_token_many@approx_r1.5 program, compiled once), clean evidence
+    dials back up (executable reuse), 0 steady retraces."""
+    from draco_tpu.parallel import make_mesh_2d
+    from draco_tpu.parallel.sp_step import train_sp
+
+    d = str(tmp_path / "lm")
+    cfg = TrainConfig(
+        network="TransformerLM", dataset="synthetic-text", batch_size=2,
+        num_workers=8, max_steps=24, eval_freq=4, train_dir=d,
+        log_every=1, steps_per_call=4, approach="cyclic", worker_fail=1,
+        adversary_count=0, err_mode="rev_grad", redundancy="shared",
+        seq_len=16, vocab=32, model_dim=32, model_heads=2, model_layers=1,
+        step_guard="on", incident_watch="on", compile_guard="raise",
+        autopilot="on", autopilot_policy=POLICY,
+        incident_thresholds=THRESHOLDS,
+        fault_spec="straggle@3-10:w5",
+    )
+    state, metrics = train_sp(cfg, make_mesh_2d(cfg.num_workers, 1),
+                              quiet=True)
+    assert np.isfinite(metrics["loss"])
+
+    rems = [e for e in _events(d) if e["event"] == "remediation"]
+    actions = [e["action"] for e in rems]
+    assert actions == ["dial_down", "dial_up"], actions
+    assert all(e["trigger"] and e["trigger"]["type"] for e in rems)
+    assert rems[0]["regime"]["tag"] == "approx_r1.5"
+    assert rems[0]["evidence"]["executable"] == "compiled"
+    assert rems[1]["evidence"]["executable"] == "reused"
+
+    labels, rows = _ledger_labels(d)
+    assert labels.get("train_token_many@approx_r1.5[4]") == 1, labels
+    assert labels.get("train_token_many[4]", 0) >= 1
+    assert not any(r["steady_recompile"] for r in rows)
+
+    st = json.load(open(os.path.join(d, "status.json")))
+    assert st["state"] == "done"
+    assert st["control"]["regime"]["tag"] == "cyclic_r3"
+    assert st["control"]["swaps"] == 2
+
+
+# --------------------------------------------------------------------------
+# second-SIGTERM escalation (resilience/supervisor.py, ISSUE 14 satellite)
+# --------------------------------------------------------------------------
+
+@pytest.mark.core
+def test_deliver_signal_escalates_on_second():
+    from draco_tpu.resilience.supervisor import (GracefulStop,
+                                                 ImmediateStopError)
+
+    stop = GracefulStop()  # degraded holder (no __enter__): flag path
+    stop.deliver_signal()
+    assert stop.requested and not stop.escalated
+    with pytest.raises(ImmediateStopError, match="second SIGTERM"):
+        stop.deliver_signal()
+    assert stop.escalated
+
+
+def test_second_sigterm_forces_immediate_resumable_checkpoint(tmp_path):
+    """The pinned SIGTERM→SIGTERM sequence: both events land in the first
+    chunk's poll window, so the second escalates mid-run — the loop must
+    write an IMMEDIATE resumable checkpoint + the terminal 'preempted'
+    status (naming the escalation), and resuming from it reproduces the
+    uninterrupted run bitwise."""
+    from draco_tpu.data.datasets import load_dataset
+    from draco_tpu.training.trainer import Trainer
+    from draco_tpu.utils import checkpoint as ckpt
+
+    ds = load_dataset("synthetic-mnist", synthetic_train=256,
+                      synthetic_test=32)
+    base = dict(
+        network="FC", dataset="synthetic-mnist", batch_size=4, lr=0.02,
+        num_workers=8, max_steps=8, eval_freq=0, log_every=1,
+        steps_per_call=4, approach="cyclic", worker_fail=1,
+        err_mode="rev_grad", redundancy="shared",
+    )
+
+    def pv(tr):
+        import jax
+
+        return np.concatenate([np.ravel(x) for x in jax.tree.leaves(
+            jax.device_get(tr.state.params))])
+
+    clean = Trainer(TrainConfig(**base), dataset=ds, quiet=True)
+    clean.run()
+    want = pv(clean)
+    clean.close()
+
+    d = str(tmp_path / "esc")
+    tr = Trainer(TrainConfig(**base, train_dir=d,
+                             fault_spec="sigterm@2,sigterm@3"),
+                 dataset=ds, quiet=True)
+    last = tr.run()
+    tr.close()
+    assert last == {}  # escalated: un-flushed tail records are dropped
+    st = json.load(open(os.path.join(d, "status.json")))
+    assert st["state"] == "preempted"
+    assert "second SIGTERM" in st["cause"]
+    assert st["resumable_step"] == 4
+    assert 4 in ckpt.available_steps(d)
+
+    tr2 = Trainer(TrainConfig(**base, train_dir=d, checkpoint_step=4),
+                  dataset=ds, quiet=True)
+    tr2.run()
+    got = pv(tr2)
+    tr2.close()
+    np.testing.assert_array_equal(want, got)

@@ -697,6 +697,82 @@ def test_perf_watch_gates_on_flipped_straggler_bound(tmp_path):
     assert "straggler.cyclic.e3.feasible" in regs
 
 
+def test_autopilot_study_infeasible_cell_fast(tmp_path):
+    """tools/autopilot_study.py partial sweep: the fixed-approx cell is
+    infeasible BY CONSTRUCTION under the adversary scenario (config.
+    validate: no Byzantine certificate) and a partial sweep can never
+    certify beats_fixed — exit 1 with the structure intact."""
+    import json
+
+    from tools import autopilot_study
+
+    out = tmp_path / "ap.json"
+    rc = autopilot_study.main(["--cells", "approx_r1.5",
+                               "--out", str(out)])
+    assert rc == 1
+    data = json.loads(out.read_text())
+    (row,) = data["rows"]
+    assert row["cell"] == "approx_r1.5" and row["feasible"] is False
+    assert "adversary" in row["detail"]
+    assert data["infeasible_fixed"] == ["approx_r1.5"]
+    assert data["autopilot_beats_fixed"] is False
+    assert data["scenario"].count("@") == 3  # the committed 3-episode plan
+
+
+def test_perf_watch_gates_on_flipped_autopilot_certificates(tmp_path):
+    """The autopilot-study certificates gate at tolerance 0 in BOTH
+    directions: beats_fixed or quarantine_clean flipping false is a
+    control-loop regression; the infeasible fixed-approx cell silently
+    claiming feasibility (the 'good' direction) is a semantic change in
+    the family's validation and must gate too (kind 'pinned')."""
+    import json
+
+    from tools import perf_watch
+
+    root = tmp_path
+    (root / "baselines_out").mkdir()
+    study = {"all_ok": True, "autopilot_beats_fixed": True, "rows": [
+        {"cell": "autopilot", "feasible": True, "reached_target": True,
+         "remediations_attributed": True, "dialed_down": True,
+         "dialed_up": True, "quarantine_clean": True, "ok": True},
+        {"cell": "cyclic_r3", "feasible": True, "reached_target": True,
+         "ok": True},
+        {"cell": "approx_r1.5", "feasible": False},
+    ]}
+    path = root / "baselines_out" / "autopilot_study.json"
+    path.write_text(json.dumps(study))
+    assert perf_watch.main(["--root", str(root), "--snapshot"]) == 0
+    snap = json.loads(
+        (root / "baselines_out" / "perf_watch.json").read_text())
+    assert "autopilot.autopilot_beats_fixed" in snap["metrics"]
+    assert "autopilot.autopilot.quarantine_clean" in snap["metrics"]
+    # infeasible cells fold ONLY their (pinned) feasibility flag
+    assert "autopilot.approx_r1.5.feasible" in snap["metrics"]
+    assert "autopilot.approx_r1.5.reached_target" not in snap["metrics"]
+    assert perf_watch.main(["--root", str(root)]) == 0  # clean
+
+    study["autopilot_beats_fixed"] = False
+    study["all_ok"] = False
+    study["rows"][0]["quarantine_clean"] = False
+    path.write_text(json.dumps(study))
+    out = root / "report.json"
+    assert perf_watch.main(["--root", str(root), "--json", str(out)]) == 1
+    regs = {r["metric"] for r in json.loads(out.read_text())["regressions"]}
+    assert {"autopilot.autopilot_beats_fixed",
+            "autopilot.autopilot.quarantine_clean",
+            "autopilot.all_ok"} <= regs
+
+    # the pinned direction: fixed approx silently becoming feasible gates
+    study["autopilot_beats_fixed"] = True
+    study["all_ok"] = True
+    study["rows"][0]["quarantine_clean"] = True
+    study["rows"][2]["feasible"] = True
+    path.write_text(json.dumps(study))
+    assert perf_watch.main(["--root", str(root), "--json", str(out)]) == 1
+    regs = {r["metric"] for r in json.loads(out.read_text())["regressions"]}
+    assert "autopilot.approx_r1.5.feasible" in regs
+
+
 def test_perf_watch_passes_on_committed_artifacts():
     """The committed baselines_out/perf_watch.json snapshot must match the
     committed round artifacts — the same gate a future round runs."""

@@ -86,10 +86,15 @@ def test_trust_collapse_onset_offset_and_attribution():
     (ep,) = [e for e in eng.episodes if e["type"] == "trust"]
     assert ep["offset_step"] > ep["onset_step"]
     # an ABSENT worker's trust holds: absence is an erasure, not evidence
+    # for the ACCUSATION detectors — what sustained absence DOES raise is
+    # the straggle incident (ISSUE 14: the autopilot's dial-down signal),
+    # attributed to the absent worker
     eng2 = inc.IncidentEngine(num_workers=4)
     for s in range(1, 12):
         eng2.observe(rec(s, accused=0, present=0b1011))  # w2 always absent
-    assert eng2.total_onsets == 0
+    assert [e["type"] for e in eng2.open_episodes()] == ["straggle"]
+    assert eng2.open_episodes()[0]["workers"] == [2]
+    assert not any(e["type"] == "trust" for e in eng2.all_episodes())
 
 
 @pytest.mark.core

@@ -481,8 +481,36 @@ class TestAttacks:
         np.testing.assert_allclose(out[1], np.asarray(g)[1], rtol=1e-6)
         out = np.asarray(attacks.inject_plain(g, mask, "constant"))
         np.testing.assert_allclose(out[3], -100.0)
-        out = np.asarray(attacks.inject_plain(g, mask, "random"))
-        np.testing.assert_allclose(out, np.asarray(g))
+        # the random attack is REAL now (ISSUE 14 satellite — the
+        # reference left it a passthrough TODO): a seeded N(0,1) payload
+        # scaled by the magnitude, drawn from the (seed, step) schedule
+        # discipline — deterministic, worker rows independent, honest
+        # rows untouched
+        out = np.asarray(attacks.inject_plain(g, mask, "random",
+                                              step=3, seed=428))
+        np.testing.assert_allclose(out[1], np.asarray(g)[1], rtol=1e-6)
+        np.testing.assert_allclose(out[2], np.asarray(g)[2], rtol=1e-6)
+        assert not np.allclose(out[0], np.asarray(g)[0])
+        assert not np.allclose(out[0], out[3])  # per-row independent draws
+        assert np.abs(out[0]).max() > 10  # magnitude-scaled, not a nudge
+        again = np.asarray(attacks.inject_plain(g, mask, "random",
+                                                step=3, seed=428))
+        np.testing.assert_array_equal(out, again)  # same (seed, step) draw
+        other = np.asarray(attacks.inject_plain(g, mask, "random",
+                                                step=4, seed=428))
+        assert not np.array_equal(out, other)  # distinct per step
+        # a keyless call has no stream to draw from — named config error
+        with pytest.raises(ValueError, match="random"):
+            attacks.attack_plain(g, "random")
+        # cyclic wire form: additive on the encoded rows, seeded the same
+        re_ = jnp.asarray(np.asarray(g)[:3])
+        o_re, o_im = attacks.inject_cyclic(re_, re_, jnp.asarray(
+            np.array([False, True, False])), "random", step=3, seed=428)
+        np.testing.assert_allclose(np.asarray(o_re)[0], np.asarray(re_)[0])
+        assert not np.allclose(np.asarray(o_re)[1], np.asarray(re_)[1])
+        # independent re/im draws
+        assert not np.allclose(np.asarray(o_re)[1] - np.asarray(re_)[1],
+                               np.asarray(o_im)[1] - np.asarray(re_)[1])
 
     def test_cyclic_additive(self, rng):
         from draco_tpu import attacks

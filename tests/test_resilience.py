@@ -108,6 +108,62 @@ def test_fault_plan_parse_grammar_and_determinism():
     for bad in ("what@3", "nan_grad@0", "nan_grad@2:w9", "nan_grad"):
         with pytest.raises(ValueError):
             FaultPlan.parse(bad, 428, 8)
+
+
+@pytest.mark.core
+def test_fault_plan_episode_grammar_windows_and_recurrence():
+    """ISSUE 14 satellite: windowed/recurring specs — ``kind@a-b`` with
+    optional ``:every<k>`` stride — parse, validate, expand to the right
+    occurrence sets, and round-trip through ``FaultPlan.spec()``."""
+    p = FaultPlan.parse(
+        "straggle@20-60:w3:d4:every10,adversary@5-40:w2,nan_grad@8-10:w1",
+        428, 8)
+    churn, adv, nan = p.events
+    assert list(churn.occurrences(1, 100)) == [20, 30, 40, 50, 60]
+    assert list(churn.occurrences(35, 100)) == [40, 50, 60]
+    assert adv.every == 1 and list(adv.occurrences(38, 39)) == [38, 39]
+    assert nan.occurs_at(9) and not nan.occurs_at(11)
+    # round-trip: spec() is canonical (workers pinned explicit) and
+    # re-parsing reproduces the exact plan
+    assert p.spec() == ("straggle@20-60:w3:d4:every10,adversary@5-40:w2,"
+                        "nan_grad@8-10:w1")
+    assert FaultPlan.parse(p.spec(), 428, 8) == p
+    # seeded-draw workers become explicit on the way out, and stay stable
+    q = FaultPlan.parse("straggle@5-9", 428, 8)
+    assert f":w{q.events[0].worker}" in q.spec()
+    assert FaultPlan.parse(q.spec(), 428, 8) == q
+    # parse-time validation: inverted windows, strides without a window,
+    # windows on one-checkpoint kinds, fractional step dwell
+    for bad in ("nan_grad@9-5", "sigterm@5:every2", "ckpt_corrupt@5-9",
+                "straggle@5-9:d1.5", "adversary@5:d0.5",
+                "straggle@5-9:every0"):
+        with pytest.raises(ValueError):
+            FaultPlan.parse(bad, 428, 8)
+
+
+@pytest.mark.core
+def test_episode_schedule_application():
+    """Windowed events land on the host schedules exactly: adversary
+    episodes mark their window (within budget), windowed straggle is
+    absent exactly DURING the window, recurring churn drops d steps per
+    occurrence, and the point form stays sustained-to-the-end."""
+    import numpy as np
+
+    from draco_tpu.resilience import faults as fm
+
+    plan = FaultPlan.parse(
+        "adversary@5-8:w2,straggle@10-13:w4,straggle@20-28:w5:d2:every4,"
+        "straggle@30:w6", 428, 8)
+    adv = fm.apply_adversary(np.zeros((35, 8), bool), plan)
+    assert sorted(adv[:, 2].nonzero()[0]) == [5, 6, 7, 8]
+    st = fm.apply_straggle(None, plan, 8, 34)
+    assert sorted(st[:, 4].nonzero()[0]) == [10, 11, 12, 13]  # window only
+    assert sorted(st[:, 5].nonzero()[0]) == [20, 21, 24, 25, 28, 29]
+    assert sorted(st[:, 6].nonzero()[0]) == [30, 31, 32, 33, 34]  # to end
+    # config-level: approx rejects adversary-marking kinds
+    with pytest.raises(ValueError, match="not expressible"):
+        make_cfg(approach="approx", worker_fail=0, redundancy="shared",
+                 fault_spec="adversary@5:w2").validate()
     # config.validate() surfaces parse errors at config time
     with pytest.raises(ValueError):
         make_cfg(fault_spec="bogus@1").validate()
@@ -526,10 +582,11 @@ def test_chaos_mini_matrix_cnn_k4(tmp_path):
     assert rc == 0, data
     assert data["all_ok"]
     # straggle is the approx family's cell (a sustained drop on an exact
-    # code just re-tests the over_budget locator failure) — every other
-    # fault class runs here
+    # code just re-tests the over_budget locator failure) and the
+    # adversary episode runs on the dedicated random-attack loops
+    # (cnn_rand_*, ISSUE 14) — every other fault class runs here
     assert {r["fault"] for r in data["rows"]} \
-        == set(chaos_run.FAULTS) - {"straggle"}
+        == set(chaos_run.FAULTS) - {"straggle"} - set(chaos_run.RAND_FAULTS)
     outcomes = {r["fault"]: r["outcome"] for r in data["rows"]}
     assert outcomes["nan_grad"] == "guarded"
     assert outcomes["over_budget"] == "guarded"
@@ -556,7 +613,7 @@ def test_committed_chaos_matrix_covers_every_fault_class():
     # coded-DP trainer + >= 2 LM routes + the approx family (ISSUE 8),
     # eager and chunked regimes
     assert {"cnn_k1", "cnn_k4", "lm_k1", "lm_k4", "lm_tp_k4",
-            "approx_k1", "approx_k4"} <= loops
+            "approx_k1", "approx_k4", "cnn_rand_k1", "cnn_rand_k4"} <= loops
     assert not any(r["outcome"] == "FAILED" for r in data["rows"])
     # the approx cells: straggle degrades boundedly (victim absent, never
     # accused, every residual within its bound), nan_grad stays guarded
@@ -581,8 +638,18 @@ def test_committed_chaos_matrix_covers_every_fault_class():
             assert "nonfinite" in r["incident"]["raised"], r
         if r["fault"] == "over_budget":
             assert "guard" in r["incident"]["raised"], r
-        if r["fault"] in ("straggle", "sigterm", "ckpt_corrupt",
-                          "ckpt_truncate"):
+        if r["fault"] == "straggle":
+            # the sustained drop raises the attributed straggle incident
+            # (ISSUE 14 — the autopilot's dial-down evidence)
+            assert r["incident"]["raised"] == ["straggle"], r
+        if r["fault"] == "adversary":
+            # the seeded random attack (ISSUE 14 satellite): detected,
+            # attributed and excised — one within-budget step opens NO
+            # incident (trust EW is the hysteresis)
+            assert r["outcome"] == "attributed_excised", r
+            assert r["attributed"] and r["detected"], r
+            assert r["incident"]["raised"] == [], r
+        if r["fault"] in ("sigterm", "ckpt_corrupt", "ckpt_truncate"):
             assert r["incident"]["raised"] == [], r
     # perf_watch folds the matrix: a masked->crashed flip gates nonzero
     from tools import perf_watch

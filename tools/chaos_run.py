@@ -78,7 +78,16 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from draco_tpu.cli import maybe_force_cpu_mesh  # noqa: E402
 
 FAULTS = ("nan_grad", "over_budget", "prefetch_crash", "prefetch_hang",
-          "sigterm", "ckpt_corrupt", "ckpt_truncate", "straggle")
+          "sigterm", "ckpt_corrupt", "ckpt_truncate", "straggle",
+          "adversary")
+# the declarative within-budget adversary episode (faults.apply_adversary)
+# runs on the dedicated random-attack loops: cfg.err_mode="random" (the
+# seeded random-gradient attack, ISSUE 14 satellite — a reference TODO
+# until now), base adversary_count=0 so the event's worker is the ONLY
+# live adversary. Expected outcome: the cyclic decode detects, attributes
+# AND excises the attack (detection P/R 1.0 at the fault step, named
+# worker accused, zero guard trips) — `attributed_excised`.
+RAND_FAULTS = ("adversary",)
 # eager loops have no chunk prefetcher thread and ckpt rows ride the
 # chunked regime; the in-graph + signal faults cover both regimes
 EAGER_FAULTS = ("nan_grad", "over_budget", "sigterm")
@@ -105,7 +114,7 @@ NUM_WORKERS = 8
 # to exactly this worker; faults that attribute are checked against the
 # run's own metrics.jsonl at the fault step (ISSUE 7)
 NAN_WORKER = 3
-ATTRIBUTED_FAULTS = ("nan_grad", "over_budget")
+ATTRIBUTED_FAULTS = ("nan_grad", "over_budget", "adversary")
 
 
 def _base_cfg_kw():
@@ -189,6 +198,12 @@ def _loops():
                      redundancy="shared", code_redundancy=1.5,
                      straggler_alpha=0.25)
 
+    # the random-attack loops (ISSUE 14 satellite): err_mode="random" with
+    # the code budget reserved (adversary_count=0), so the `adversary`
+    # fault event's worker is the only live adversary and the clean run
+    # trains attack-free
+    rand_kw = dict(err_mode="random", adversary_count=0)
+
     return {
         "cnn_k1": (with_k(cnn_cfg, 1), cnn_run),
         "cnn_k4": (with_k(cnn_cfg, 4), cnn_run),
@@ -197,6 +212,8 @@ def _loops():
         "lm_tp_k4": (with_k(lm_cfg, 4, tensor_shards=2), lm_tp_run),
         "approx_k1": (with_k(cnn_cfg, 1, **approx_kw), cnn_run),
         "approx_k4": (with_k(cnn_cfg, 4, **approx_kw), cnn_run),
+        "cnn_rand_k1": (with_k(cnn_cfg, 1, **rand_kw), cnn_run),
+        "cnn_rand_k4": (with_k(cnn_cfg, 4, **rand_kw), cnn_run),
     }
 
 
@@ -330,11 +347,20 @@ def _expected_incidents(loop, fault):
         if loop.startswith("lm"):
             return [("starvation", None)], set()
         return [], {"starvation", "throughput"}
-    # straggle (a within-budget erasure — the approx family's NORMAL
-    # regime), sigterm (graceful preemption), ckpt_* (offline recovery):
-    # the resilience layer absorbs these with clean telemetry, and a
-    # spurious incident is exactly the flapping the hysteresis exists to
-    # prevent
+    if fault == "straggle":
+        # a SUSTAINED drop (the spot-instance shape): the straggle
+        # detector (ISSUE 14 — the autopilot's dial-down evidence) must
+        # fire once the victim's absence streak crosses its threshold,
+        # attributed to the named victim; the decode itself stays clean
+        return [("straggle", [STRAGGLE_WORKER])], set()
+    if fault == "adversary":
+        # a single within-budget attack step: detected, attributed and
+        # excised by the decode — one accusation cannot collapse EW trust
+        # (the hysteresis), so NO incident may open
+        return [], set()
+    # sigterm (graceful preemption), ckpt_* (offline recovery): the
+    # resilience layer absorbs these with clean telemetry, and a spurious
+    # incident is exactly the flapping the hysteresis exists to prevent
     return [], set()
 
 
@@ -460,6 +486,8 @@ def run_case(loop: str, fault: str, make_cfg, run, clean_vec, workdir):
     spec = f"{fault}@{step}"
     if fault == "nan_grad":
         spec += f":w{NAN_WORKER}"  # named victim — the attribution target
+    if fault == "adversary":
+        spec += f":w{NAN_WORKER}"  # named attacker — attribution target
     if fault == "straggle":
         # named victim, no :d — sustained to the end of the run (the
         # spot-instance shape the approx family exists for)
@@ -528,6 +556,33 @@ def run_case(loop: str, fault: str, make_cfg, run, clean_vec, workdir):
             row["detail"] = ("straggle cell not bounded-degraded: "
                              f"{verdict}")
         return row
+    if fault == "adversary":
+        # the random-attack cell (ISSUE 14 satellite): the seeded random
+        # gradient must be DETECTED (in-graph detection columns score
+        # P/R 1.0 at the fault step), ATTRIBUTED (checked above) and
+        # EXCISED (decode exact → no guard trip, run finishes clean).
+        # Bitwise equality with the clean run is NOT expected: locating
+        # an error changes which honest rows the recombination solves
+        # from (different f32 rounding), not the algebraic value.
+        from draco_tpu.obs import replay
+
+        rec = replay.record_at_step(os.path.join(d, "metrics.jsonl"),
+                                    step)
+        detected = bool(rec
+                        and rec.get("det_adv") == 1
+                        and rec.get("det_tp") == 1
+                        and rec.get("located_errors") == 1)
+        row["detected"] = detected
+        if (row["final_finite"] and status.get("state") == "done"
+                and row["guard_trips"] == 0 and detected
+                and row["attributed"]):
+            row.update(ok=True, outcome="attributed_excised")
+        else:
+            row["detail"] = (f"random attack not excised cleanly: "
+                             f"detected={detected} "
+                             f"attributed={row.get('attributed')} "
+                             f"guard_trips={row['guard_trips']}")
+        return row
     if row["bitwise_equal_clean"] and status.get("state") == "done":
         row.update(ok=True, outcome="masked")
     elif (row["guard_trips"] > 0 and row["final_finite"]
@@ -589,9 +644,12 @@ def main(argv=None) -> int:
         if loop.startswith("approx"):
             # both regimes run the family's own fault triple (ISSUE 8)
             faults = [f for f in pick_faults if f in APPROX_FAULTS]
+        elif loop.startswith("cnn_rand"):
+            # the random-attack loops run exactly the adversary episode
+            faults = [f for f in pick_faults if f in RAND_FAULTS]
         else:
             faults = [f for f in pick_faults
-                      if f != "straggle"
+                      if f not in ("straggle",) + RAND_FAULTS
                       and not (eager and f not in EAGER_FAULTS)]
         if not faults:
             continue

@@ -224,6 +224,41 @@ def _check_incident_report(root):
         return None if rc == 0 else f"torn-tail replay exited {rc}"
 
 
+def _check_autopilot_study(root):
+    """ISSUE 14: the committed scenario artifact must certify the
+    autopilot beating every fixed configuration on compute-to-target
+    (with at least one fixed row recorded infeasible — the scenario must
+    actually close a family out), every remediation attributed to its
+    triggering incident, and the quarantine never corrupting the
+    aggregate."""
+    path = os.path.join(root, "baselines_out", "autopilot_study.json")
+    try:
+        with open(path) as fh:
+            data = json.load(fh)
+    except (OSError, ValueError) as e:
+        return f"cannot read autopilot_study.json: {e}"
+    if not data.get("autopilot_beats_fixed"):
+        return ("autopilot_beats_fixed is false — the adaptive dial lost "
+                "to a fixed configuration")
+    if not data.get("infeasible_fixed"):
+        return ("no fixed configuration was infeasible — the scenario no "
+                "longer exercises the certificate boundary")
+    rows = {r.get("cell"): r for r in data.get("rows") or []}
+    ap_row = rows.get("autopilot")
+    if not isinstance(ap_row, dict):
+        return "no autopilot row in the artifact"
+    for flag in ("remediations_attributed", "dialed_down",
+                 "quarantine_clean", "ok"):
+        if not ap_row.get(flag):
+            return f"autopilot row: {flag} is false"
+    for rem in ap_row.get("remediations") or []:
+        if not rem.get("trigger") or rem.get("trigger_onset") is None:
+            return f"unattributed remediation in artifact: {rem}"
+    if not data.get("all_ok"):
+        return "autopilot_study.json: all_ok is false"
+    return None
+
+
 CHECKS = (
     ("perf_watch", _check_perf_watch),
     ("device_profile --check", _check_device_profile),
@@ -236,6 +271,7 @@ CHECKS = (
     ("chaos incident coverage", _check_chaos_incidents),
     ("straggler_study all_ok",
      _flag_check(os.path.join("baselines_out", "straggler_study.json"))),
+    ("autopilot_study certificates", _check_autopilot_study),
     ("trace_report smoke", _check_trace_report),
     ("forensics_report smoke", _check_forensics_report),
     ("incident_report smoke", _check_incident_report),

@@ -140,13 +140,20 @@ def make_report(metrics_path: str, incidents_path: str,
     # instances with reset hysteresis/EW state, which one continuous
     # replay engine cannot reproduce) all degrade to a labelled
     # carry-through instead of a false DIVERGED verdict
+    # ... and so does an AUTOPILOT run (control/autopilot.py): its
+    # remediation events mark runtime-control state — quarantines mutate
+    # the present-mask schedules and the straggle detector's exclusion
+    # set, regime swaps change which columns exist — that a pure column
+    # replay cannot reproduce, so the ledger is carried through
+    controlled = any(e.get("event") == "remediation"
+                     for e in replay.iter_jsonl(incidents_path))
     ordered = [r["step"] for r in records
                if isinstance(r.get("step"), int)]
     steps = sorted(set(ordered))
     full_coverage = bool(steps) \
         and len(steps) >= steps[-1] - steps[0] + 1 \
         and all(b > a for a, b in zip(ordered, ordered[1:])) \
-        and not multi_run
+        and not multi_run and not controlled
 
     # diff the RECORD-sourced halves; beat-sourced episodes are carried
     # through (not recomputable offline — module docstring)
@@ -173,6 +180,7 @@ def make_report(metrics_path: str, incidents_path: str,
             "have_ledger": have_ledger,
             "full_coverage": full_coverage,
             "multi_run_ledger": multi_run,
+            "controlled_run": controlled,
             "match": match,
             "only_replay": [list(k) for k in only_replay],
             "only_ledger": [list(k) for k in only_ledger],
@@ -208,11 +216,17 @@ def print_table(report: dict, out=None) -> None:
         print("no incidents.jsonl (pre-incident run or clean run with no "
               "events) — replay-only report", file=out)
     elif not diff["full_coverage"]:
-        print("metrics.jsonl is subsampled (log_every > 1), missing, or a "
-              "resumed run's appended stream — the live fold saw "
-              "observations the replay cannot reproduce, so the ledger is "
-              "carried through unverified (a single log_every=1 run gets "
-              "the strict diff)", file=out)
+        if diff.get("controlled_run"):
+            print("autopilot-controlled run (remediation events in the "
+                  "ledger): quarantines and regime swaps are runtime-"
+                  "control state a pure column replay cannot reproduce — "
+                  "ledger carried through unverified", file=out)
+        else:
+            print("metrics.jsonl is subsampled (log_every > 1), missing, "
+                  "or a resumed run's appended stream — the live fold saw "
+                  "observations the replay cannot reproduce, so the "
+                  "ledger is carried through unverified (a single "
+                  "log_every=1 run gets the strict diff)", file=out)
     elif diff["match"]:
         print("replay == ledger on every record-sourced episode", file=out)
     else:

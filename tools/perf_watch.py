@@ -51,6 +51,13 @@ Folded sources (all optional — a missing artifact folds nothing):
                                 feasible is a semantic change, not an
                                 improvement), wall ms/step at the time
                                 tolerance
+  baselines_out/autopilot_study.json
+                                the adaptive-autopilot-vs-fixed scenario
+                                study (tools/autopilot_study.py, ISSUE
+                                14): beats-fixed / remediation-
+                                attribution / quarantine-clean
+                                certificates at tolerance 0, cell
+                                feasibility pinned both directions
   baselines_out/wire_study.json
                                 the shadow-quantized wire matrix
                                 (tools/wire_study.py, ISSUE 10): shadow
@@ -351,6 +358,48 @@ def fold_straggler(root: str, metrics: dict) -> None:
                 "source": src}
 
 
+def fold_autopilot(root: str, metrics: dict) -> None:
+    """Autopilot-study artifact (tools/autopilot_study.py, ISSUE 14): the
+    adaptive-control certificates gate at tolerance 0 — the autopilot
+    beating every fixed configuration on compute-to-target
+    (``beats_fixed``), every remediation naming its triggering incident
+    (``remediations_attributed``), the dial actually moving both
+    directions, and the quarantined worker never corrupting the aggregate
+    (``quarantine_clean``). Cell feasibility is pinned BOTH directions:
+    the fixed-approx row silently becoming feasible under the adversary
+    scenario would mean the family's Byzantine-certificate validation
+    regressed."""
+    path = os.path.join(root, "baselines_out", "autopilot_study.json")
+    data = _read_json(path)
+    if not isinstance(data, dict):
+        return
+    src = "baselines_out/autopilot_study.json"
+    for flag in ("all_ok", "autopilot_beats_fixed"):
+        if flag in data:
+            metrics[f"autopilot.{flag}"] = {
+                "value": float(bool(data[flag])), "kind": "ok",
+                "source": src}
+    for row in data.get("rows", []):
+        cell = row.get("cell")
+        if not cell:
+            continue
+        key = f"autopilot.{cell}"
+        metrics[f"{key}.feasible"] = {
+            "value": float(bool(row.get("feasible"))), "kind": "pinned",
+            "source": src}
+        if not row.get("feasible"):
+            continue
+        metrics[f"{key}.reached_target"] = {
+            "value": float(bool(row.get("reached_target"))), "kind": "ok",
+            "source": src}
+        for flag in ("remediations_attributed", "dialed_down", "dialed_up",
+                     "quarantine_clean"):
+            if flag in row:
+                metrics[f"{key}.{flag}"] = {
+                    "value": float(bool(row[flag])), "kind": "ok",
+                    "source": src}
+
+
 def fold_wire_study(root: str, metrics: dict) -> None:
     """Wire-study artifact (tools/wire_study.py, ISSUE 10): the shadow
     residual and flag-agreement columns are PINNED at tolerance 0 in both
@@ -488,6 +537,7 @@ def fold_all(root: str) -> dict:
     fold_program_lint(root, metrics)
     fold_chaos(root, metrics)
     fold_straggler(root, metrics)
+    fold_autopilot(root, metrics)
     fold_wire_study(root, metrics)
     fold_decode_bench(root, metrics)
     fold_device_profile(root, metrics)
