@@ -205,6 +205,34 @@ def _build_host_outfeed_in_scan() -> BuiltProgram:
                         Manifest(collectives=_MINI_COLLECTIVES))
 
 
+def _build_wide_narrow_wire() -> BuiltProgram:
+    """Defect (ISSUE 15): the manifest DECLARES a bf16 narrow wire
+    (``required_dtypes={"bf16"}``) but the program never materializes a
+    bf16 tensor — the silently-f32 "narrow" program shape: a dropped or
+    dead-code-eliminated quantize ships the wide wire under a narrow
+    name, which only the required-dtypes half of the dtype rule can
+    see (all element types are individually allowed)."""
+    import jax
+    import jax.numpy as jnp
+
+    mesh = _mini_mesh()
+
+    def f(state, x):
+        w, step = state
+        g = _psum_grads(mesh)(x).sum(0)  # all-f32: the quantize is "gone"
+        return (w - 0.01 * g, step + 1), jnp.sum(w)
+
+    with mesh:
+        fn = jax.jit(f, donate_argnums=(0,))
+    from draco_tpu.analysis.registry import BF16_DTYPES
+
+    return BuiltProgram("control_wide_narrow_wire", fn,
+                        (_mini_state(mesh), _mini_batch(mesh)), mesh,
+                        Manifest(collectives=_MINI_COLLECTIVES,
+                                 allowed_dtypes=BF16_DTYPES,
+                                 required_dtypes=frozenset({"bf16"})))
+
+
 def _build_memory_hog() -> BuiltProgram:
     """Defect: a working set far beyond the manifest's declared peak-memory
     budget — a runtime (1024, 1024) matrix product whose operands and
@@ -245,6 +273,8 @@ def control_programs() -> Tuple[Control, ...]:
         Control(mk("control_undonated_carry", _build_undonated_carry),
                 "donation"),
         Control(mk("control_f64_upcast", _build_f64_upcast), "dtype"),
+        Control(mk("control_wide_narrow_wire", _build_wide_narrow_wire),
+                "dtype"),
         Control(mk("control_extra_all_gather", _build_extra_all_gather),
                 "collectives"),
         Control(mk("control_host_outfeed_in_scan",

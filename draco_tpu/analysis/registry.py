@@ -90,6 +90,14 @@ class Manifest:
     require_donated: Any = "state"  # int | "state" | None
     allowed_dtypes: frozenset = DEFAULT_DTYPES
     bf16_promotion_whitelist: Tuple[str, ...] = ("convert_element_type",)
+    # Element types that MUST appear in the exported module (ISSUE 15):
+    # a narrow-wire production program declares its wire dtype here
+    # ({"bf16"} / {"i8"}), so a "narrow" registration whose module is
+    # silently all-f32 (the quantize got dropped, dead-code-eliminated,
+    # or the config stopped reaching the step body) trips the dtype rule
+    # instead of shipping a wide wire under a narrow name. Empty = no
+    # requirement (every pre-ISSUE-15 manifest).
+    required_dtypes: frozenset = frozenset()
     collectives: Optional[dict] = None
     host_transfer_budget: int = 0
     max_peak_bytes: Optional[int] = 2 << 30  # memory_budget rule cap

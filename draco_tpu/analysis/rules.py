@@ -340,6 +340,18 @@ def rule_dtype(art: Artifacts) -> dict:
         return {"ok": False, **res,
                 "error": f"element types {sorted(extra)} not in the "
                          f"manifest's allowed set {sorted(m.allowed_dtypes)}"}
+    missing_req = m.required_dtypes - types
+    if missing_req:
+        # the narrow-wire contract (ISSUE 15): a manifest that declares a
+        # narrow wire dtype REQUIRES it in the module — a silently-f32
+        # "narrow" program means the quantize was dropped or DCE'd and
+        # the wire is wide again under a narrow name
+        return {"ok": False, **res,
+                "error": f"manifest requires element types "
+                         f"{sorted(m.required_dtypes)} in the module but "
+                         f"{sorted(missing_req)} never appear — a "
+                         f"narrow-wire program whose wire is silently f32 "
+                         f"(dropped/dead-code-eliminated quantize?)"}
     return {"ok": True, **res}
 
 

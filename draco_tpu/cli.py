@@ -202,6 +202,19 @@ def add_fit_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                         "the decoded aggregate — riding the (K, m) metric "
                         "block at zero extra device fetches (coded "
                         "approaches only)")
+    p.add_argument("--wire-dtype", type=str, default="f32",
+                   choices=["f32", "bf16", "int8"],
+                   help="the REAL worker→aggregator wire dtype (ISSUE 15): "
+                        "f32 keeps today's wire bit-for-bit; bf16/int8 "
+                        "round the codewords into real narrow buffers "
+                        "(int8 with per-block scales over --shadow-block "
+                        "elements; --shadow-round stochastic = shared-draw "
+                        "stochastic rounding) that cross the sharding "
+                        "boundary narrow and widen to f32 only inside the "
+                        "decode — 2–4× wire bytes/HBM (PERF.md §17). The "
+                        "cyclic decode runs the quantization-aware flag "
+                        "threshold + Tikhonov-regularized locator; coded "
+                        "approaches only, exclusive with --shadow-wire")
     p.add_argument("--shadow-wire", type=str, default="off",
                    choices=["off", "bf16", "int8"],
                    help="shadow-quantized coded wire: round the codewords "
@@ -374,6 +387,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         compile_guard=args.compile_guard,
         compile_warmup=args.compile_warmup,
         numerics_watch=args.numerics_watch,
+        wire_dtype=args.wire_dtype,
         shadow_wire=args.shadow_wire,
         shadow_round=args.shadow_round,
         shadow_block=args.shadow_block,

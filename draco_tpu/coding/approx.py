@@ -184,7 +184,7 @@ def recovered_fraction(code: ApproxCode,
 def decode(code: ApproxCode, rows: jnp.ndarray,
            present: Optional[jnp.ndarray] = None,
            with_health: bool = False, batch_grads: Optional[jnp.ndarray] = None,
-           impl: str = "xla"):
+           impl: str = "xla", wire=None):
     """Partial-recovery decode: (n, d) received rows -> (d,) mean gradient.
 
     ``rows``: per-worker weighted partial sums; absent rows (``present``
@@ -215,7 +215,7 @@ def decode(code: ApproxCode, rows: jnp.ndarray,
     """
     if impl != "xla":
         return _decode_fused(code, rows, present, with_health, batch_grads,
-                             impl)
+                             impl, wire=wire)
     v, u, bound = decode_weights(code, present)
     if present is not None:
         # true zero-fill, not multiplicative masking: a NaN payload in an
@@ -243,13 +243,17 @@ def decode(code: ApproxCode, rows: jnp.ndarray,
 
 
 def _decode_fused(code: ApproxCode, rows, present, with_health, batch_grads,
-                  impl: str):
+                  impl: str, wire=None):
     """The fused decode (``decode`` docstring, impl != "xla"): the SAME
     weight solve as the xla path (decode_weights — a bitwise-shared
     prologue op), then the O(n·d) work either as the restructured XLA
     sweep ("fused" — the CPU fallback) or the Pallas kernel
     ("pallas"/"pallas_interpret"). Health semantics identical to the xla
-    path; only accumulation order differs."""
+    path; only accumulation order differs. ``wire`` (ISSUE 15): the REAL
+    narrow wire buffers ``(mode, buf, block)`` — on the kernel path they
+    are ingested narrow and dequantized in-tile
+    (ops/decode_kernels.approx_decode), so the widened f32 wire matrix
+    never exists in HBM; the XLA paths consume the pre-widened ``rows``."""
     n = code.n
     v, u, bound = decode_weights(code, present)
     pres_b = (jnp.ones((n,), bool) if present is None
@@ -264,9 +268,11 @@ def _decode_fused(code: ApproxCode, rows, present, with_health, batch_grads,
     if impl in ("pallas", "pallas_interpret"):
         from draco_tpu.ops import decode_kernels
 
+        if not decode_kernels.narrow_kernel_ok(wire):
+            wire = None
         decoded, sq_diff, sq_g = decode_kernels.approx_decode(
             rows, batch_grads, v, pres_b,
-            interpret=(impl == "pallas_interpret"))
+            interpret=(impl == "pallas_interpret"), wire=wire)
     else:
         rows_m = jnp.where(pres_b[:, None], rows, jnp.zeros_like(rows))
         decoded = jnp.matmul(v / n, rows_m)

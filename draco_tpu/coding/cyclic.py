@@ -62,6 +62,26 @@ LOCATOR_RCOND = 1e-5
 # ADVERSARY=-100) — five orders of margin either side.
 HEALTH_REL_TOL = 1e-3
 
+# Golden-ratio Weyl constant for the λ-regularized locator's honest-subset
+# bias (ISSUE 15): rows ranked by frac(r·φ) form a maximally-spread subset
+# (three-distance theorem), whose DFT extrapolation amplification is O(1)
+# (measured 2–9× across study shapes) where the index-contiguous first
+# n−2s rows amplify ~4e4× at n=32 — the mechanism behind the PR 10
+# quant-noise blowup: with no live adversary the locator magnitudes are
+# noise, the chosen subset is noise-driven (or contiguous under the index
+# bias), and the exact codeword fit extrapolates the excluded rows with
+# that amplification. The spread bias only engages on the λ path; the
+# exact λ=0 decode keeps the historical index bias bit-for-bit.
+SPREAD_PHI = 0.6180339887498949
+
+
+def _spread_rank(n: int) -> np.ndarray:
+    """Host-side (n,) f32 spread ranks: rank of frac(r·φ) — the λ-path
+    tie-break ordering (SPREAD_PHI docstring)."""
+    key = (np.arange(n) * SPREAD_PHI) % 1.0
+    return np.argsort(np.argsort(key)).astype(np.float32)
+
+
 # Loud-row forensics threshold (relative ENERGY vs the median present row):
 # a present row whose projected energy exceeds LOUD_REL_TOL × the median is
 # "loud". A forensic-only accusation signal (obs/forensics.py) — it feeds
@@ -227,8 +247,17 @@ _complex_solve = linalg_mod.complex_solve
 
 def _locate_v(code: CyclicCode, e_re: jnp.ndarray, e_im: jnp.ndarray,
               present: Optional[jnp.ndarray] = None,
-              rel_tol: float = HEALTH_REL_TOL):
+              rel_tol: float = HEALTH_REL_TOL, lam: float = 0.0):
     """Locator + recombination vector from one projected column e (n,).
+
+    ``lam`` (ISSUE 15): Tikhonov λ for the LOCATOR solve only — the Hankel
+    system is the one that goes rank-deficient with fewer than s corrupt
+    rows and amplifies a narrow wire's quantization noise
+    (obs/numerics.WIRE_LOCATOR_LAMBDA scales λ to the dtype's noise floor
+    on the scale-normalized system). The recombination and health-fit
+    solves stay exact: their honest-row DFT submatrices are full-rank by
+    construction. λ=0 (every f32-wire caller) is bitwise the historical
+    path.
 
     Steps 2–5 of the decode: syndrome → error-locator solve → honest-row
     top-k → recombination vector v with vᵀC1 = e1ᵀ supported on those rows.
@@ -264,6 +293,13 @@ def _locate_v(code: CyclicCode, e_re: jnp.ndarray, e_im: jnp.ndarray,
     c2h_re = jnp.asarray(code.c2h_re)
     c2h_im = jnp.asarray(code.c2h_im)
 
+    # presence + received-energy statistics (the λ path's signal scale and
+    # the health normalisation both read these)
+    pres_f = (jnp.ones((n,), jnp.float32) if present is None
+              else present.astype(jnp.float32))
+    energy = e_re**2 + e_im**2
+    msq = jnp.sum(energy * pres_f) / jnp.maximum(jnp.sum(pres_f), 1.0)
+
     # 2. syndrome E2 = C2^H e, shape (2s,)
     e2_re = jnp.matmul(c2h_re, e_re, precision=PREC) - jnp.matmul(c2h_im, e_im, precision=PREC)
     e2_im = jnp.matmul(c2h_re, e_im, precision=PREC) + jnp.matmul(c2h_im, e_re, precision=PREC)
@@ -284,10 +320,19 @@ def _locate_v(code: CyclicCode, e_re: jnp.ndarray, e_im: jnp.ndarray,
         # keeps the solve NaN-free there while staying exact (f32 exact) on
         # full-rank systems, so corrupt-row locator magnitudes sit ~1e-5 vs
         # honest ~1.
-        scale = jnp.maximum(jnp.max(e2_re**2 + e2_im**2) ** 0.5, 1e-30)
+        syn = jnp.maximum(jnp.max(e2_re**2 + e2_im**2) ** 0.5, 1e-30)
+        if lam == 0.0:
+            scale = syn
+        else:
+            # λ path (ISSUE 15): normalise by the SIGNAL scale (present-row
+            # RMS of e) instead of the syndrome's own magnitude. A pure-
+            # quantization syndrome is then ~the dtype noise floor λ is
+            # calibrated to — self-normalisation would blow it up to O(1)
+            # and hand the solve pure noise, the PR 10 amplification.
+            scale = jnp.maximum(jnp.sqrt(msq), 1e-30)
         alpha_re, alpha_im = _complex_solve(
             a_re / scale, a_im / scale, b_re / scale, b_im / scale,
-            rcond=LOCATOR_RCOND,
+            rcond=LOCATOR_RCOND, lam=lam,
         )
 
         # 4. locator polynomial p(z) = z^s - Σ α_j z^j, roots at corrupt rows
@@ -299,6 +344,22 @@ def _locate_v(code: CyclicCode, e_re: jnp.ndarray, e_im: jnp.ndarray,
         val_re = jnp.matmul(est_re, poly_re, precision=PREC) - jnp.matmul(est_im, poly_im, precision=PREC)
         val_im = jnp.matmul(est_re, poly_im, precision=PREC) + jnp.matmul(est_im, poly_re, precision=PREC)
         mag = val_re**2 + val_im**2
+        if lam > 0.0:
+            # syndrome significance gate (branchless): a syndrome at the
+            # quantization noise floor certifies NO corruption — the
+            # locator output is pure amplified noise there, so the row
+            # magnitudes collapse to uniform and the spread bias below
+            # picks the deterministic well-conditioned subset. A real
+            # corruption (O(100×) payloads) puts the relative syndrome
+            # orders of magnitude above λ and the gate is transparent.
+            # gate at 2λ: the gate must clear the dtype's measured
+            # noise-floor maximum with margin, while the SOLVE cutoff
+            # (σ ≤ λ dropped, coding/linalg) must not eat the genuine
+            # locator directions — one λ cannot serve both (measured:
+            # int8 at n=32 s=3 mislocates live adversaries when the
+            # cutoff rides at the gate's 2^-5, locates exactly at 2^-6)
+            live = (syn / scale) > 2.0 * lam
+            mag = jnp.where(live, mag, jnp.ones_like(mag))
     else:
         mag = jnp.ones((n,), jnp.float32)
 
@@ -308,8 +369,12 @@ def _locate_v(code: CyclicCode, e_re: jnp.ndarray, e_im: jnp.ndarray,
     # different (all equally valid) honest sets. An index-monotone bias far
     # above float noise (~1e-7·mean) and far below any honest magnitude
     # (≳5e-2·mean) pins the choice, identically in the jit and native
-    # decoders (native/coding.cpp draco_cyclic_decode).
-    mag = mag + jnp.arange(n, dtype=mag.dtype) * ((1e-3 / n) * jnp.mean(mag))
+    # decoders (native/coding.cpp draco_cyclic_decode). The λ path biases
+    # by SPREAD rank instead (SPREAD_PHI docstring): the subset it pins in
+    # the gated no-corruption state extrapolates at O(1) amplification.
+    order = (jnp.arange(n, dtype=mag.dtype) if lam == 0.0
+             else jnp.asarray(_spread_rank(n)))
+    mag = mag + order * ((1e-3 / n) * jnp.mean(mag))
 
     # 5. recombination vector v supported on n-2s located-honest rows,
     #    v^T C1[idx] = e1^T  (fixed-shape stand-in for the reference's
@@ -337,8 +402,8 @@ def _locate_v(code: CyclicCode, e_re: jnp.ndarray, e_im: jnp.ndarray,
     v_full_im = jnp.zeros((n,), rec_re.dtype).at[idx].set(v_im)
 
     # ---- decode health (docstring above): codeword fit + per-row deviation
-    pres_f = (jnp.ones((n,), jnp.float32) if present is None
-              else present.astype(jnp.float32))
+    # (pres_f / energy / msq computed at the top alongside the λ path's
+    # signal scale)
     q_re, q_im = _complex_solve(rec_re, rec_im, e_re[idx], e_im[idx])
     c1_re = jnp.asarray(code.c1_re)
     c1_im = jnp.asarray(code.c1_im)
@@ -347,8 +412,6 @@ def _locate_v(code: CyclicCode, e_re: jnp.ndarray, e_im: jnp.ndarray,
     fit_im = jnp.matmul(c1_re, q_im, precision=PREC) + jnp.matmul(
         c1_im, q_re, precision=PREC)
     dev = (e_re - fit_re) ** 2 + (e_im - fit_im) ** 2  # (n,) |e - C1 q̂|²
-    energy = e_re**2 + e_im**2
-    msq = jnp.sum(energy * pres_f) / jnp.maximum(jnp.sum(pres_f), 1.0)
     flagged = (dev > (rel_tol**2) * msq) & (pres_f > 0)
     resid_sq = jnp.sum(jnp.where(flagged, 0.0, dev) * pres_f) / jnp.maximum(
         jnp.sum(energy * pres_f), 1e-30)
@@ -362,12 +425,18 @@ def _locate_v(code: CyclicCode, e_re: jnp.ndarray, e_im: jnp.ndarray,
     med = jnp.nanmedian(jnp.where(pres_f > 0, energy, jnp.nan))
     loud = (energy > LOUD_REL_TOL * med) & (pres_f > 0)
     health = {"residual": jnp.sqrt(resid_sq), "flagged": flagged,
-              "loud": loud}
+              "loud": loud,
+              # per-row relative deviation sqrt(dev/msq) — the quantity
+              # rel_tol thresholds. Not a metric column: tools/wire_study
+              # reads it to DERIVE the per-(n, s, dtype) narrow-wire
+              # threshold table (honest-max vs adversary-min margins)
+              "dev_rel": jnp.sqrt(dev / jnp.maximum(msq, 1e-30))}
     return v_full_re, v_full_im, honest, health
 
 
 def locator_core(e_re, e_im, c2h_re, c2h_im, c1_re, c1_im, est_re, est_im,
-                 pres_f, s: int, rel_tol: float = HEALTH_REL_TOL):
+                 pres_f, s: int, rel_tol: float = HEALTH_REL_TOL,
+                 lam: float = 0.0):
     """Steps 2–5 of the decode + health, batched over projected columns —
     the fused counterpart of :func:`_locate_v` (ISSUE 12 tentpole).
 
@@ -398,6 +467,11 @@ def locator_core(e_re, e_im, c2h_re, c2h_im, c1_re, c1_im, est_re, est_im,
     bb, n = e_re.shape
     m = n - 2 * s
     pres_f = jnp.broadcast_to(pres_f, (bb, n))
+    # presence-weighted received energy (the λ path's signal scale and the
+    # health normalisation below)
+    energy = e_re ** 2 + e_im ** 2
+    msq = (jnp.sum(energy * pres_f, axis=1)
+           / jnp.maximum(jnp.sum(pres_f, axis=1), 1.0))[:, None]
 
     if s > 0:
         # 2. syndrome (bb, 2s): one complex matmul pair
@@ -415,15 +489,22 @@ def locator_core(e_re, e_im, c2h_re, c2h_im, c1_re, c1_im, est_re, est_im,
             [e2_re[:, 2 * s - 1 - i:2 * s - i] for i in range(s)], axis=1)
         b_im = jnp.concatenate(
             [e2_im[:, 2 * s - 1 - i:2 * s - i] for i in range(s)], axis=1)
-        # same scale-free normalisation as _locate_v
-        scale = jnp.sqrt(jnp.maximum(
+        # same scale-free normalisation as _locate_v; the λ path divides
+        # by the SIGNAL scale instead and gates on syndrome significance
+        # (_locate_v's λ-branch comments — identical semantics here)
+        syn = jnp.sqrt(jnp.maximum(
             jnp.max(e2_re ** 2 + e2_im ** 2, axis=1), 1e-60))[:, None]
+        if lam == 0.0:
+            scale = syn
+        else:
+            scale = jnp.maximum(jnp.sqrt(msq), 1e-30)
         big = jnp.concatenate([
             jnp.concatenate([a_re, -a_im], axis=2),
             jnp.concatenate([a_im, a_re], axis=2),
         ], axis=1) / scale[:, :, None]
         rhs = jnp.concatenate([b_re, b_im], axis=1) / scale
-        al = linalg_mod.jacobi_lstsq(big, rhs, LOCATOR_RCOND)  # (bb, 2s)
+        al = linalg_mod.jacobi_lstsq(big, rhs, LOCATOR_RCOND,
+                                     lam=lam)  # (bb, 2s)
         alpha_re, alpha_im = al[:, :s], al[:, s:]
         # 4. locator polynomial evaluated on the DFT grid
         poly_re = jnp.concatenate(
@@ -435,11 +516,25 @@ def locator_core(e_re, e_im, c2h_re, c2h_im, c1_re, c1_im, est_re, est_im,
         val_im = (jnp.matmul(poly_re, est_im.T, precision=PREC)
                   + jnp.matmul(poly_im, est_re.T, precision=PREC))
         mag = val_re ** 2 + val_im ** 2
+        if lam > 0.0:
+            # syndrome significance gate at 2λ (_locate_v λ-branch comment)
+            live = (syn / scale) > 2.0 * lam  # (bb, 1)
+            mag = jnp.where(live, mag, jnp.ones_like(mag))
     else:
         mag = jnp.ones((bb, n), jnp.float32)
 
-    # deterministic tie-break (see _locate_v) + absent rows never eligible
-    bias = jax.lax.broadcasted_iota(jnp.float32, (bb, n), 1)
+    # deterministic tie-break (see _locate_v) + absent rows never eligible;
+    # the λ path biases by SPREAD rank (SPREAD_PHI) — computed from iota
+    # pairwise comparisons, no host constant (Mosaic kernel body)
+    if lam == 0.0:
+        bias = jax.lax.broadcasted_iota(jnp.float32, (bb, n), 1)
+    else:
+        ki = jax.lax.broadcasted_iota(jnp.float32, (n, n), 0) * SPREAD_PHI
+        kj = jax.lax.broadcasted_iota(jnp.float32, (n, n), 1) * SPREAD_PHI
+        ki = ki - jnp.floor(ki)
+        kj = kj - jnp.floor(kj)
+        rank = jnp.sum((kj < ki).astype(jnp.float32), axis=1)  # (n,)
+        bias = jnp.broadcast_to(rank[None, :], (bb, n))
     mag = mag + bias * ((1e-3 / n) * jnp.mean(mag, axis=1, keepdims=True))
     mag = jnp.where(pres_f > 0, mag, -1.0)
 
@@ -471,9 +566,7 @@ def locator_core(e_re, e_im, c2h_re, c2h_im, c1_re, c1_im, est_re, est_im,
     fit_im = (jnp.matmul(q_re, c1_im.T, precision=PREC)
               + jnp.matmul(q_im, c1_re.T, precision=PREC))
     dev = (e_re - fit_re) ** 2 + (e_im - fit_im) ** 2
-    energy = e_re ** 2 + e_im ** 2
-    msq = (jnp.sum(energy * pres_f, axis=1)
-           / jnp.maximum(jnp.sum(pres_f, axis=1), 1.0))[:, None]
+    # energy / msq computed at the top (the λ path's signal scale)
     flagged = (dev > (rel_tol ** 2) * msq) & (pres_f > 0)
     resid_sq = (jnp.sum(jnp.where(flagged, 0.0, dev) * pres_f, axis=1)
                 / jnp.maximum(jnp.sum(energy * pres_f, axis=1), 1e-30))
@@ -486,7 +579,7 @@ def locator_core(e_re, e_im, c2h_re, c2h_im, c1_re, c1_im, est_re, est_im,
 
 
 def _run_locator(code: CyclicCode, e_re_l, e_im_l, present, rel_tol,
-                 impl: str):
+                 impl: str, lam: float = 0.0):
     """Dispatch the batched locator: ``fused`` = :func:`locator_core`
     lowered through XLA (the decode_impl="pallas" CPU fallback),
     ``pallas``/``pallas_interpret`` = the hand-tiled kernel
@@ -500,18 +593,19 @@ def _run_locator(code: CyclicCode, e_re_l, e_im_l, present, rel_tol,
 
         return decode_kernels.cyclic_locator(
             code, e_re_l, e_im_l, pres_f, rel_tol,
-            interpret=(impl == "pallas_interpret"))
+            interpret=(impl == "pallas_interpret"), lam=lam)
     return locator_core(
         e_re_l, e_im_l,
         jnp.asarray(code.c2h_re), jnp.asarray(code.c2h_im),
         jnp.asarray(code.c1_re), jnp.asarray(code.c1_im),
         jnp.asarray(code.est_re), jnp.asarray(code.est_im),
-        pres_f, code.s, rel_tol)
+        pres_f, code.s, rel_tol, lam=lam)
 
 
 def decode(code: CyclicCode, r_re: jnp.ndarray, r_im: jnp.ndarray, rand_factor: jnp.ndarray,
            present: Optional[jnp.ndarray] = None, with_health: bool = False,
-           rel_tol: float = HEALTH_REL_TOL, impl: str = "xla"):
+           rel_tol: float = HEALTH_REL_TOL, impl: str = "xla",
+           lam: float = 0.0, wire=None):
     """Recover the exact sum of the n batch gradients from corrupt rows.
 
     r_re, r_im: (n, d) received encoded rows (≤ s rows arbitrarily corrupt).
@@ -550,18 +644,31 @@ def decode(code: CyclicCode, r_re: jnp.ndarray, r_im: jnp.ndarray, rand_factor: 
     e_re, e_im = ops_coded.complex_project(r_re, r_im, rand_factor)
     if impl == "xla":
         v_full_re, v_full_im, honest, health = _locate_v(code, e_re, e_im,
-                                                         present, rel_tol)
+                                                         present, rel_tol,
+                                                         lam=lam)
         # 6. recombine: Re(v^T R) / n — the second O(n·d) pass, fused
         decoded = ops_coded.complex_recombine(v_full_re, v_full_im,
                                               r_re, r_im) / n
     else:
         v_re, v_im, honest_l, flagged_l, loud_l, resid_l = _run_locator(
-            code, e_re[None, :], e_im[None, :], present, rel_tol, impl)
+            code, e_re[None, :], e_im[None, :], present, rel_tol, impl,
+            lam=lam)
         honest = honest_l[0]
         health = {"residual": resid_l[0], "flagged": flagged_l[0],
                   "loud": loud_l[0]}
-        decoded = ops_coded.complex_recombine(v_re[0] / n, v_im[0] / n,
-                                              r_re, r_im)
+        from draco_tpu.ops import decode_kernels
+
+        if (impl in ("pallas", "pallas_interpret")
+                and decode_kernels.narrow_kernel_ok(wire)):
+            # narrow-ingest recombination (ISSUE 15): the kernel streams
+            # the REAL narrow wire buffers and dequantizes in-tile — the
+            # widened f32 (n, d) matrix never round-trips HBM
+            decoded = decode_kernels.cyclic_narrow_recombine(
+                v_re[0] / n, v_im[0] / n, wire,
+                interpret=(impl == "pallas_interpret"))
+        else:
+            decoded = ops_coded.complex_recombine(v_re[0] / n, v_im[0] / n,
+                                                  r_re, r_im)
     if with_health:
         return decoded, honest, health
     return decoded, honest
@@ -591,7 +698,8 @@ def decode_layers(code: CyclicCode, r_re: jnp.ndarray, r_im: jnp.ndarray,
                   rand_factor: jnp.ndarray, offsets,
                   present: Optional[jnp.ndarray] = None,
                   with_health: bool = False,
-                  rel_tol: float = HEALTH_REL_TOL, impl: str = "xla"):
+                  rel_tol: float = HEALTH_REL_TOL, impl: str = "xla",
+                  lam: float = 0.0, wire=None):
     """Layer-granularity decode — one locator per parameter tensor.
 
     The reference decodes each layer independently with its own random
@@ -618,7 +726,14 @@ def decode_layers(code: CyclicCode, r_re: jnp.ndarray, r_im: jnp.ndarray,
     a hand-tiled Pallas grid on TPU, one XLA program on CPU — instead of
     L vmapped solver chains, and the per-layer recombination is re-tiled
     per worker count (:func:`_recombine_layers_fused`).
+
+    ``wire`` (ISSUE 15) is accepted for signature parity with
+    :func:`decode` but the layer-granularity recombination keeps the
+    widened f32 rows: the per-layer segment boundaries do not align with
+    the narrow wire's per-block scale tiling, so the in-tile dequant
+    kernel applies to the GLOBAL decode only (PERF.md §17).
     """
+    del wire
     n = code.n
     bounds = [int(o) for o in offsets]
     e_res, e_ims = [], []
@@ -632,7 +747,7 @@ def decode_layers(code: CyclicCode, r_re: jnp.ndarray, r_im: jnp.ndarray,
     e_im_l = jnp.stack(e_ims)
     if impl == "xla":
         v_re_l, v_im_l, honest_l, health_l = jax.vmap(
-            lambda er, ei: _locate_v(code, er, ei, present, rel_tol)
+            lambda er, ei: _locate_v(code, er, ei, present, rel_tol, lam)
         )(e_re_l, e_im_l)
         parts = [
             ops_coded.complex_recombine(v_re_l[i], v_im_l[i], r_re[:, a:b], r_im[:, a:b])
@@ -642,11 +757,12 @@ def decode_layers(code: CyclicCode, r_re: jnp.ndarray, r_im: jnp.ndarray,
         if with_health:
             health = {"residual": jnp.max(health_l["residual"]),
                       "flagged": jnp.any(health_l["flagged"], axis=0),
-                      "loud": jnp.any(health_l["loud"], axis=0)}
+                      "loud": jnp.any(health_l["loud"], axis=0),
+                      "dev_rel": jnp.max(health_l["dev_rel"], axis=0)}
             return decoded, honest_l, health
         return decoded, honest_l
     v_re_l, v_im_l, honest_l, flagged_l, loud_l, resid_l = _run_locator(
-        code, e_re_l, e_im_l, present, rel_tol, impl)
+        code, e_re_l, e_im_l, present, rel_tol, impl, lam=lam)
     decoded = _recombine_layers_fused(n, v_re_l / n, v_im_l / n, bounds,
                                       r_re, r_im)
     if with_health:

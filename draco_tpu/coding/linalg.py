@@ -63,7 +63,8 @@ import jax.numpy as jnp
 # ---------------------------------------------------------------------------
 
 
-def truncated_lstsq(a: jnp.ndarray, b: jnp.ndarray, rcond: float):
+def truncated_lstsq(a: jnp.ndarray, b: jnp.ndarray, rcond: float,
+                    lam: float = 0.0):
     """rcond-truncated SVD least squares (singular values below
     ``rcond·σmax`` zeroed), the shared primitive of the cyclic locator
     solve (via :func:`complex_solve`) and the approx family's where-masked
@@ -71,12 +72,36 @@ def truncated_lstsq(a: jnp.ndarray, b: jnp.ndarray, rcond: float):
     ridge, truncation leaves full-rank systems f32-exact while keeping
     genuinely rank-deficient ones NaN-free — both call sites depend on
     exactly that (cyclic's < s-corrupt locator, approx's whole-cluster
-    absences)."""
-    x, _, _, _ = jnp.linalg.lstsq(a, b, rcond=rcond)
-    return x
+    absences).
+
+    ``lam`` > 0 (ISSUE 15) switches to the noise-floor-regularized solve:
+    singular directions with σ ≤ λ are dropped OUTRIGHT on top of the
+    relative rcond cutoff (keep σ > max(rcond·σmax, λ)). On the
+    signal-scale-normalized locator system a direction at or below λ
+    carries only quantization noise — the relative rcond alone keeps it
+    whenever σmax is large (a live adversary), which is the PR 10
+    finding: the cyclic locator amplifies bf16/int8 rounding past any
+    usable flag threshold at n=32 s=3. λ is the hard-truncation limit of
+    the Tikhonov family — ridge-DAMPING the kept directions
+    (σ/(σ²+λ²)) was measured to distort the locator polynomial enough
+    to mislocate live adversaries at int8's noise floor (the σ ≈ λ
+    boundary pays up to 50% coefficient shrinkage; PERF.md §17), so
+    kept directions solve exactly. ``lam == 0.0`` takes the historical
+    path bit-for-bit (a static python branch — the compiled program is
+    unchanged)."""
+    if lam == 0.0:
+        x, _, _, _ = jnp.linalg.lstsq(a, b, rcond=rcond)
+        return x
+    u, s, vt = jnp.linalg.svd(a, full_matrices=False)
+    smax = jnp.max(s)
+    keep = s > jnp.maximum(rcond * smax, lam)
+    utb = jnp.matmul(u.T, b)
+    coef = jnp.where(keep, 1.0 / jnp.maximum(s, lam * 1e-6), 0.0)
+    return jnp.matmul(vt.T, coef * utb)
 
 
-def complex_solve(a_re, a_im, b_re, b_im, rcond: float = 0.0):
+def complex_solve(a_re, a_im, b_re, b_im, rcond: float = 0.0,
+                  lam: float = 0.0):
     """Solve complex A x = b via the real 2m×2m block embedding.
 
     [[Ar, -Ai], [Ai, Ar]] [xr; xi] = [br; bi]. LU-based jnp.linalg.solve is
@@ -88,7 +113,11 @@ def complex_solve(a_re, a_im, b_re, b_im, rcond: float = 0.0):
     than s rows are actually corrupt; the reference used an SVD
     least-squares there for the same reason (c_coding.cpp:81). SVD on the
     embedded system (not its gram) keeps the threshold meaningful in f32:
-    the gram squares the condition number.
+    the gram squares the condition number. ``lam`` > 0 additionally drops
+    singular directions at or below the noise floor λ OUTRIGHT — kept
+    directions still solve exactly, deliberately NOT ridge-damped
+    (narrow-wire locator solves, truncated_lstsq docstring); λ=0 is the
+    historical path bit-for-bit.
 
     (Moved verbatim from ``coding/cyclic._complex_solve`` — the XLA decode
     path must stay bitwise.)
@@ -99,7 +128,7 @@ def complex_solve(a_re, a_im, b_re, b_im, rcond: float = 0.0):
     big = jnp.concatenate([top, bot], axis=0)
     rhs = jnp.concatenate([b_re, b_im], axis=0)
     if rcond > 0.0:
-        x = truncated_lstsq(big, rhs, rcond)
+        x = truncated_lstsq(big, rhs, rcond, lam=lam)
     else:
         x = jnp.linalg.solve(big, rhs)
     return x[:m], x[m:]
@@ -137,13 +166,18 @@ def _set_col(a, j, new):
 
 
 def jacobi_lstsq(a: jnp.ndarray, b: jnp.ndarray, rcond: float,
-                 sweeps: int = JACOBI_SWEEPS):
+                 sweeps: int = JACOBI_SWEEPS, lam: float = 0.0):
     """Truncated least squares ``min ‖A x − b‖`` via one-sided Jacobi SVD.
 
     a: (bb, m, m) real, b: (bb, m) — returns x (bb, m) with singular
     directions below ``rcond·σmax`` dropped, the fused-tier counterpart of
     :func:`truncated_lstsq` (same cutoff semantics; σ come out of the
     rotations at high relative accuracy because the gram is never formed).
+    ``lam`` > 0 drops directions with σ ≤ λ outright, exactly like the
+    XLA tier (truncated_lstsq's noise-floor cutoff — keep
+    σ² > max((rcond·σmax)², λ²)); kept directions solve exactly. λ=0
+    keeps the historical expression bit-for-bit via a static python
+    branch.
 
     One-sided Jacobi: rotate column pairs of A (accumulating the rotations
     in V) until columns are mutually orthogonal — then A·V = W with
@@ -196,6 +230,8 @@ def jacobi_lstsq(a: jnp.ndarray, b: jnp.ndarray, rcond: float,
     sig2max = jnp.max(sig2, axis=1, keepdims=True)
     keep = sig2 > (rcond * rcond) * sig2max
     wtb = jnp.sum(w * b[:, :, None], axis=1)  # (bb, m) = Wᵀ b
+    if lam > 0.0:
+        keep = keep & (sig2 > lam * lam)
     coef = jnp.where(keep, wtb / jnp.maximum(sig2, _TINY), 0.0)
     return jnp.sum(v * coef[:, None, :], axis=2)  # V @ coef
 

@@ -241,6 +241,25 @@ class TrainConfig:
     # zero retraces. Coded approaches only (cyclic / maj_vote / approx):
     # the baseline path ships no codewords and emits no optional columns.
     numerics_watch: str = "off"
+    # --- the REAL narrow coded wire (obs/numerics.py; ISSUE 15) ---
+    # What the worker→aggregator wire PHYSICALLY carries. "f32" keeps
+    # today's wire bit-for-bit (no ops added). "bf16"/"int8": the step
+    # body rounds the codewords into REAL narrow buffers (bf16 casts;
+    # int8 with per-block scales over shadow_block elements and — under
+    # shadow_round="stochastic" — shared-draw stochastic rounding) which
+    # cross the worker-sharding boundary narrow and are widened to f32
+    # only inside the decode (f32 accumulation throughout): the 2–4×
+    # wire-bytes/HBM win of PERF.md §13's ledger, landed on the actual
+    # coded path. The cyclic decode then runs the quantization-aware flag
+    # threshold (per-(n, s, dtype) table derived by tools/wire_study.py)
+    # and the Tikhonov-regularized locator (λ scaled to the dtype's noise
+    # floor — the PR 10 large-n blocker's fix); the step guard and the
+    # decode_residual incident detector widen their tolerances by the
+    # dtype's residual slack. Coded approaches only; mutually exclusive
+    # with shadow_wire (the shadow is the CALIBRATION mode — it measures
+    # a candidate dtype against the f32 wire, which a narrow wire no
+    # longer ships).
+    wire_dtype: str = "f32"  # f32 | bf16 | int8
     # Shadow-quantized wire (obs/numerics.py): round the codewords to the
     # narrow dtype INSIDE the step body, decode the shadow copy alongside
     # the f32 path, and emit shadow_err / shadow_residual /
@@ -502,6 +521,44 @@ class TrainConfig:
             raise ValueError(
                 f"shadow_wire must be off|bf16|int8, got {self.shadow_wire!r}"
             )
+        if self.wire_dtype not in ("f32", "bf16", "int8"):
+            raise ValueError(
+                f"wire_dtype must be f32|bf16|int8, got {self.wire_dtype!r}"
+            )
+        if self.wire_dtype != "f32":
+            if self.approach not in ("cyclic", "maj_vote", "approx"):
+                # the narrow wire quantizes the CODED wire; the baseline
+                # path ships raw rows to approximate robust rules with no
+                # certificate to re-threshold — same rule as the shadow
+                raise ValueError(
+                    "wire_dtype != f32 requires a coded approach "
+                    f"(cyclic|maj_vote|approx), got {self.approach!r}"
+                )
+            if self.shadow_wire != "off":
+                raise ValueError(
+                    "wire_dtype and shadow_wire are mutually exclusive: "
+                    "the shadow is the calibration mode — it measures a "
+                    "candidate dtype AGAINST the f32 wire, which a narrow "
+                    "wire no longer ships (set shadow_wire=off, or keep "
+                    "wire_dtype=f32 while calibrating)"
+                )
+            if self.approach == "cyclic":
+                # shapes whose certificate still degrades under the
+                # regularized locator must route through the approx family
+                # (arXiv:1802.03475's communication-efficient coding) —
+                # the committed threshold table is the contract
+                from draco_tpu.obs.numerics import wire_rel_tol
+
+                if not (wire_rel_tol(self.num_workers, self.worker_fail,
+                                     self.wire_dtype) < 1.0):
+                    raise ValueError(
+                        f"no usable narrow-wire flag threshold at "
+                        f"(n={self.num_workers}, s={self.worker_fail}, "
+                        f"{self.wire_dtype}) — run tools/wire_study.py at "
+                        f"this shape, or route the narrow wire through "
+                        f"approach=approx (no locator to amplify the "
+                        f"quantization noise)"
+                    )
         if self.shadow_round not in ("nearest", "stochastic"):
             raise ValueError(
                 f"shadow_round must be nearest|stochastic, got "

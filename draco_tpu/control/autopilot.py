@@ -83,6 +83,12 @@ DEFAULT_POLICY: Dict[str, float] = {
     "max_swaps": 8.0,
     # boundaries of numerics_drift before the shadow dtype is dropped
     "shadow_off_boundaries": 1.0,
+    # REAL-wire dial (ISSUE 15): boundaries of numerics_drift /
+    # decode_residual evidence before the wire dtype widens one f32-ward
+    # step (int8 → bf16 → f32), and boundaries of clean evidence before it
+    # narrows one step back toward the configured dtype
+    "wire_widen_boundaries": 1.0,
+    "wire_narrow_boundaries": 4.0,
 }
 
 # incident types that count as ADVERSARY evidence: any of these open (or
@@ -119,28 +125,36 @@ def parse_policy(spec: str) -> Dict[str, float]:
 class Regime:
     """One point of the (family, redundancy, wire dtype) dial. For cyclic
     ``redundancy`` is the per-worker load r = 2s+1; for approx it is the
-    fractional code_redundancy."""
+    fractional code_redundancy. ``wire_dtype`` (ISSUE 15) is the REAL
+    wire's materialized dtype — the wire_widen/wire_narrow remediations
+    move it along the f32 ↔ bf16 ↔ int8 ladder as warm cached program
+    swaps, exactly like the family dial."""
 
     approach: str
     redundancy: float
     shadow_wire: str
+    wire_dtype: str = "f32"
 
     @property
     def tag(self) -> str:
         t = f"{self.approach}_r{self.redundancy:g}"
         if self.shadow_wire != "off":
             t += f"_{self.shadow_wire}"
+        if self.wire_dtype != "f32":
+            t += f"_wire{self.wire_dtype}"
         return t
 
     def as_dict(self) -> dict:
         return {"approach": self.approach, "redundancy": self.redundancy,
-                "shadow_wire": self.shadow_wire, "tag": self.tag}
+                "shadow_wire": self.shadow_wire,
+                "wire_dtype": self.wire_dtype, "tag": self.tag}
 
 
 def base_regime(cfg) -> Regime:
     r = (2 * cfg.worker_fail + 1 if cfg.approach == "cyclic"
          else float(cfg.code_redundancy))
-    return Regime(cfg.approach, float(r), cfg.shadow_wire)
+    return Regime(cfg.approach, float(r), cfg.shadow_wire,
+                  getattr(cfg, "wire_dtype", "f32"))
 
 
 def regime_cfg(base_cfg, regime: Regime, quarantined: int = 0):
@@ -152,7 +166,8 @@ def regime_cfg(base_cfg, regime: Regime, quarantined: int = 0):
     design point to cover the quarantined workers plus churn headroom."""
     from draco_tpu.resilience.faults import INGRAPH_KINDS, plan_from_cfg
 
-    kw = {"approach": regime.approach, "shadow_wire": regime.shadow_wire}
+    kw = {"approach": regime.approach, "shadow_wire": regime.shadow_wire,
+          "wire_dtype": regime.wire_dtype}
     plan = plan_from_cfg(base_cfg)
     if plan is not None:
         kw["fault_spec"] = ",".join(ev.spec() for ev in plan.events
@@ -205,6 +220,8 @@ class Autopilot:
         self._strag_hot = 0
         self._strag_quiet = 0
         self._drift_hot = 0
+        self._wire_hot = 0
+        self._wire_quiet = 0
         self._prev_accused = 0.0
 
     def attach(self, client) -> None:
@@ -266,12 +283,57 @@ class Autopilot:
         self._strag_quiet = 0 if straggle_evidence else self._strag_quiet + 1
         self._drift_hot = (self._drift_hot + 1
                            if "numerics_drift" in open_eps else 0)
+        # REAL-wire evidence (ISSUE 15): numerics drift on the wire columns
+        # or decode-residual drift (residual-near-bound / rel-tol crossing)
+        # argues the narrow dtype's noise floor is no longer safe
+        wire_evidence = ("numerics_drift" in open_eps
+                        or "decode_residual" in open_eps)
+        self._wire_hot = self._wire_hot + 1 if wire_evidence else 0
+        self._wire_quiet = 0 if wire_evidence else self._wire_quiet + 1
 
         self._maybe_quarantine(step, client, open_eps, ledger)
         self._maybe_readmit(step, client, ledger)
         if getattr(client, "can_swap", True) \
                 and self.swaps < self.policy["max_swaps"]:
-            if self._drift_hot >= self.policy["shadow_off_boundaries"] \
+            from draco_tpu.obs.numerics import WIRE_WIDEN, narrow_toward
+
+            if (self.regime.wire_dtype != "f32"
+                    and self._wire_hot
+                    >= self.policy["wire_widen_boundaries"]):
+                # wire_widen (ISSUE 15): the dial moves the REAL wire one
+                # f32-ward step — a warm cached program swap like every
+                # other regime change; the narrow dtype's noise floor is
+                # implicated by the open drift/residual episode
+                trigger = (open_eps.get("numerics_drift")
+                           or open_eps.get("decode_residual"))
+                target = dataclasses.replace(
+                    self.regime,
+                    wire_dtype=WIRE_WIDEN[self.regime.wire_dtype])
+                self._swap(step, client, target, "wire_widen", trigger, {
+                    "wire_evidence_boundaries": self._wire_hot,
+                    "wire_dtype_before": self.regime.wire_dtype,
+                    "wire_dtype_after": target.wire_dtype,
+                })
+            elif (self.regime.wire_dtype != self.base.wire_dtype
+                  and self._wire_quiet
+                  >= self.policy["wire_narrow_boundaries"]
+                  and narrow_toward(self.regime.wire_dtype,
+                                    self.base.wire_dtype)
+                  != self.regime.wire_dtype):
+                # wire_narrow: sustained clean evidence earns one step back
+                # toward the configured narrow dtype (never past it)
+                trigger = self._last_cleared(("numerics_drift",
+                                              "decode_residual"))
+                target = dataclasses.replace(
+                    self.regime,
+                    wire_dtype=narrow_toward(self.regime.wire_dtype,
+                                             self.base.wire_dtype))
+                self._swap(step, client, target, "wire_narrow", trigger, {
+                    "wire_quiet_boundaries": self._wire_quiet,
+                    "wire_dtype_before": self.regime.wire_dtype,
+                    "wire_dtype_after": target.wire_dtype,
+                })
+            elif self._drift_hot >= self.policy["shadow_off_boundaries"] \
                     and self.regime.shadow_wire != "off":
                 self._swap(step, client,
                            dataclasses.replace(self.regime,
@@ -285,7 +347,8 @@ class Autopilot:
                 trigger = (open_eps.get("straggle")
                            or open_eps.get("starvation"))
                 target = Regime("approx", float(self.policy["r_low"]),
-                                self.regime.shadow_wire)
+                                self.regime.shadow_wire,
+                                self.regime.wire_dtype)
                 self._swap(step, client, target, "dial_down", trigger, {
                     "straggle_boundaries": self._strag_hot,
                     "adversary_quiet_boundaries": self._adv_quiet,
@@ -305,7 +368,9 @@ class Autopilot:
                 self._swap(step, client,
                            dataclasses.replace(self.base,
                                                shadow_wire=self.regime
-                                               .shadow_wire),
+                                               .shadow_wire,
+                                               wire_dtype=self.regime
+                                               .wire_dtype),
                            "dial_up", trigger, {
                                "straggle_quiet_boundaries":
                                    self._strag_quiet,
@@ -416,6 +481,7 @@ class Autopilot:
         self.swaps += 1
         # counters reset so the NEW regime earns its own evidence window
         self._strag_hot = self._strag_quiet = self._drift_hot = 0
+        self._wire_hot = self._wire_quiet = 0
         try:
             # the wire ledger is per-family: re-stamp the status block
             from draco_tpu.obs import numerics as numerics_mod

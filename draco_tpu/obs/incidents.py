@@ -251,7 +251,7 @@ class TrustDetector(_Detector):
 @register_detector(
     "decode_residual", severity="critical", source="record",
     thresholds={"cyclic_tol": 1e-3, "bound_frac": 0.95, "alpha": 0.25,
-                "on_count": 2, "off_count": 3})
+                "slack": 0.0, "on_count": 2, "off_count": 3})
 class ResidualDetector(_Detector):
     """Decode-residual drift. Exact families (cyclic): the fitted-codeword
     residual crossing ``cyclic_tol`` (clean decodes sit at f32 solve noise
@@ -273,15 +273,21 @@ class ResidualDetector(_Detector):
         bound = record.get("decode_residual_bound")
         if isinstance(bound, (int, float)):  # approx family
             bound = float(bound)
+            # narrow-wire slack (ISSUE 15, make_engine): on a bf16/int8
+            # wire the measured residual carries the end-to-end
+            # quantization error on TOP of the analytic bound (which
+            # prices drops only) — the dtype's slack is the family's
+            # normal state, same widening guards.assess applies. 0 on f32.
+            qres = max(res - self.th["slack"], 0.0) if res == res else res
             # full-participation steps: both sit at f32 noise — ratio is
             # meaningless there, and a healthy 0 must drain the EW
-            ratio = res / bound if bound > 1e-6 else 0.0
+            ratio = qres / bound if bound > 1e-6 else 0.0
             if not (ratio == ratio):  # NaN residual: poisoned decode
                 ratio = 2.0
             a = self.th["alpha"]
             self._ew = ratio if self._ew is None else \
                 a * ratio + (1.0 - a) * self._ew
-            violated = not (res <= bound + 1e-5)
+            violated = not (qres <= bound + 1e-5)
             firing = violated or self._ew > self.th["bound_frac"]
             return (firing, {"residual": res, "bound": bound,
                              "ew_ratio": round(self._ew, 4)}, None)
@@ -713,11 +719,19 @@ def make_engine(cfg, is_main: bool = True) -> Optional[IncidentEngine]:
     stream into, and this is the metrics-emitting process; threshold
     overrides from ``cfg.incident_thresholds``, with the cyclic residual
     tolerance defaulting to the step guard's ``cfg.guard_residual_tol``
-    (one loudness definition across guard and detector)."""
+    (one loudness definition across guard and detector) plus the narrow
+    wire's residual slack (ISSUE 15 — same widening guards.assess applies:
+    quantization noise on a bf16/int8 wire is the dtype's normal state,
+    not residual drift; 0 on the f32 wire)."""
     if getattr(cfg, "incident_watch", "off") != "on" or not cfg.train_dir \
             or not is_main:
         return None
-    thresholds = {"decode_residual.cyclic_tol": cfg.guard_residual_tol}
+    from draco_tpu.obs.numerics import wire_residual_slack
+
+    slack = wire_residual_slack(getattr(cfg, "wire_dtype", "f32"))
+    thresholds = {"decode_residual.cyclic_tol":
+                  cfg.guard_residual_tol + slack,
+                  "decode_residual.slack": slack}
     thresholds.update(parse_thresholds(
         getattr(cfg, "incident_thresholds", "")))
     return IncidentEngine(

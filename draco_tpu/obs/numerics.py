@@ -106,6 +106,101 @@ SHADOW_NAMES = ("shadow_err", "shadow_residual", "shadow_flag_agree",
 # every shadow column are >= 0, so -1 is unambiguous)
 SHADOW_SENTINEL = -1.0
 
+# ---- the REAL narrow wire (ISSUE 15) --------------------------------------
+# cfg.wire_dtype picks what the worker→aggregator wire PHYSICALLY carries:
+WIRE_DTYPES = ("f32", "bf16", "int8")
+
+# Regularization λ for the cyclic locator solve per wire dtype, scaled to
+# the dtype's quantization noise floor on the SIGNAL-normalized Hankel
+# system (the λ path divides the syndrome by the received rows' RMS, so a
+# pure-quantization syndrome sits at the dtype's relative noise — measured
+# ≤ 4.6e-3 bf16 / ≤ 1.6e-2 int8 at n=32 s=3, tools/wire_study.py locator
+# cells). λ sits ~2× above each measured floor and acts twice, both
+# branchless: (1) the syndrome-significance GATE — relative syndrome below
+# λ certifies no corruption, collapsing the locator magnitudes to uniform
+# so the spread-rank bias (coding/cyclic.SPREAD_PHI) pins the
+# well-conditioned honest subset, instead of the noise-driven subset whose
+# exact codeword fit extrapolates quantization noise ~4e4× (the PR 10
+# n=32 s=3 blocker); (2) the solve's noise-floor cutoff — singular
+# directions with σ ≤ λ are dropped outright (coding/linalg.truncated_lstsq
+# λ semantics). λ=0 (the f32 wire) is the exact historical path, bitwise.
+WIRE_LOCATOR_LAMBDA = {"f32": 0.0, "bf16": 2.0 ** -8, "int8": 2.0 ** -6}
+
+# Per-(n, s, dtype) cyclic flag thresholds for the REAL narrow wire,
+# DERIVED by tools/wire_study.py's locator-margin cells (committed in
+# wire_study.json's threshold_table and re-verified by --check): each
+# entry sits between the measured worst honest-row deviation (quantization
+# noise through the λ-regularized locator/fit solves) and the measured
+# smallest adversary-row deviation at the in-scope attack magnitudes.
+# Shapes not in the table fall back to the per-dtype SHADOW_REL_TOL
+# calibration band — run wire_study at the target shape before shipping a
+# narrow wire there (wire_rel_tol docstring).
+WIRE_REL_TOL_TABLE = {
+    # study shapes (n=8): the PR 10 shadow calibration band holds
+    (8, 1, "bf16"): 5e-2, (8, 1, "int8"): 1.5e-1,
+    # the PR 10 blocker shape: UNUSABLE unregularized (no-adversary honest
+    # deviations amplified to 29–137× the row RMS — past any threshold);
+    # usable with the λ-regularized locator, whose measured no-adversary
+    # honest deviations sit under 0.047/0.24 vs adversary deviations above
+    # 0.33 (wire_study.py locator cells, re-verified by --check). Measured
+    # limit: WITH live adversaries at this shape, honest rows extrapolated
+    # through the locator fit deviate up to 0.79/7.5 — past these
+    # thresholds — so detection recall holds but flag precision degrades
+    # in the adversary regime (honest_dev_max_adv in the committed cells;
+    # PERF.md §17). The certificate these entries carry is the
+    # no-adversary one the PR 10 blocker was about.
+    (32, 3, "bf16"): 2e-1, (32, 3, "int8"): 2.8e-1,
+}
+
+# Guard/incident residual slack per wire dtype: on a narrow wire the
+# UNFLAGGED honest rows deviate from the fitted codeword by rounding noise
+# (not f32 noise), and the approx family's measured residual carries the
+# end-to-end quantization error on top of its analytic bound (which prices
+# drops only). guards.assess and the decode_residual incident detector add
+# this to their tolerances so a clean narrow-wire step is not a trip —
+# sized ~3× the committed shadow-study maxima (bf16 err ≤0.6%, int8 ≤3.5%).
+WIRE_RESIDUAL_SLACK = {"f32": 0.0, "bf16": 2e-2, "int8": 1e-1}
+
+# f32-ward widening ladder (the autopilot's wire_widen remediation walks
+# it one step at a time; wire_narrow walks back toward the configured
+# dtype): int8 -> bf16 -> f32
+WIRE_WIDEN = {"int8": "bf16", "bf16": "f32", "f32": "f32"}
+
+
+def wire_rel_tol(n: int, s: int, dtype: str) -> float:
+    """The cyclic flag threshold a REAL narrow wire decodes with at
+    (n, s): the committed per-shape table entry, else — inside the
+    s ≤ 2 band PR 10 measured — the per-dtype calibration default
+    (SHADOW_REL_TOL). Outside both, ``inf``: no usable threshold is
+    KNOWN, and config.validate routes such shapes to the approx family
+    (whose decode has no locator to amplify the quantization noise,
+    arXiv:1802.03475) until tools/wire_study.py measures them. f32 keeps
+    HEALTH_REL_TOL — resolved by the caller, not here."""
+    key = (int(n), int(s), dtype)
+    if key in WIRE_REL_TOL_TABLE:
+        return WIRE_REL_TOL_TABLE[key]
+    if int(s) <= 2:
+        return SHADOW_REL_TOL[dtype]
+    return float("inf")
+
+
+def wire_locator_lambda(dtype: str) -> float:
+    return WIRE_LOCATOR_LAMBDA[dtype]
+
+
+def wire_residual_slack(dtype: str) -> float:
+    return WIRE_RESIDUAL_SLACK.get(dtype, 0.0)
+
+
+def narrow_toward(current: str, target: str) -> str:
+    """One narrowing step from ``current`` toward ``target`` (the
+    autopilot's wire_narrow ladder): f32 -> bf16 -> int8, never past the
+    configured target."""
+    order = ("f32", "bf16", "int8")
+    ci, ti = order.index(current), order.index(target)
+    return order[min(ci + 1, ti)] if ci < ti else current
+
+
 # quantization-aware flag threshold for the SHADOW cyclic decode (relative
 # amplitude, same role as coding/cyclic.HEALTH_REL_TOL = 1e-3): honest rows
 # on a quantized wire deviate from the fitted codeword by the rounding
@@ -160,11 +255,15 @@ def wire_rows(approach: str) -> int:
 
 def wire_ledger(cfg, dim: int) -> dict:
     """Logical worker→aggregator wire bytes per step at the program's
-    registered shapes — what the wire WOULD carry, per dtype candidate.
-    int8 adds one f32 scale per ``cfg.shadow_block`` elements (per row).
-    Derived, not measured: the simulated fleet never serializes these
-    bytes, which is exactly why the ledger must exist before ROADMAP
-    item 4 narrows the real wire."""
+    registered shapes, per dtype candidate — and, since ISSUE 15, the
+    MATERIALIZED wire: ``wire_dtype`` names the dtype the step body
+    actually rounds the codewords into (real bf16/int8 buffers crossing
+    the sharding boundary) and ``physical_bytes_per_worker`` /
+    ``physical_bytes_per_step`` are that candidate's bytes — equal BY
+    CONSTRUCTION to the logical candidate row (the narrow buffers carry
+    exactly 1 byte/elem + f32 per-block scales for int8, 2 bytes/elem for
+    bf16), which is what wire_study --check re-verifies. int8 adds one
+    f32 scale per ``cfg.shadow_block`` elements (per row)."""
     n = int(cfg.num_workers)
     rows = wire_rows(cfg.approach)
     words = rows * int(dim)
@@ -175,6 +274,7 @@ def wire_ledger(cfg, dim: int) -> dict:
         "bf16": 2 * words,
         "int8": words + 4 * blocks,  # 1 byte/elem + f32 per-block scales
     }
+    wire_dtype = getattr(cfg, "wire_dtype", "f32")
     return {
         "family": cfg.approach,
         "dim": int(dim),
@@ -182,6 +282,9 @@ def wire_ledger(cfg, dim: int) -> dict:
         "wire_words_per_worker": words,
         "bytes_per_worker": per_worker,
         "bytes_per_step": {k: v * n for k, v in per_worker.items()},
+        "wire_dtype": wire_dtype,
+        "physical_bytes_per_worker": per_worker[wire_dtype],
+        "physical_bytes_per_step": per_worker[wire_dtype] * n,
         "shadow_wire": cfg.shadow_wire,
         "shadow_block": block,
     }
@@ -293,45 +396,55 @@ def numerics_columns(cfg, grad_parts, wire_parts, agg) -> dict:
 # --------------------------------------------------------------------------
 
 
-def shadow_step_key(cfg, step=None):
+def _round_step_key(cfg, step, offset: int):
     """Per-step PRNG key for stochastic rounding — None under nearest
     rounding (the default), so the deterministic path adds no PRNG ops.
     Folded from (seed, step) like every other schedule; the noise draw is
     shared across wire rows (shape (d,)), so bitwise-identical rows
-    (maj_vote's soundness condition) quantize bitwise-identically."""
+    (maj_vote's soundness condition) quantize bitwise-identically.
+    ``offset`` separates the shadow and real-wire streams."""
     if cfg.shadow_round != "stochastic":
         return None
     import jax
 
     s = 0 if step is None else step
-    return jax.random.fold_in(jax.random.key(cfg.seed + 11), s)
+    return jax.random.fold_in(jax.random.key(cfg.seed + offset), s)
 
 
-def quantize_rows(x, mode: str, block: int = DEFAULT_BLOCK, key=None):
-    """Round wire rows to the narrow dtype, returning the DEQUANTIZED f32
-    tensor the shadow decode consumes.
+def shadow_step_key(cfg, step=None):
+    """The shadow quantizer's stochastic-rounding key (_round_step_key)."""
+    return _round_step_key(cfg, step, 11)
 
-    ``bf16``: round-to-nearest-even via real bf16 converts (or stochastic
-    via the +rand16-truncate bit trick when ``key`` is set). ``int8``:
-    symmetric per-block scales (absmax/127 over ``block``-element blocks
-    along the last axis, per row), round-to-nearest (or floor(x/s + u)
-    stochastic); non-finite inputs map to 0 — a narrow integer wire has no
-    NaN encoding, and non-finite attribution belongs to the ingest-row
-    forensics (obs/forensics.nonfinite_rows), not the wire."""
+
+def _bf16_stochastic(x, key):
+    """Stochastic bf16 rounding via the +rand16-truncate bit trick: f32 in,
+    the exactly-bf16-representable f32 values out. ONE implementation for
+    the shadow quantizer and the real wire — the calibration transfers
+    because the arithmetic cannot drift (pinned bitwise in
+    tests/test_wire.py)."""
     import jax
     import jax.numpy as jnp
 
-    x = jnp.asarray(x, jnp.float32)
-    if mode == "bf16":
-        if key is None:
-            return x.astype(jnp.bfloat16).astype(jnp.float32)
-        bits = jax.lax.bitcast_convert_type(x, jnp.uint32)
-        r = jax.random.bits(key, (x.shape[-1],), jnp.uint32) \
-            & jnp.uint32(0xFFFF)
-        bits = (bits + r) & jnp.uint32(0xFFFF0000)
-        return jax.lax.bitcast_convert_type(bits, jnp.float32)
-    if mode != "int8":
-        raise ValueError(f"unknown shadow wire dtype: {mode!r}")
+    bits = jax.lax.bitcast_convert_type(x, jnp.uint32)
+    r = jax.random.bits(key, (x.shape[-1],), jnp.uint32) \
+        & jnp.uint32(0xFFFF)
+    bits = (bits + r) & jnp.uint32(0xFFFF0000)
+    return jax.lax.bitcast_convert_type(bits, jnp.float32)
+
+
+def _int8_levels_and_scale(x, block: int, key):
+    """Symmetric per-block int8 quantization core — f32 rows in,
+    ``(q, scale)`` out with ``q`` the integer levels in [-127, 127] held
+    in f32 (exact) and ``scale`` the per-ELEMENT f32 scale (absmax/127
+    over ``block``-element blocks along the last axis, constant within a
+    block). Round-to-nearest, or floor(x/s + u) stochastic under ``key``;
+    non-finite inputs map to 0 — a narrow integer wire has no NaN
+    encoding, and non-finite attribution belongs to the ingest-row
+    forensics (obs/forensics.nonfinite_rows), not the wire. ONE
+    implementation for the shadow quantizer and the real wire."""
+    import jax
+    import jax.numpy as jnp
+
     block = max(int(block), 1)
     d = x.shape[-1]
     finite = jnp.isfinite(x)
@@ -344,11 +457,152 @@ def quantize_rows(x, mode: str, block: int = DEFAULT_BLOCK, key=None):
     else:
         u = jax.random.uniform(key, (d,), jnp.float32)
         q = jnp.floor(y + u)
-    q = jnp.clip(q, -INT8_LEVELS, INT8_LEVELS)
+    return jnp.clip(q, -INT8_LEVELS, INT8_LEVELS), scale
+
+
+def quantize_rows(x, mode: str, block: int = DEFAULT_BLOCK, key=None):
+    """Round wire rows to the narrow dtype, returning the DEQUANTIZED f32
+    tensor the shadow decode consumes.
+
+    ``bf16``: round-to-nearest-even via real bf16 converts (or stochastic
+    via :func:`_bf16_stochastic` when ``key`` is set). ``int8``:
+    :func:`_int8_levels_and_scale` — the SAME cores the real wire
+    (narrow_wire_rows) quantizes with, so the shadow calibration
+    transfers by construction."""
+    import jax.numpy as jnp
+
+    x = jnp.asarray(x, jnp.float32)
+    if mode == "bf16":
+        if key is None:
+            return x.astype(jnp.bfloat16).astype(jnp.float32)
+        return _bf16_stochastic(x, key)
+    if mode != "int8":
+        raise ValueError(f"unknown shadow wire dtype: {mode!r}")
+    q, scale = _int8_levels_and_scale(x, block, key)
     # int8 levels are exact in f32 — the shadow never leaves the chip, so
     # no narrow buffer is materialized (module docstring); the LOGICAL
     # bytes live in wire_ledger
     return q * scale
+
+
+# --------------------------------------------------------------------------
+# the REAL narrow wire (ISSUE 15): actual bf16/int8 buffers cross the
+# sharding boundary; f32 exists again only inside the decode
+# --------------------------------------------------------------------------
+
+
+def wire_step_key(cfg, step=None):
+    """Per-step PRNG key for the REAL wire's stochastic rounding
+    (``cfg.shadow_round`` doubles as the wire rounding mode — the
+    observatory knob it was calibrated with). Distinct stream from the
+    shadow's (seed + 17 vs + 11, _round_step_key)."""
+    return _round_step_key(cfg, step, 17)
+
+
+def narrow_wire_rows(x, mode: str, block: int = DEFAULT_BLOCK, key=None):
+    """Round (..., d) f32 wire rows into REAL narrow buffers — the arrays
+    that physically cross the worker→aggregator sharding boundary.
+
+    Returns a dict of narrow arrays:
+      bf16: {"q": bfloat16 (..., d)}
+      int8: {"q": int8 (..., d), "scale": f32 (..., ceil(d/block))}
+            symmetric per-block scales (absmax/127 over ``block``-element
+            blocks along the last axis, per row); non-finite inputs map
+            to 0 (an integer wire has no NaN encoding — non-finite
+            attribution belongs to the pre-encode ingest forensics).
+    Rounding: nearest by default; ``key`` enables the shared-draw
+    stochastic rounding (wire_step_key). The quantization cores
+    (:func:`_bf16_stochastic`, :func:`_int8_levels_and_scale`) are THE
+    SAME ones the shadow quantizer runs — the calibration transfers by
+    construction, pinned bitwise in tests/test_wire.py."""
+    import jax.numpy as jnp
+
+    x = jnp.asarray(x, jnp.float32)
+    if mode == "bf16":
+        if key is None:
+            return {"q": x.astype(jnp.bfloat16)}
+        # the stochastic-rounded value is exactly bf16-representable: the
+        # narrowing cast is exact
+        return {"q": _bf16_stochastic(x, key).astype(jnp.bfloat16)}
+    if mode != "int8":
+        raise ValueError(f"unknown wire dtype: {mode!r}")
+    q, scale = _int8_levels_and_scale(x, block, key)
+    # blocked scale buffer: within-block values are identical, so strided
+    # slicing at the block starts yields the (..., nb) per-block scales
+    return {"q": q.astype(jnp.int8),
+            "scale": scale[..., ::max(int(block), 1)]}
+
+
+def widen_wire_rows(buf: dict, mode: str, block: int = DEFAULT_BLOCK):
+    """Narrow wire buffers -> the f32 rows the decode consumes (f32
+    accumulation throughout). This is the ONLY widening site: on the XLA
+    path the convert fuses into the consuming matmul; on TPU the
+    narrow-ingest Pallas kernels (ops/decode_kernels) run the same
+    arithmetic in-tile on VMEM blocks, so the widened (n, d) f32 matrix
+    never round-trips HBM."""
+    import jax.numpy as jnp
+
+    q = jnp.asarray(buf["q"])
+    if mode == "bf16":
+        return q.astype(jnp.float32)
+    if mode != "int8":
+        raise ValueError(f"unknown wire dtype: {mode!r}")
+    block = max(int(block), 1)
+    d = q.shape[-1]
+    scale = jnp.asarray(buf["scale"])
+    wide = jnp.repeat(scale, block, axis=-1)[..., :d]
+    return q.astype(jnp.float32) * wide
+
+
+def wire_decode_params(cfg):
+    """(rel_tol, lam) the cyclic decode runs with at ``cfg``'s wire dtype:
+    (None, 0.0) on the f32 wire — the caller keeps HEALTH_REL_TOL and the
+    exact λ=0 solve bitwise — else the committed per-(n, s, dtype)
+    threshold and the dtype's locator λ."""
+    dtype = getattr(cfg, "wire_dtype", "f32")
+    if dtype == "f32":
+        return None, 0.0
+    return (wire_rel_tol(cfg.num_workers, cfg.worker_fail, dtype),
+            wire_locator_lambda(dtype))
+
+
+def narrow_wire_pair(cfg, enc_re, enc_im, step=None, constrain=None):
+    """Apply the REAL narrow wire to a cyclic (re, im) codeword pair:
+    quantize into narrow buffers — THE arrays that cross the sharding
+    boundary (``constrain`` pins each to the worker axis) — then widen to
+    f32 for the decode. Returns ``(enc_re, enc_im, wire)`` where ``wire``
+    is ``(mode, buf_re, buf_im, block)`` for the narrow-ingest decode
+    kernels, or None on the f32 wire (identity — no ops added)."""
+    dtype = getattr(cfg, "wire_dtype", "f32")
+    if dtype == "f32":
+        return enc_re, enc_im, None
+    import jax
+
+    key = wire_step_key(cfg, step)
+    k_im = None if key is None else jax.random.fold_in(key, 1)
+    buf_re = narrow_wire_rows(enc_re, dtype, cfg.shadow_block, key)
+    buf_im = narrow_wire_rows(enc_im, dtype, cfg.shadow_block, k_im)
+    if constrain is not None:
+        buf_re = {k: constrain(v) for k, v in buf_re.items()}
+        buf_im = {k: constrain(v) for k, v in buf_im.items()}
+    return (widen_wire_rows(buf_re, dtype, cfg.shadow_block),
+            widen_wire_rows(buf_im, dtype, cfg.shadow_block),
+            (dtype, buf_re, buf_im, int(cfg.shadow_block)))
+
+
+def narrow_wire_single(cfg, rows, step=None, constrain=None):
+    """The single-row-block variant (approx partial sums / maj_vote raw
+    gradient rows): returns ``(rows_f32, wire)`` with ``wire`` =
+    ``(mode, buf, block)`` or None on the f32 wire."""
+    dtype = getattr(cfg, "wire_dtype", "f32")
+    if dtype == "f32":
+        return rows, None
+    buf = narrow_wire_rows(rows, dtype, cfg.shadow_block,
+                           wire_step_key(cfg, step))
+    if constrain is not None:
+        buf = {k: constrain(v) for k, v in buf.items()}
+    return (widen_wire_rows(buf, dtype, cfg.shadow_block),
+            (dtype, buf, int(cfg.shadow_block)))
 
 
 # --------------------------------------------------------------------------

@@ -89,7 +89,7 @@ def resolve_decode_impl(value: str, backend_pallas=None) -> str:
 # ---------------------------------------------------------------------------
 
 
-def _cyclic_locator_kernel(s, rel_tol, e_re_ref, e_im_ref, c2h_re_ref,
+def _cyclic_locator_kernel(s, rel_tol, lam, e_re_ref, e_im_ref, c2h_re_ref,
                            c2h_im_ref, c1_re_ref, c1_im_ref, est_re_ref,
                            est_im_ref, pres_ref, v_re_ref, v_im_ref,
                            honest_ref, flagged_ref, loud_ref, resid_ref):
@@ -98,7 +98,7 @@ def _cyclic_locator_kernel(s, rel_tol, e_re_ref, e_im_ref, c2h_re_ref,
     v_re, v_im, honest, flagged, loud, resid = cyclic_mod.locator_core(
         e_re_ref[...], e_im_ref[...], c2h_re_ref[...], c2h_im_ref[...],
         c1_re_ref[...], c1_im_ref[...], est_re_ref[...], est_im_ref[...],
-        pres_ref[...], s, rel_tol)
+        pres_ref[...], s, rel_tol, lam=lam)
     v_re_ref[...] = v_re
     v_im_ref[...] = v_im
     honest_ref[...] = honest.astype(jnp.float32)
@@ -110,9 +110,10 @@ def _cyclic_locator_kernel(s, rel_tol, e_re_ref, e_im_ref, c2h_re_ref,
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("s", "rel_tol", "interpret"))
+                   static_argnames=("s", "rel_tol", "lam", "interpret"))
 def _cyclic_locator_pallas(e_re_l, e_im_l, c2h_re, c2h_im, c1_re, c1_im,
-                           est_re, est_im, pres_f, s, rel_tol, interpret):
+                           est_re, est_im, pres_f, s, rel_tol, lam,
+                           interpret):
     L, n = e_re_l.shape
     lp = -(-L // LAYER_BLOCK) * LAYER_BLOCK
     if lp != L:
@@ -124,7 +125,7 @@ def _cyclic_locator_pallas(e_re_l, e_im_l, c2h_re, c2h_im, c1_re, c1_im,
     whole = lambda i: (0, 0)  # noqa: E731
     blk = (LAYER_BLOCK, n)
     out = pl.pallas_call(
-        functools.partial(_cyclic_locator_kernel, s, rel_tol),
+        functools.partial(_cyclic_locator_kernel, s, rel_tol, lam),
         grid=grid,
         in_specs=[
             pl.BlockSpec(blk, row),
@@ -147,18 +148,44 @@ def _cyclic_locator_pallas(e_re_l, e_im_l, c2h_re, c2h_im, c1_re, c1_im,
 
 
 def cyclic_locator(code, e_re_l, e_im_l, pres_f, rel_tol,
-                   interpret: bool = False):
+                   interpret: bool = False, lam: float = 0.0):
     """Kernel entry used by ``coding/cyclic._run_locator``: (L, n)
     projected-column stack -> the locator outputs of
     ``coding/cyclic.locator_core`` (v pair, honest/flagged/loud masks,
     per-layer residual). ``pres_f``: (1, n) f32 presence row shared by
-    every layer."""
+    every layer. ``lam``: static Tikhonov λ of the locator solve
+    (narrow-wire regularization, ISSUE 15; 0.0 = exact path)."""
     return _cyclic_locator_pallas(
         e_re_l, e_im_l,
         jnp.asarray(code.c2h_re), jnp.asarray(code.c2h_im),
         jnp.asarray(code.c1_re), jnp.asarray(code.c1_im),
         jnp.asarray(code.est_re), jnp.asarray(code.est_im),
-        jnp.asarray(pres_f), code.s, float(rel_tol), interpret)
+        jnp.asarray(pres_f), code.s, float(rel_tol), float(lam), interpret)
+
+
+# ---------------------------------------------------------------------------
+# narrow-ingest dequantization (ISSUE 15): widen bf16/int8 wire tiles to
+# f32 INSIDE the kernel body, so the widened (n, d) f32 matrix never
+# round-trips HBM — the dequant the XLA fallback pays as a separate
+# convert/multiply pass happens on the VMEM-resident tile instead
+# ---------------------------------------------------------------------------
+
+
+def _dequant_tile(q, scale, block):
+    """(n, T) narrow tile -> f32. ``q`` bf16 (scale None) or int8 with
+    ``scale`` the (n, T/block) per-block f32 scales. The block broadcast
+    is a matmul against an iota-built 0/1 expansion matrix — Mosaic has
+    no gather/repeat, but (nb, T) one-hot times (n, nb) is MXU work."""
+    if scale is None:
+        return q.astype(jnp.float32)
+    n, t = q.shape
+    nb = scale.shape[-1]
+    row = jax.lax.broadcasted_iota(jnp.int32, (nb, t), 0)
+    col = jax.lax.broadcasted_iota(jnp.int32, (nb, t), 1)
+    expand = ((col >= row * block)
+              & (col < (row + 1) * block)).astype(jnp.float32)
+    wide = jnp.dot(scale, expand, preferred_element_type=jnp.float32)
+    return q.astype(jnp.float32) * wide
 
 
 # ---------------------------------------------------------------------------
@@ -166,8 +193,8 @@ def cyclic_locator(code, e_re_l, e_im_l, pres_f, rel_tol,
 # ---------------------------------------------------------------------------
 
 
-def _approx_decode_kernel(d, n, rows_ref, bg_ref, vn_ref, pres_ref,
-                          dec_ref, sqd_ref, sqg_ref):
+def _approx_decode_body(d, n, block, rows_ref, scale_ref, bg_ref, vn_ref,
+                        pres_ref, dec_ref, sqd_ref, sqg_ref):
     j = pl.program_id(0)
 
     @pl.when(j == 0)
@@ -179,9 +206,11 @@ def _approx_decode_kernel(d, n, rows_ref, bg_ref, vn_ref, pres_ref,
     cols = base + jax.lax.broadcasted_iota(jnp.int32, (1, TILE_D), 1)
     live = (cols < d).astype(jnp.float32)  # ragged edge tile mask
     pres = pres_ref[...][:, :1]  # (n, 1) — lane 0 of the broadcast block
+    raw = _dequant_tile(
+        rows_ref[...], None if scale_ref is None else scale_ref[...], block)
     # true zero-fill of absent rows (0·NaN = NaN through the matvec —
     # multiplicative masking alone would pass a NaN payload)
-    rows = jnp.where(pres > 0, rows_ref[...], 0.0) * live
+    rows = jnp.where(pres > 0, raw, 0.0) * live
     bg = bg_ref[...] * live
     decoded = jnp.dot(vn_ref[...], rows,
                       preferred_element_type=jnp.float32)  # (1, T), Σv/n·row
@@ -195,23 +224,54 @@ def _approx_decode_kernel(d, n, rows_ref, bg_ref, vn_ref, pres_ref,
         axis=(0, 1))[None, :]
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def _approx_decode_pallas(rows, bg, v_over_n, pres_wide, interpret):
+def _approx_decode_kernel(d, n, rows_ref, bg_ref, vn_ref, pres_ref,
+                          dec_ref, sqd_ref, sqg_ref):
+    _approx_decode_body(d, n, 0, rows_ref, None, bg_ref, vn_ref, pres_ref,
+                        dec_ref, sqd_ref, sqg_ref)
+
+
+def _approx_decode_kernel_narrow(d, n, block, rows_ref, scale_ref, bg_ref,
+                                 vn_ref, pres_ref, dec_ref, sqd_ref,
+                                 sqg_ref):
+    _approx_decode_body(d, n, block, rows_ref, scale_ref, bg_ref, vn_ref,
+                        pres_ref, dec_ref, sqd_ref, sqg_ref)
+
+
+@functools.partial(jax.jit, static_argnames=("block", "interpret"))
+def _approx_decode_pallas(rows, bg, v_over_n, pres_wide, scale=None,
+                          block=0, interpret=False):
     n, d = rows.shape
     rows_p = _pad_d(rows, TILE_D)
     bg_p = _pad_d(bg, TILE_D)
     dp = rows_p.shape[-1]
     grid = (dp // TILE_D,)
     whole = lambda j: (0, 0)  # noqa: E731
+    in_specs = [
+        pl.BlockSpec((n, TILE_D), lambda j: (0, j)),
+        pl.BlockSpec((n, TILE_D), lambda j: (0, j)),
+        pl.BlockSpec((1, n), whole),
+        pl.BlockSpec((n, 128), whole),
+    ]
+    operands = [rows_p, bg_p, v_over_n, pres_wide]
+    if scale is None:
+        kernel = functools.partial(_approx_decode_kernel, d, n)
+    else:
+        # per-block int8 scales ride their own (n, TILE_D/block) tiles,
+        # padded with 1.0 (padded q lanes are 0, so 0·1 stays 0)
+        sb = TILE_D // block
+        nb = scale.shape[-1]
+        nb_p = (dp // TILE_D) * sb
+        if nb_p != nb:
+            scale = jnp.pad(scale, [(0, 0), (0, nb_p - nb)],
+                            constant_values=1.0)
+        kernel = functools.partial(_approx_decode_kernel_narrow, d, n,
+                                   block)
+        in_specs.insert(1, pl.BlockSpec((n, sb), lambda j: (0, j)))
+        operands.insert(1, scale)
     decoded, sqd, sqg = pl.pallas_call(
-        functools.partial(_approx_decode_kernel, d, n),
+        kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((n, TILE_D), lambda j: (0, j)),
-            pl.BlockSpec((n, TILE_D), lambda j: (0, j)),
-            pl.BlockSpec((1, n), whole),
-            pl.BlockSpec((n, 128), whole),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, TILE_D), lambda j: (0, j)),
             pl.BlockSpec((1, 128), whole),
@@ -223,20 +283,137 @@ def _approx_decode_pallas(rows, bg, v_over_n, pres_wide, interpret):
             jax.ShapeDtypeStruct((1, 128), jnp.float32),
         ],
         interpret=interpret,
-    )(rows_p, bg_p, v_over_n, pres_wide)
+    )(*operands)
     return decoded[0, :d], jnp.sum(sqd), jnp.sum(sqg)
 
 
-def approx_decode(rows, batch_grads, v, pres_b, interpret: bool = False):
+def approx_decode(rows, batch_grads, v, pres_b, interpret: bool = False,
+                  wire=None):
     """Kernel entry used by ``coding/approx._decode_fused``: one fused
     pass over the (n, d) wire + gradient blocks. Returns
     ``(decoded (d,), Σ(decoded − true_mean)², Σ batch_grads²)`` — the
-    caller folds the two scalars into the residual-vs-bound health."""
-    n = rows.shape[0]
+    caller folds the two scalars into the residual-vs-bound health.
+
+    ``wire`` (ISSUE 15): the narrow-ingest variant. ``(mode, buf)`` with
+    ``buf`` the real narrow buffers (obs/numerics.narrow_wire_rows —
+    bf16 ``{"q"}`` or int8 ``{"q", "scale"}`` at ``block`` granularity,
+    passed as ``(mode, buf, block)`` for int8): the kernel loads the
+    NARROW tiles and dequantizes in VMEM (_dequant_tile), so the widened
+    f32 wire matrix never exists in HBM. ``rows`` is ignored then (the
+    narrow buffers ARE the wire). int8 requires ``TILE_D % block == 0``
+    (the per-tile scale columns must align; callers fall back to the
+    pre-widened path otherwise)."""
+    n = batch_grads.shape[0]
     pres_wide = jnp.broadcast_to(
         jnp.asarray(pres_b).astype(jnp.float32)[:, None], (n, 128))
+    if wire is not None:
+        mode, buf = wire[0], wire[1]
+        if mode == "bf16":
+            return _approx_decode_pallas(
+                jnp.asarray(buf["q"]), batch_grads, (v / n)[None, :],
+                pres_wide, interpret=interpret)
+        block = int(wire[2])
+        return _approx_decode_pallas(
+            jnp.asarray(buf["q"]), batch_grads, (v / n)[None, :],
+            pres_wide, scale=jnp.asarray(buf["scale"]), block=block,
+            interpret=interpret)
     return _approx_decode_pallas(rows, batch_grads, (v / n)[None, :],
-                                 pres_wide, interpret)
+                                 pres_wide, interpret=interpret)
+
+
+# ---------------------------------------------------------------------------
+# cyclic: narrow-ingest recombination (ISSUE 15) — Re[vᵀ(R_re + i·R_im)]
+# with R supplied as the REAL narrow wire buffers, dequantized in-tile
+# ---------------------------------------------------------------------------
+
+
+def _cyclic_recombine_body(block, vr_ref, vi_ref, qr_ref, qi_ref, sr_ref,
+                           si_ref, out_ref):
+    rr = _dequant_tile(qr_ref[...],
+                       None if sr_ref is None else sr_ref[...], block)
+    ri = _dequant_tile(qi_ref[...],
+                       None if si_ref is None else si_ref[...], block)
+    out_ref[...] = (jnp.dot(vr_ref[...], rr,
+                            preferred_element_type=jnp.float32)
+                    - jnp.dot(vi_ref[...], ri,
+                              preferred_element_type=jnp.float32))
+
+
+def _cyclic_recombine_kernel_bf16(vr_ref, vi_ref, qr_ref, qi_ref, out_ref):
+    _cyclic_recombine_body(0, vr_ref, vi_ref, qr_ref, qi_ref, None, None,
+                           out_ref)
+
+
+def _cyclic_recombine_kernel_int8(block, vr_ref, vi_ref, qr_ref, qi_ref,
+                                  sr_ref, si_ref, out_ref):
+    _cyclic_recombine_body(block, vr_ref, vi_ref, qr_ref, qi_ref, sr_ref,
+                           si_ref, out_ref)
+
+
+@functools.partial(jax.jit, static_argnames=("block", "interpret"))
+def _cyclic_recombine_pallas(v_re, v_im, q_re, q_im, s_re=None, s_im=None,
+                             block=0, interpret=False):
+    n, d = q_re.shape
+    qr_p = _pad_d(q_re, TILE_D)
+    qi_p = _pad_d(q_im, TILE_D)
+    dp = qr_p.shape[-1]
+    grid = (dp // TILE_D,)
+    whole = lambda j: (0, 0)  # noqa: E731
+    in_specs = [pl.BlockSpec((1, n), whole), pl.BlockSpec((1, n), whole),
+                pl.BlockSpec((n, TILE_D), lambda j: (0, j)),
+                pl.BlockSpec((n, TILE_D), lambda j: (0, j))]
+    operands = [v_re[None, :], v_im[None, :], qr_p, qi_p]
+    if s_re is None:
+        kernel = _cyclic_recombine_kernel_bf16
+    else:
+        sb = TILE_D // block
+        nb_p = (dp // TILE_D) * sb
+        pad = [(0, 0), (0, nb_p - s_re.shape[-1])]
+        if nb_p != s_re.shape[-1]:
+            s_re = jnp.pad(s_re, pad, constant_values=1.0)
+            s_im = jnp.pad(s_im, pad, constant_values=1.0)
+        kernel = functools.partial(_cyclic_recombine_kernel_int8, block)
+        in_specs += [pl.BlockSpec((n, sb), lambda j: (0, j))] * 2
+        operands += [s_re, s_im]
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, TILE_D), lambda j: (0, j)),
+        out_shape=jax.ShapeDtypeStruct((1, dp), jnp.float32),
+        interpret=interpret,
+    )(*operands)
+    return out[0, :d]
+
+
+def cyclic_narrow_recombine(v_re, v_im, wire, interpret: bool = False):
+    """Narrow-ingest cyclic recombination: ``wire`` is the
+    ``(mode, buf_re, buf_im, block)`` tuple of
+    obs/numerics.narrow_wire_pair — the REAL bf16/int8 buffers that
+    crossed the sharding boundary. The kernel streams the narrow tiles
+    once and dequantizes in VMEM (_dequant_tile), so the widened f32
+    (n, d) matrix never round-trips HBM — the narrow wire's HBM half of
+    the ISSUE 15 win. int8 requires ``TILE_D % block == 0``."""
+    mode, buf_re, buf_im, block = wire
+    if mode == "bf16":
+        return _cyclic_recombine_pallas(
+            v_re, v_im, jnp.asarray(buf_re["q"]), jnp.asarray(buf_im["q"]),
+            interpret=interpret)
+    return _cyclic_recombine_pallas(
+        v_re, v_im, jnp.asarray(buf_re["q"]), jnp.asarray(buf_im["q"]),
+        s_re=jnp.asarray(buf_re["scale"]), s_im=jnp.asarray(buf_im["scale"]),
+        block=int(block), interpret=interpret)
+
+
+def narrow_kernel_ok(wire) -> bool:
+    """Static feasibility of the narrow-ingest kernels for this wire:
+    int8 per-block scales must tile evenly into the TILE_D grid."""
+    if wire is None:
+        return False
+    if wire[0] == "bf16":
+        return True
+    block = int(wire[-1])
+    return block >= 1 and TILE_D % block == 0
 
 
 # ---------------------------------------------------------------------------
@@ -257,7 +434,12 @@ def lint_programs():
         BuiltProgram, LintProgram, Manifest,
     )
 
+    from draco_tpu.analysis.registry import BF16_DTYPES
+
     kernel_manifest = Manifest(require_donated=None, collectives=None)
+    bf16_kernel_manifest = Manifest(require_donated=None, collectives=None,
+                                    allowed_dtypes=BF16_DTYPES,
+                                    required_dtypes=frozenset({"bf16"}))
 
     def build_cyclic():
         from draco_tpu.coding import cyclic as cyclic_mod
@@ -292,9 +474,88 @@ def lint_programs():
                             extra={"n": n, "d": d},
                             capture_memory=False)
 
+    def build_cyclic_narrow():
+        n, d, block = 8, 4096, 256
+
+        def fn(v_re, v_im, q_re, q_im, s_re, s_im):
+            wire = ("int8", {"q": q_re, "scale": s_re},
+                    {"q": q_im, "scale": s_im}, block)
+            return cyclic_narrow_recombine(v_re, v_im, wire)
+
+        nb = d // block
+        args = (jnp.zeros((n,), jnp.float32), jnp.zeros((n,), jnp.float32),
+                jnp.zeros((n, d), jnp.int8), jnp.zeros((n, d), jnp.int8),
+                jnp.ones((n, nb), jnp.float32),
+                jnp.ones((n, nb), jnp.float32))
+        return BuiltProgram("kernel_cyclic_narrow_recombine", jax.jit(fn),
+                            args, None, kernel_manifest,
+                            extra={"n": n, "d": d, "block": block},
+                            capture_memory=False)
+
+    def build_approx_narrow():
+        n, d, block = 8, 4096, 256
+
+        def fn(q, s, bg, v, pres):
+            return approx_decode(q, bg, v, pres,
+                                 wire=("int8", {"q": q, "scale": s}, block))
+
+        args = (jnp.zeros((n, d), jnp.int8),
+                jnp.ones((n, d // block), jnp.float32),
+                jnp.zeros((n, d), jnp.float32),
+                jnp.ones((n,), jnp.float32) / n,
+                jnp.ones((n,), bool))
+        return BuiltProgram("kernel_approx_decode_narrow", jax.jit(fn),
+                            args, None, kernel_manifest,
+                            extra={"n": n, "d": d, "block": block},
+                            capture_memory=False)
+
+    def build_cyclic_narrow_bf16():
+        n, d = 8, 4096
+
+        def fn(v_re, v_im, q_re, q_im):
+            wire = ("bf16", {"q": q_re}, {"q": q_im}, 256)
+            return cyclic_narrow_recombine(v_re, v_im, wire)
+
+        args = (jnp.zeros((n,), jnp.float32), jnp.zeros((n,), jnp.float32),
+                jnp.zeros((n, d), jnp.bfloat16),
+                jnp.zeros((n, d), jnp.bfloat16))
+        return BuiltProgram("kernel_cyclic_narrow_recombine_bf16",
+                            jax.jit(fn), args, None, bf16_kernel_manifest,
+                            extra={"n": n, "d": d},
+                            capture_memory=False)
+
+    def build_approx_narrow_bf16():
+        n, d = 8, 4096
+
+        def fn(q, bg, v, pres):
+            return approx_decode(q, bg, v, pres,
+                                 wire=("bf16", {"q": q}, 256))
+
+        args = (jnp.zeros((n, d), jnp.bfloat16),
+                jnp.zeros((n, d), jnp.float32),
+                jnp.ones((n,), jnp.float32) / n,
+                jnp.ones((n,), bool))
+        return BuiltProgram("kernel_approx_decode_narrow_bf16",
+                            jax.jit(fn), args, None, bf16_kernel_manifest,
+                            extra={"n": n, "d": d},
+                            capture_memory=False)
+
     return [
         LintProgram(name="kernel_cyclic_locator", build=build_cyclic,
                     route="decode_kernel"),
         LintProgram(name="kernel_approx_decode", build=build_approx,
                     route="decode_kernel"),
+        # narrow-ingest variants (ISSUE 15), BOTH wire dtypes: the int8
+        # tiles + per-block scales and the bf16 tiles (which hit bf16's
+        # stricter sublane tiling) are dequantized in VMEM (_dequant_tile)
+        # — the TPU-platform export below runs their Python-side Mosaic
+        # lowering on every CI lint sweep, like the other kernel rows
+        LintProgram(name="kernel_cyclic_narrow_recombine",
+                    build=build_cyclic_narrow, route="decode_kernel"),
+        LintProgram(name="kernel_approx_decode_narrow",
+                    build=build_approx_narrow, route="decode_kernel"),
+        LintProgram(name="kernel_cyclic_narrow_recombine_bf16",
+                    build=build_cyclic_narrow_bf16, route="decode_kernel"),
+        LintProgram(name="kernel_approx_decode_narrow_bf16",
+                    build=build_approx_narrow_bf16, route="decode_kernel"),
     ]

@@ -50,6 +50,7 @@ def approx_aggregate(code, grads: jnp.ndarray, present=None, constrain=None,
     merge into the metric row. Identity (no added ops) when the watch is
     off."""
     from draco_tpu.obs import forensics as forensics_mod
+    from draco_tpu.obs import numerics as numerics_mod
     from draco_tpu.ops.decode_kernels import resolve_decode_impl
 
     decode_impl = resolve_decode_impl(
@@ -60,12 +61,20 @@ def approx_aggregate(code, grads: jnp.ndarray, present=None, constrain=None,
         if present is not None:
             rows = jnp.where(jnp.asarray(present).astype(bool)[:, None],
                              rows, jnp.zeros_like(rows))
-        if constrain is not None:
+        # the REAL narrow wire (ISSUE 15): quantize the partial-sum rows
+        # into narrow buffers — THE arrays that cross the sharding
+        # boundary — and widen to f32 only for the decode; identity (no
+        # ops) on the f32 wire
+        wire = None
+        if cfg is not None and getattr(cfg, "wire_dtype", "f32") != "f32":
+            rows, wire = numerics_mod.narrow_wire_single(
+                cfg, rows, step=step, constrain=constrain)
+        elif constrain is not None:
             rows = constrain(rows)
     with jax.named_scope("draco_decode"):
         agg, _v, health = approx_mod.decode(
             code, rows, present=present, with_health=True,
-            batch_grads=grads, impl=decode_impl)
+            batch_grads=grads, impl=decode_impl, wire=wire)
     health["bad_rows"] = bad_rows
     if cfg is not None:
         from draco_tpu.obs import numerics as numerics_mod
@@ -150,9 +159,19 @@ def aggregate_flat_grads(grads: jnp.ndarray, adv_mask, cfg, code, rand_factor,
             if present is not None:
                 pw = present[:, None].astype(enc_re.dtype)
                 enc_re, enc_im = enc_re * pw, enc_im * pw
+        from draco_tpu.obs import numerics as numerics_mod
         from draco_tpu.ops.decode_kernels import resolve_decode_impl
 
         decode_impl = resolve_decode_impl(cfg.decode_impl)
+        # the REAL narrow wire (ISSUE 15): the codeword pair is rounded
+        # into narrow buffers that cross the sharding boundary; the decode
+        # widens to f32 and runs the quantization-aware flag threshold +
+        # Tikhonov-regularized locator. Identity on the f32 wire.
+        enc_re, enc_im, wire = numerics_mod.narrow_wire_pair(
+            cfg, enc_re, enc_im, step=step)
+        wire_tol, wire_lam = numerics_mod.wire_decode_params(cfg)
+        rel_tol = (cyclic_mod.HEALTH_REL_TOL if wire_tol is None
+                   else wire_tol)
         with jax.named_scope("draco_decode"):
             if cfg.decode_granularity == "layer":
                 if leaf_offsets is None:
@@ -163,13 +182,14 @@ def aggregate_flat_grads(grads: jnp.ndarray, adv_mask, cfg, code, rand_factor,
                 agg, _honest, health = cyclic_mod.decode_layers(
                     code, enc_re, enc_im, rand_factor, leaf_offsets,
                     present=present, with_health=True, impl=decode_impl,
+                    rel_tol=rel_tol, lam=wire_lam,
                 )
             else:
                 agg, _honest, health = cyclic_mod.decode(
                     code, enc_re, enc_im, rand_factor, present=present,
-                    with_health=True, impl=decode_impl)
+                    with_health=True, impl=decode_impl, rel_tol=rel_tol,
+                    lam=wire_lam, wire=wire)
         health["bad_rows"] = bad_rows
-        from draco_tpu.obs import numerics as numerics_mod
 
         if numerics_mod.watch_enabled(cfg):
             # numerics observatory (obs/numerics.py, ISSUE 10): dynamic-

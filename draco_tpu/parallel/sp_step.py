@@ -406,6 +406,19 @@ def lint_programs():
                                          numerics_watch="on",
                                          shadow_wire="bf16",
                                          step_guard="on")),
+        # REAL narrow-wire production program (ISSUE 15): the flat-grad
+        # tail's codewords cross the sharding boundary as actual bf16
+        # buffers and the λ-regularized locator decodes them — ring
+        # budget, donation and host traffic unchanged, and the manifest
+        # REQUIRES bf16 in the module (a silently-f32 "narrow" ring
+        # program trips the dtype rule)
+        LintProgram("lm_sp_ring_wire_bf16_many_k2", route="sp",
+                    build=lambda: _build(
+                        "lm_sp_ring_wire_bf16_many_k2", True,
+                        mf=Manifest(collectives=LINT_COLLECTIVES,
+                                    allowed_dtypes=BF16_DTYPES,
+                                    required_dtypes=frozenset({"bf16"})),
+                        wire_dtype="bf16", step_guard="on")),
     ]
 
 

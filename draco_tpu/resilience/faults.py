@@ -26,6 +26,13 @@ Event grammar (``FaultPlan.parse``)::
                         injection path); the declarative time-varying-
                         adversary knob the autopilot scenarios use
     adversary@5-40:w2   ... a sustained adversary EPISODE (steps 5..40)
+    drift_grad@5-12     every worker's gradient is scaled by 2^-20 during
+                        the window — a finite numerics-drift injection
+                        (the whole wire's dynamic range drops a full
+                        histogram band, shifting the exponent histogram
+                        the ``numerics_drift`` incident detector watches,
+                        while staying far from f32/int8-scale underflow;
+                        ISSUE 15's autopilot wire_widen chaos cell)
     straggle@5:w3       worker 3 drops (sustained) from step 5 to the end
                         of the run — the heterogeneous-fleet / preempted-
                         worker fault the approx code family (ISSUE 8)
@@ -74,7 +81,16 @@ import numpy as np
 # the seeded host schedules before upload (over_budget → adversary rows,
 # straggle → straggler/present rows); host kinds fire in the host loop /
 # prefetcher; ckpt kinds are consumed by tools/chaos_run.py
-INGRAPH_KINDS = ("nan_grad", "inf_grad")
+INGRAPH_KINDS = ("nan_grad", "inf_grad", "drift_grad")
+
+# drift_grad's multiplicative payload: 2^-20 moves gradient-scale values
+# (~1e-2) down ~6 decades — more than one full exponent-histogram band
+# (obs/numerics.EXP_EDGES are 8-16 bins wide), so the numerics_drift
+# detector's TV-shift signal goes loud, while every derived quantity
+# (int8 per-block scales, squared energies in the decode health) stays in
+# the f32 normal range: the injection perturbs NUMERICS, never
+# finiteness or decode exactness
+DRIFT_GRAD_SCALE = 2.0 ** -20
 SCHEDULE_KINDS = ("over_budget", "straggle", "adversary")
 HOST_KINDS = ("prefetch_crash", "prefetch_hang", "sigterm")
 CKPT_KINDS = ("ckpt_corrupt", "ckpt_truncate")
@@ -83,7 +99,8 @@ FAULT_KINDS = INGRAPH_KINDS + SCHEDULE_KINDS + HOST_KINDS + CKPT_KINDS
 # kinds whose :d payload is an integer STEP count (dwell), not seconds
 _STEP_DWELL_KINDS = ("straggle", "adversary")
 # kinds whose target worker is drawn from the seeded stream when no :w
-_DRAWN_WORKER_KINDS = INGRAPH_KINDS + ("straggle", "adversary")
+# (drift_grad is fleet-wide — no victim to draw)
+_DRAWN_WORKER_KINDS = ("nan_grad", "inf_grad", "straggle", "adversary")
 
 _EVENT_RE = re.compile(r"^(?P<kind>[a-z_]+)@(?P<step>\d+)"
                        r"(?:-(?P<hi>\d+))?"
@@ -285,6 +302,13 @@ def corrupt_grads(grads, cfg, step):
             # event's stride grid — still branch-free, still config-static
             hit = ((s >= ev.step) & (s <= ev.step_hi)
                    & ((s - ev.step) % ev.every == 0))
+        if ev.kind == "drift_grad":
+            # fleet-wide multiplicative drift (no victim worker): the
+            # whole wire's dynamic range collapses during the window
+            grads = grads * jnp.where(
+                hit, jnp.asarray(DRIFT_GRAD_SCALE, grads.dtype),
+                jnp.asarray(1.0, grads.dtype))
+            continue
         row = jnp.arange(n) == ev.worker
         mask = mask | (hit & row)
         val = jnp.nan if ev.kind == "nan_grad" else jnp.inf

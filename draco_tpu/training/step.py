@@ -311,9 +311,20 @@ def build_train_setup(cfg: TrainConfig, mesh, dataset_name: Optional[str] = None
             # cfg.vote_check="exact" is the collision-free option for that
             # threat model (repetition.py module docstring, tier 3).
             vkey = drng.fold(jax.random.key(cfg.seed + 4), state.step)
+            # the REAL narrow wire (ISSUE 15): this family's wire IS the
+            # raw gradient rows — quantize them into narrow buffers (the
+            # shared noise draw keeps within-group rows bitwise identical,
+            # the vote's soundness condition; pinned in tests/test_wire.py)
+            # and vote over the widened rows. Identity on the f32 wire.
+            vote_rows = grads
+            if cfg.wire_dtype != "f32":
+                vote_rows, _wire = numerics_mod.narrow_wire_single(
+                    cfg, grads, step=state.step,
+                    constrain=lambda r: jax.lax.with_sharding_constraint(
+                        r, shard_w))
             with jax.named_scope("draco_decode"):
                 voted, vhealth = rep_mod.majority_vote(
-                    rep_code, grads, present=present, key=vkey,
+                    rep_code, vote_rows, present=present, key=vkey,
                     method=cfg.vote_check, with_health=True)
             new_state = apply_update(state, voted, new_stats)
             out = _metrics(losses, precs, present)
@@ -331,7 +342,7 @@ def build_train_setup(cfg: TrainConfig, mesh, dataset_name: Optional[str] = None
             if numerics_mod.watch_enabled(cfg):
                 if cfg.numerics_watch == "on":
                     out.update(numerics_mod.numerics_columns(
-                        cfg, [grads], [grads], voted))
+                        cfg, [grads], [vote_rows], voted))
                 if cfg.shadow_wire != "off":
                     out.update(numerics_mod.majvote_shadow(
                         cfg, rep_code, grads, voted, vhealth, vkey,
@@ -488,12 +499,30 @@ def build_train_setup(cfg: TrainConfig, mesh, dataset_name: Optional[str] = None
                     pw = present[:, None].astype(enc_re.dtype)
                     enc_re = enc_re * pw
                     enc_im = enc_im * pw
-                enc_re = jax.lax.with_sharding_constraint(enc_re, shard_w)
-                enc_im = jax.lax.with_sharding_constraint(enc_im, shard_w)
+                # the REAL narrow wire (ISSUE 15): the codeword pair is
+                # rounded into narrow bf16/int8 buffers — THE arrays that
+                # cross the worker-sharding boundary (the constraint pins
+                # them, not a widened copy) — and widened to f32 only for
+                # the decode. Identity (no added ops) on the f32 wire.
+                if cfg.wire_dtype != "f32":
+                    enc_re, enc_im, wire = numerics_mod.narrow_wire_pair(
+                        cfg, enc_re, enc_im, step=state.step,
+                        constrain=lambda r: jax.lax.with_sharding_constraint(
+                            r, shard_w))
+                else:
+                    wire = None
+                    enc_re = jax.lax.with_sharding_constraint(enc_re, shard_w)
+                    enc_im = jax.lax.with_sharding_constraint(enc_im, shard_w)
             # in-graph decode projection — no d-length program constant
             # (rng.random_projection_factors_in_graph docstring)
             rand_factor = drng.random_projection_factors_in_graph(cfg.seed,
                                                                   dim)
+            # quantization-aware flag threshold + locator λ for the narrow
+            # wire (obs/numerics.wire_decode_params; f32 keeps the exact
+            # HEALTH_REL_TOL / λ=0 path bitwise)
+            wire_tol, wire_lam = numerics_mod.wire_decode_params(cfg)
+            rel_tol = (cyclic_mod.HEALTH_REL_TOL if wire_tol is None
+                       else wire_tol)
             with jax.named_scope("draco_decode"):
                 if cfg.decode_granularity == "layer":
                     # per-parameter-tensor locator + projection, like the
@@ -501,13 +530,14 @@ def build_train_setup(cfg: TrainConfig, mesh, dataset_name: Optional[str] = None
                     decoded, honest_l, health = cyclic_mod.decode_layers(
                         code, enc_re, enc_im, rand_factor, leaf_offsets,
                         present=present, with_health=True,
-                        impl=decode_impl,
+                        impl=decode_impl, rel_tol=rel_tol, lam=wire_lam,
                     )
                     honest = jnp.all(honest_l, axis=0)
                 else:
                     decoded, honest, health = cyclic_mod.decode(
                         code, enc_re, enc_im, rand_factor, present=present,
-                        with_health=True, impl=decode_impl)
+                        with_health=True, impl=decode_impl,
+                        rel_tol=rel_tol, lam=wire_lam, wire=wire)
             new_state = apply_update(state, decoded, new_stats)
             out = _metrics(losses, precs, present)
             out["honest_located"] = jnp.sum(honest.astype(jnp.int32))
@@ -648,7 +678,7 @@ def lint_programs():
         kw.update(overrides)
         return TrainConfig(**kw)
 
-    def _build(name, cfg, many=False, k=2, bf16=False):
+    def _build(name, cfg, many=False, k=2, bf16=False, require=()):
         from draco_tpu import rng as drng, runtime
 
         mesh = runtime.make_mesh(cfg.num_workers)
@@ -657,11 +687,14 @@ def lint_programs():
         shape = input_shape(cfg.dataset)
         adv = drng.adversary_schedule(cfg.seed, k + 1, n,
                                      cfg.num_adversaries)
-        # the bf16 shadow wire's converts are whitelisted promotion sites;
-        # its programs carry bf16 element types by design (ISSUE 10)
+        # the bf16 shadow/real wire's converts are whitelisted promotion
+        # sites; those programs carry bf16 element types by design
+        # (ISSUES 10/15). ``require``: the narrow-wire manifests PIN their
+        # wire dtype in the module (rules.rule_dtype required_dtypes)
         manifest = Manifest(collectives={},
                             allowed_dtypes=(BF16_DTYPES if bf16
-                                            else DEFAULT_DTYPES))
+                                            else DEFAULT_DTYPES),
+                            required_dtypes=frozenset(require))
         extra = {"dim": setup.dim, "devices_in_mesh": int(mesh.devices.size)}
         if many:
             args = (setup.state,
@@ -716,6 +749,24 @@ def lint_programs():
            cfg=_cfg(approach="approx", worker_fail=0, redundancy="shared",
                     code_redundancy=1.5, numerics_watch="on",
                     shadow_wire="int8", shadow_round="stochastic")),
+        # REAL narrow-wire production programs (ISSUE 15): the codewords
+        # cross the sharding boundary as actual bf16 / int8(+f32 scale)
+        # buffers, widened only inside the decode — every invariant holds
+        # (zero explicit collectives, full donation, zero host traffic)
+        # AND the manifest REQUIRES the narrow element type in the module
+        # (required_dtypes): a silently-f32 "narrow" program trips the
+        # dtype rule (control_wide_narrow_wire is the live negative
+        # control). The bf16 row runs the λ-regularized locator +
+        # quantization-aware threshold on the K-fused scan; the int8 row
+        # adds stochastic shared-draw rounding on the approx family.
+        mk("cnn_cyclic_wire_bf16_many_k2",
+           cfg=_cfg(wire_dtype="bf16", step_guard="on"),
+           many=True, bf16=True, require=("bf16",)),
+        mk("cnn_approx_wire_int8_step",
+           cfg=_cfg(approach="approx", worker_fail=0, redundancy="shared",
+                    code_redundancy=1.5, wire_dtype="int8",
+                    shadow_round="stochastic"),
+           require=("i8",)),
         # fused-decode production programs (ISSUE 12): decode_impl="pallas"
         # resolves to the kernels' fused reference lowering on this CPU
         # host (ops/decode_kernels.resolve_decode_impl) — a plain XLA

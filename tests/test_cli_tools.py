@@ -970,6 +970,20 @@ def test_wire_study_tool(tmp_path):
     assert row["guard_trips_total"] == 0.0
     per = row["wire"]["bytes_per_worker"]
     assert per["bf16"] * 2 == per["f32"] and per["int8"] < per["bf16"]
+    # the REAL-wire cell (ISSUE 15) rides the same invocation: bounded
+    # end-to-end error vs the f32 twin, P/R 1.0 on the narrow wire's own
+    # flags, and the materialized bytes ARE the logical bf16 candidate
+    real = next(r for r in rep["rows"] if r.get("mode") == "real")
+    assert real["det_precision"] == 1.0 and real["det_recall"] == 1.0
+    assert 0.0 < real["end_to_end_err"] < 2e-2
+    assert real["wire"]["wire_dtype"] == "bf16"
+    assert real["wire"]["physical_bytes_per_worker"] \
+        == real["wire"]["bytes_per_worker"]["bf16"]
+    # the locator cells replay the PR 10 blocker: λ=0 reproduces it, the
+    # committed λ solves it
+    locs = {bool(r["regularized"]): r for r in rep["rows"]
+            if r.get("mode") == "locator" and r["dtype"] == "bf16"}
+    assert not locs[False]["usable"] and locs[True]["usable"]
 
 
 def test_wire_study_check_names_failures(tmp_path):
@@ -1053,6 +1067,132 @@ def test_perf_watch_gates_on_flipped_wire_metrics(tmp_path):
     regs = {r["metric"] for r in json.loads(out.read_text())["regressions"]}
     assert {"wire.cyclic.bf16.k4.det_preserved",
             "wire.cyclic.bf16.k4.det_recall_shadow"} <= regs
+
+
+def test_perf_watch_gates_on_flipped_real_wire_metrics(tmp_path):
+    """The ISSUE 15 real-wire fold: narrow-wire detection P/R and the
+    pinned end-to-end error gate at tolerance 0 in BOTH directions; the
+    physical bytes ride at the bytes tolerance (a ballooning wire gates,
+    an honest dim change inside tolerance does not); the locator cells'
+    blocker certificate is pinned BOTH ways — the λ=0 row silently
+    becoming usable gates exactly like the regularized row losing it."""
+    import json
+
+    from tools import perf_watch
+
+    root = tmp_path
+    (root / "baselines_out").mkdir()
+
+    def artifact(err=0.0002, prec=1.0, phys=214, unreg_usable=False,
+                 reg_usable=True):
+        rows = [
+            {"mode": "real", "family": "cyclic", "dtype": "int8", "k": 4,
+             "end_to_end_err": err, "det_precision": prec,
+             "det_recall": 1.0, "det_preserved": prec == 1.0,
+             "wire": {"bytes_per_worker": {"f32": 800, "bf16": 400,
+                                           "int8": 214},
+                      "wire_dtype": "int8",
+                      "physical_bytes_per_worker": phys},
+             "ok": True},
+            {"mode": "locator", "n": 32, "s": 3, "dtype": "int8",
+             "lam": 0.0, "regularized": False, "usable": unreg_usable,
+             "honest_dev_max_noadv": 136.9, "adv_dev_min": 0.333,
+             "ok": not unreg_usable},
+            {"mode": "locator", "n": 32, "s": 3, "dtype": "int8",
+             "lam": 0.015625, "regularized": True, "usable": reg_usable,
+             "honest_dev_max_noadv": 0.24, "adv_dev_min": 0.333,
+             "ok": reg_usable},
+        ]
+        return {"all_ok": all(r["ok"] for r in rows), "rows": rows}
+
+    path = root / "baselines_out" / "wire_study.json"
+    path.write_text(json.dumps(artifact()))
+    assert perf_watch.main(["--root", str(root), "--snapshot"]) == 0
+    snap = json.loads(
+        (root / "baselines_out" / "perf_watch.json").read_text())
+    for key in ("wire.real.cyclic.int8.k4.det_precision",
+                "wire.real.cyclic.int8.k4.end_to_end_err",
+                "wire.real.cyclic.int8.k4.physical_bytes_per_worker",
+                "wire.locator.n32s3.int8.unreg.usable",
+                "wire.locator.n32s3.int8.reg.usable"):
+        assert key in snap["metrics"], key
+    assert perf_watch.main(["--root", str(root)]) == 0  # clean
+
+    out = root / "report.json"
+    # end-to-end err is PINNED: an IMPROVEMENT gates too
+    path.write_text(json.dumps(artifact(err=0.0001)))
+    assert perf_watch.main(["--root", str(root), "--json", str(out)]) == 1
+    regs = {r["metric"] for r in json.loads(out.read_text())["regressions"]}
+    assert "wire.real.cyclic.int8.k4.end_to_end_err" in regs
+
+    # lost precision on the real wire gates as ok-kind
+    path.write_text(json.dumps(artifact(prec=0.8)))
+    assert perf_watch.main(["--root", str(root), "--json", str(out)]) == 1
+    regs = {r["metric"] for r in json.loads(out.read_text())["regressions"]}
+    assert "wire.real.cyclic.int8.k4.det_precision" in regs
+
+    # a ballooning physical wire gates at the bytes tolerance
+    path.write_text(json.dumps(artifact(phys=800)))
+    assert perf_watch.main(["--root", str(root), "--json", str(out)]) == 1
+    regs = {r["metric"] for r in json.loads(out.read_text())["regressions"]}
+    assert "wire.real.cyclic.int8.k4.physical_bytes_per_worker" in regs
+
+    # the blocker certificate flips BOTH ways
+    path.write_text(json.dumps(artifact(unreg_usable=True)))
+    assert perf_watch.main(["--root", str(root), "--json", str(out)]) == 1
+    regs = {r["metric"] for r in json.loads(out.read_text())["regressions"]}
+    assert "wire.locator.n32s3.int8.unreg.usable" in regs
+    path.write_text(json.dumps(artifact(reg_usable=False)))
+    assert perf_watch.main(["--root", str(root), "--json", str(out)]) == 1
+    regs = {r["metric"] for r in json.loads(out.read_text())["regressions"]}
+    assert "wire.locator.n32s3.int8.reg.usable" in regs
+
+
+def test_wire_study_check_real_and_locator_rows(tmp_path):
+    """wire_study --check (ISSUE 15): the committed artifact passes; a
+    mutated real row (physical bytes diverging from the ledger, P/R
+    dropping) or a flipped locator certificate is caught and named."""
+    import copy
+    import json
+
+    from tools import wire_study
+
+    committed = os.path.join(REPO, "baselines_out", "wire_study.json")
+    data = json.load(open(committed))
+    assert wire_study.main(["--check", "--artifact", committed]) == 0
+
+    bad = tmp_path / "wire_study.json"
+
+    def mutate(fn):
+        d = copy.deepcopy(data)
+        fn(d)
+        bad.write_text(json.dumps(d))
+        return wire_study.main(["--check", "--artifact", str(bad)])
+
+    def first(d, mode):
+        return next(r for r in d["rows"] if r.get("mode") == mode)
+
+    # materialized bytes diverging from the logical candidate row
+    assert mutate(lambda d: first(d, "real")["wire"].update(
+        physical_bytes_per_worker=999999)) == 1
+    # detection lost on the real wire
+    def drop_pr(d):
+        r = next(r for r in d["rows"] if r.get("mode") == "real"
+                 and r["family"] == "cyclic")
+        r["det_precision"] = 0.5
+    assert mutate(drop_pr) == 1
+    # the λ=0 blocker "solved" (exact path changed) trips
+    def flip_unreg(d):
+        r = next(r for r in d["rows"] if r.get("mode") == "locator"
+                 and not r["regularized"])
+        r["usable"] = True
+    assert mutate(flip_unreg) == 1
+    # the regularized threshold drifting off the committed table trips
+    def drift_thr(d):
+        r = next(r for r in d["rows"] if r.get("mode") == "locator"
+                 and r["regularized"])
+        r["threshold"] = r["threshold"] * 2
+    assert mutate(drift_thr) == 1
 
 
 def test_perf_watch_gates_on_flipped_chaos_numerics(tmp_path):

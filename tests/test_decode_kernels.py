@@ -344,7 +344,11 @@ def test_kernel_programs_export_for_tpu():
 
     progs = decode_kernels.lint_programs()
     assert {p.name for p in progs} == {"kernel_cyclic_locator",
-                                       "kernel_approx_decode"}
+                                       "kernel_approx_decode",
+                                       "kernel_cyclic_narrow_recombine",
+                                       "kernel_approx_decode_narrow",
+                                       "kernel_cyclic_narrow_recombine_bf16",
+                                       "kernel_approx_decode_narrow_bf16"}
     for prog in progs:
         bp = prog.build()
         exp = jexport.export(bp.fn, platforms=["tpu"])(*[

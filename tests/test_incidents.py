@@ -134,6 +134,23 @@ def test_approx_residual_drift_toward_bound():
         eng2.observe({"step": s, "loss": 1.0, "decode_residual": 1.5,
                       "decode_residual_bound": 1.0})
     assert eng2.total_onsets == 1
+    # narrow-wire slack (ISSUE 15): on a bf16/int8 wire the measured
+    # residual carries quantization error the analytic bound (drops only)
+    # does not price — make_engine widens the approx branch by the dtype's
+    # slack (same widening guards.assess applies), so a clean int8-wire
+    # run sitting just past the bound is NOT an incident (the slack comes
+    # off the measured residual before BOTH the violation check and the
+    # EW drift ratio), while a real violation past the slack still fires
+    eng3 = inc.IncidentEngine(
+        thresholds={"decode_residual.slack": 0.1})
+    for s in range(1, 12):
+        eng3.observe({"step": s, "loss": 1.0, "decode_residual": 1.04,
+                      "decode_residual_bound": 1.0})
+    assert eng3.total_onsets == 0
+    for s in (12, 13):
+        eng3.observe({"step": s, "loss": 1.0, "decode_residual": 1.5,
+                      "decode_residual_bound": 1.0})
+    assert eng3.total_onsets == 1
 
 
 @pytest.mark.core

@@ -79,7 +79,17 @@ from draco_tpu.cli import maybe_force_cpu_mesh  # noqa: E402
 
 FAULTS = ("nan_grad", "over_budget", "prefetch_crash", "prefetch_hang",
           "sigterm", "ckpt_corrupt", "ckpt_truncate", "straggle",
-          "adversary")
+          "adversary", "drift_grad")
+# the autopilot REAL-wire cell (ISSUE 15): an int8-wire run under the
+# declarative drift_grad window must raise the numerics_drift incident AND
+# the autopilot must actuate — a `wire_widen` remediation moving the wire
+# dtype one f32-ward step as a warm program swap, recorded + attributed in
+# incidents.jsonl. Only the dedicated ap_wire loop runs it.
+WIRE_FAULTS = ("drift_grad",)
+# drift window end: covers the second chunk so the widened regime actually
+# dispatches (boundaries at 4/8/12; the episode opens ~step 7, the widen
+# fires at boundary 8, chunk 9-12 runs on the widened wire)
+WIRE_MAX_STEPS = 12
 # the declarative within-budget adversary episode (faults.apply_adversary)
 # runs on the dedicated random-attack loops: cfg.err_mode="random" (the
 # seeded random-gradient attack, ISSUE 14 satellite — a reference TODO
@@ -204,6 +214,15 @@ def _loops():
     # trains attack-free
     rand_kw = dict(err_mode="random", adversary_count=0)
 
+    # the autopilot wire-dial loop (ISSUE 15): a REAL int8 wire with the
+    # policy engine live — drift_grad must widen it (WIRE_FAULTS).
+    # adversary_count=0 isolates the drift: the cell's surface is the
+    # numerics_drift → wire_widen chain, not the (separately-celled)
+    # Byzantine detection path — a live adversary would legitimately
+    # collapse trust and blur the incident contract
+    ap_wire_kw = dict(wire_dtype="int8", autopilot="on",
+                      adversary_count=0, max_steps=WIRE_MAX_STEPS)
+
     return {
         "cnn_k1": (with_k(cnn_cfg, 1), cnn_run),
         "cnn_k4": (with_k(cnn_cfg, 4), cnn_run),
@@ -214,6 +233,7 @@ def _loops():
         "approx_k4": (with_k(cnn_cfg, 4, **approx_kw), cnn_run),
         "cnn_rand_k1": (with_k(cnn_cfg, 1, **rand_kw), cnn_run),
         "cnn_rand_k4": (with_k(cnn_cfg, 4, **rand_kw), cnn_run),
+        "ap_wire_k4": (with_k(cnn_cfg, 4, **ap_wire_kw), cnn_run),
     }
 
 
@@ -358,6 +378,11 @@ def _expected_incidents(loop, fault):
         # excised by the decode — one accusation cannot collapse EW trust
         # (the hysteresis), so NO incident may open
         return [], set()
+    if fault == "drift_grad":
+        # the declarative drift window must raise numerics_drift (no
+        # worker to name — the whole wire drifts); the regime swap's
+        # compile pause may dent a beat (throughput tolerated)
+        return [("numerics_drift", None)], {"throughput"}
     # sigterm (graceful preemption), ckpt_* (offline recovery): the
     # resilience layer absorbs these with clean telemetry, and a spurious
     # incident is exactly the flapping the hysteresis exists to prevent
@@ -484,6 +509,10 @@ def run_case(loop: str, fault: str, make_cfg, run, clean_vec, workdir):
     # loop simply rides out (4 s keeps the matrix quick)
     step = SIGTERM_STEP if fault == "sigterm" else FAULT_STEP
     spec = f"{fault}@{step}"
+    if fault == "drift_grad":
+        # declarative window covering the rest of the run, so the widened
+        # regime's chunk dispatches while the drift is still live
+        spec = f"drift_grad@{step}-{WIRE_MAX_STEPS}"
     if fault == "nan_grad":
         spec += f":w{NAN_WORKER}"  # named victim — the attribution target
     if fault == "adversary":
@@ -555,6 +584,39 @@ def run_case(loop: str, fault: str, make_cfg, run, clean_vec, workdir):
         else:
             row["detail"] = ("straggle cell not bounded-degraded: "
                              f"{verdict}")
+        return row
+    if fault == "drift_grad":
+        # the autopilot wire-dial cell (ISSUE 15): the injected numerics
+        # drift must be SEEN (numerics_drift incident — checked by the
+        # incident contract) and ACTED ON — a `wire_widen` remediation in
+        # incidents.jsonl moving the regime's wire dtype one f32-ward
+        # step, attributed to the drift episode. The drift itself is
+        # finite by construction, so the run must finish clean (no guard
+        # trips — a guarded drift cell would mean the injection broke the
+        # decode instead of the numerics).
+        from draco_tpu.obs import replay
+
+        rems = [e for e in replay.iter_jsonl(
+            os.path.join(d, "incidents.jsonl"))
+            if e.get("event") == "remediation"]
+        widens = [r for r in rems if r.get("action") == "wire_widen"]
+        row["remediations"] = [r.get("action") for r in rems]
+        row["widened"] = bool(widens)
+        row["widen_attributed"] = bool(widens) and all(
+            (r.get("trigger") or {}).get("type")
+            in ("numerics_drift", "decode_residual") for r in widens)
+        row["wire_dtype_after"] = (
+            ((widens[-1].get("regime") or {}).get("wire_dtype"))
+            if widens else None)
+        if (row["final_finite"] and status.get("state") == "done"
+                and row["guard_trips"] == 0 and row["widened"]
+                and row["widen_attributed"]):
+            row.update(ok=True, outcome="wire_widened")
+        else:
+            row["detail"] = (f"drift cell not widened cleanly: "
+                             f"widened={row['widened']} attributed="
+                             f"{row['widen_attributed']} "
+                             f"guard_trips={row['guard_trips']}")
         return row
     if fault == "adversary":
         # the random-attack cell (ISSUE 14 satellite): the seeded random
@@ -647,9 +709,12 @@ def main(argv=None) -> int:
         elif loop.startswith("cnn_rand"):
             # the random-attack loops run exactly the adversary episode
             faults = [f for f in pick_faults if f in RAND_FAULTS]
+        elif loop.startswith("ap_wire"):
+            # the autopilot wire-dial loop runs exactly the drift episode
+            faults = [f for f in pick_faults if f in WIRE_FAULTS]
         else:
             faults = [f for f in pick_faults
-                      if f not in ("straggle",) + RAND_FAULTS
+                      if f not in ("straggle",) + RAND_FAULTS + WIRE_FAULTS
                       and not (eager and f not in EAGER_FAULTS)]
         if not faults:
             continue

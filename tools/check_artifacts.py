@@ -13,7 +13,9 @@ exits nonzero NAMING THE FIRST FAILURE:
   device_profile      --check: sums/cross-check/control of the committed
                       device-time ledger
   wire_study          --check: ledger arithmetic + bf16 detection pins of
-                      the committed shadow-wire matrix
+                      the committed shadow-wire matrix, plus (ISSUE 15)
+                      the real-wire rows' P/R + physical-bytes pins and
+                      the n=32 s=3 regularized-locator certificate
   decode_kernel_bench --check: ratio arithmetic + gated-rung
                       kernel-not-slower pins of the committed fused-decode
                       microbench (ISSUE 12)

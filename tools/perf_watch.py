@@ -401,14 +401,17 @@ def fold_autopilot(root: str, metrics: dict) -> None:
 
 
 def fold_wire_study(root: str, metrics: dict) -> None:
-    """Wire-study artifact (tools/wire_study.py, ISSUE 10): the shadow
-    residual and flag-agreement columns are PINNED at tolerance 0 in both
-    directions — a deterministic seeded decode of a deterministically
-    quantized wire moving AT ALL is a semantic change (the flipped-row
-    control in tests/test_cli_tools.py proves the gate live). The
-    detection-preserved bool and shadow detection P/R gate as 0-tolerance
-    ok-kind; logical wire bytes ride at the bytes tolerance so a ledger
-    drift (dim change) shows up without gating honest model edits."""
+    """Wire-study artifact (tools/wire_study.py, ISSUES 10 + 15): the
+    shadow residual and flag-agreement columns are PINNED at tolerance 0
+    in both directions — a deterministic seeded decode of a
+    deterministically quantized wire moving AT ALL is a semantic change
+    (the flipped-row control in tests/test_cli_tools.py proves the gate
+    live). The detection-preserved bool and shadow detection P/R gate as
+    0-tolerance ok-kind; wire bytes ride at the bytes tolerance so a
+    ledger drift (dim change) shows up without gating honest model edits.
+    The ISSUE 15 REAL-wire rows add narrow-wire detection P/R (ok-kind),
+    the pinned end-to-end error, and PHYSICAL bytes/worker; the locator
+    cells pin the n=32 s=3 blocker certificate in both directions."""
     path = os.path.join(root, "baselines_out", "wire_study.json")
     data = _read_json(path)
     if not isinstance(data, dict):
@@ -418,8 +421,54 @@ def fold_wire_study(root: str, metrics: dict) -> None:
         metrics["wire.all_ok"] = {"value": float(bool(data["all_ok"])),
                                   "kind": "ok", "source": src}
     for row in data.get("rows", []):
+        mode = row.get("mode", "shadow")
+        if mode == "locator":
+            # ISSUE 15 locator cells: the blocker certificate is PINNED in
+            # both directions — the λ=0 row silently becoming usable means
+            # the exact path changed; the regularized row losing usability
+            # means the blocker is back. Margins pin too (deterministic
+            # seeded trials).
+            n, s, dtype = row.get("n"), row.get("s"), row.get("dtype")
+            if n is None or dtype is None:
+                continue
+            reg = "reg" if row.get("regularized") else "unreg"
+            key = f"wire.locator.n{n}s{s}.{dtype}.{reg}"
+            metrics[f"{key}.usable"] = {
+                "value": float(bool(row.get("usable"))), "kind": "pinned",
+                "source": src}
+            for col in ("honest_dev_max_noadv", "adv_dev_min"):
+                if isinstance(row.get(col), (int, float)):
+                    metrics[f"{key}.{col}"] = {
+                        "value": float(row[col]), "kind": "pinned",
+                        "source": src}
+            continue
         fam, dtype, k = row.get("family"), row.get("dtype"), row.get("k")
         if fam is None or dtype is None or k is None:
+            continue
+        if mode == "real":
+            # ISSUE 15 real-wire rows: detection P/R on the narrow wire's
+            # own flags + the end-to-end error pinned at tolerance 0
+            # (deterministic seeded runs of a deterministic quantizer);
+            # PHYSICAL bytes at the bytes tolerance
+            key = f"wire.real.{fam}.{dtype}.k{k}"
+            for col in ("det_precision", "det_recall"):
+                if isinstance(row.get(col), (int, float)):
+                    metrics[f"{key}.{col}"] = {
+                        "value": float(row[col]), "kind": "ok",
+                        "source": src}
+            if isinstance(row.get("end_to_end_err"), (int, float)):
+                metrics[f"{key}.end_to_end_err"] = {
+                    "value": float(row["end_to_end_err"]),
+                    "kind": "pinned", "source": src}
+            metrics[f"{key}.det_preserved"] = {
+                "value": float(bool(row.get("det_preserved"))),
+                "kind": "ok", "source": src}
+            w = row.get("wire") or {}
+            if isinstance(w.get("physical_bytes_per_worker"),
+                          (int, float)):
+                metrics[f"{key}.physical_bytes_per_worker"] = {
+                    "value": float(w["physical_bytes_per_worker"]),
+                    "kind": "bytes", "source": src}
             continue
         key = f"wire.{fam}.{dtype}.k{k}"
         for col in ("shadow_err_max", "shadow_residual_max",

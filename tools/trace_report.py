@@ -322,6 +322,13 @@ def print_table(report: dict, out=None) -> None:
                                  f"({b[dt] / f32:.2f}x)")
         if wire.get("shadow_wire", "off") != "off":
             parts.append(f"shadow={wire['shadow_wire']}")
+        # the MATERIALIZED wire (ISSUE 15): what the run physically ships
+        if wire.get("wire_dtype", "f32") != "f32":
+            phys = wire.get("physical_bytes_per_worker")
+            tag = f"materialized={wire['wire_dtype']}"
+            if phys:
+                tag += f" ({phys / 1024:.1f} KiB/worker/step physical)"
+            parts.append(tag)
         print("   ".join(parts), file=out)
     nx = (status or {}).get("numerics")
     if nx:
