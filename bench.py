@@ -378,6 +378,7 @@ def measure(args, metric_name, error=None, detail=None):
         eval_freq=0,
         train_dir="",
         log_every=10**9,
+        wire_segments=args.wire_segments,
     )
 
     # On a host-CPU run (the tpu-unavailable fallback) the r=2s+1 simulate
@@ -445,6 +446,14 @@ def measure(args, metric_name, error=None, detail=None):
         base_extra["wire_bytes_per_worker"] = \
             ledger["bytes_per_worker"]["f32"]
         base_extra["wire_dim"] = ledger["dim"]
+        # streaming segmented wire (ISSUE 16): the segment count the
+        # timed program decoded with and the ledger's per-segment
+        # PHYSICAL bytes — tools/segment_study.py --check and the
+        # wire_study checker pin that these sum to the per-step row
+        seg = ledger.get("segments") or {}
+        base_extra["wire_segments"] = seg.get("count", 1)
+        base_extra["wire_segment_bytes_per_step"] = \
+            seg.get("physical_bytes_per_step")
     peak = _peak_flops(device_kind)
     mfu = (
         round(flops_c / t_cyclic / peak, 4)
@@ -563,6 +572,10 @@ def main():
     p.add_argument("--network", type=str, default="ResNet18")
     p.add_argument("--num-workers", type=int, default=8)
     p.add_argument("--cpu-mesh", type=int, default=0)
+    p.add_argument("--wire-segments", type=int, default=1,
+                   help="wire segmentation S for the timed programs "
+                        "(ISSUE 16); the record carries "
+                        "extra.wire_segments + per-segment ledger bytes")
     p.add_argument("--budget", type=float,
                    default=float(os.environ.get("DRACO_BENCH_BUDGET", "280")),
                    help="hard total wall-clock budget in seconds; a JSON "

@@ -289,3 +289,77 @@ def _decode_fused(code: ApproxCode, rows, present, with_health, batch_grads,
         "recovered_fraction": recovered_fraction(code, present),
     }
     return decoded, v, health
+
+
+def decode_segments(code: ApproxCode, rows: jnp.ndarray, bounds,
+                    present: Optional[jnp.ndarray] = None,
+                    with_health: bool = False,
+                    batch_grads: Optional[jnp.ndarray] = None,
+                    impl: str = "xla", wire=None):
+    """Streaming segmented partial-recovery decode (ISSUE 16): ``bounds``
+    are the quantum-aligned segment cuts (obs/numerics.wire_segment_bounds,
+    len S+1) and each [a, b) wire segment is decoded independently as it
+    would arrive.
+
+    Segment algebra: the optimal-decoding weight solve is PRESENCE-only —
+    it never touches d — so it runs ONCE and every segment combines with
+    the identical ``v`` (``bound`` and ``recovered_fraction`` are likewise
+    d-independent, hence per-step by construction). The decode matvec is
+    column-separable over d, so per-segment combination assembled by
+    dynamic_update_slice equals the unsegmented decode up to accumulation
+    order (bounded-err); the residual's two squared-norm accumulators fold
+    ACROSS segments before the final sqrt, so the health verdict stays one
+    per step. On the kernel path each segment streams its own slice of the
+    narrow buffers (ops/decode_kernels.approx_decode_segment — the
+    segment-offset entry point, no new kernels).
+
+    Returns ``(decoded, v[, health])`` — the same contract as
+    :func:`decode`."""
+    import jax
+
+    n = code.n
+    bounds = [int(o) for o in bounds]
+    segs = list(zip(bounds[:-1], bounds[1:]))
+    d = rows.shape[-1]
+    v, u, bound = decode_weights(code, present)
+    pres_b = (jnp.ones((n,), bool) if present is None
+              else jnp.asarray(present).astype(bool))
+    if with_health and batch_grads is None:
+        raise ValueError("with_health=True needs batch_grads (the (n, d) "
+                         "pre-mask batch-gradient matrix) to measure the "
+                         "residual against the true sum")
+    use_kernel = impl in ("pallas", "pallas_interpret") and with_health
+    if use_kernel:
+        from draco_tpu.ops import decode_kernels
+
+        if not decode_kernels.narrow_kernel_ok(wire):
+            wire = None
+    rows_m = jnp.where(pres_b[:, None], rows, jnp.zeros_like(rows))
+    out = jnp.zeros((d,), jnp.float32)
+    sq_diff = jnp.zeros((), jnp.float32)
+    sq_g = jnp.zeros((), jnp.float32)
+    for a, b in segs:
+        if use_kernel:
+            seg, sd, sg = decode_kernels.approx_decode_segment(
+                rows, batch_grads, v, pres_b, a, b,
+                interpret=(impl == "pallas_interpret"), wire=wire)
+            sq_diff = sq_diff + sd
+            sq_g = sq_g + sg
+        else:
+            seg = jnp.matmul(v / n, rows_m[:, a:b])
+            if with_health:
+                bg = batch_grads[:, a:b]
+                true_mean = jnp.matmul(
+                    jnp.full((n,), 1.0 / n, jnp.float32), bg)
+                sq_diff = sq_diff + jnp.sum((seg - true_mean) ** 2)
+                sq_g = sq_g + jnp.sum(bg.astype(jnp.float32) ** 2)
+        out = jax.lax.dynamic_update_slice(out, seg, (a,))
+    if not with_health:
+        return out, v
+    scale = jnp.maximum(jnp.sqrt(sq_g) / n, 1e-30)
+    health = {
+        "residual": jnp.sqrt(sq_diff) / scale,
+        "bound": bound,
+        "recovered_fraction": recovered_fraction(code, present),
+    }
+    return out, v, health

@@ -233,6 +233,22 @@ def encode_shared(code: CyclicCode, batch_grads: jnp.ndarray):
     )
 
 
+def encode_segment(code: CyclicCode, batch_grads: jnp.ndarray, a: int,
+                   b: int):
+    """Per-segment encode for the streaming segmented wire (ISSUE 16):
+    the encode is a d-column-separable matmul, so the [a, b) slice of the
+    full encode equals encoding the [a, b) gradient columns —
+    ``encode_shared(code, g)[..][:, a:b] == encode_segment(code, g, a, b)``
+    bitwise (identical contractions over the same operand columns). This
+    is what lets workers emit per-segment codeword messages without any
+    new encode weights: the segment-sliced weights ARE the full weights.
+    """
+    return ops_coded.complex_matmul(
+        jnp.asarray(code.w_masked_re), jnp.asarray(code.w_masked_im),
+        batch_grads[..., a:b]
+    )
+
+
 # --------------------------------------------------------------------------
 # Decode (replicated phase; reference: cyclic_master.py:152-173 +
 # c_coding.cpp:15-84)
@@ -765,6 +781,92 @@ def decode_layers(code: CyclicCode, r_re: jnp.ndarray, r_im: jnp.ndarray,
         code, e_re_l, e_im_l, present, rel_tol, impl, lam=lam)
     decoded = _recombine_layers_fused(n, v_re_l / n, v_im_l / n, bounds,
                                       r_re, r_im)
+    if with_health:
+        health = {"residual": jnp.max(resid_l),
+                  "flagged": jnp.any(flagged_l, axis=0),
+                  "loud": jnp.any(loud_l, axis=0)}
+        return decoded, honest_l, health
+    return decoded, honest_l
+
+
+def decode_segments(code: CyclicCode, r_re: jnp.ndarray, r_im: jnp.ndarray,
+                    rand_factor: jnp.ndarray, bounds,
+                    present: Optional[jnp.ndarray] = None,
+                    with_health: bool = False,
+                    rel_tol: float = HEALTH_REL_TOL, impl: str = "xla",
+                    lam: float = 0.0, wire=None):
+    """Streaming segmented decode (ISSUE 16; arXiv:1903.01974's
+    multi-message communication): one locator per WIRE SEGMENT instead of
+    one per layer — ``bounds`` are the quantum-aligned segment cuts
+    (obs/numerics.wire_segment_bounds; len S+1), segment j =
+    [bounds[j], bounds[j+1]).
+
+    Segment algebra: each segment gets its own projection column (a slice
+    of the same (d,) factor), its own syndrome + Hankel locator solve and
+    its own recombination vector — exactly the layer-granularity decode's
+    structure (:func:`decode_layers`), so the same correctness argument
+    applies: the wire protocol corrupts whole ROWS, so every segment of a
+    corrupt row carries that row's error and every segment's locator sees
+    it; a straggler erasure zero-fills all its segments under the same
+    present mask. The per-step accusation/health verdict is the FOLD
+    across segments — residual = worst segment (a single inconsistent
+    segment is a fault), flagged/loud = union (a row corrupt in any
+    segment's coordinates is a located error) — so detection P/R, guards,
+    incidents and the autopilot keep seeing one verdict per step.
+
+    Unlike :func:`decode_layers`, segment cuts ARE aligned to the narrow
+    wire's per-block scale tiling (the bounds contract), so the
+    narrow-ingest recombination applies per segment: on the kernel path
+    each segment streams its own slice of the REAL narrow buffers and
+    dequantizes in-tile (ops/decode_kernels.wire_slice_pair — the
+    segment-offset entry point, no new kernels).
+
+    Returns ``(decoded (d,), honest (S', n)[, health])`` — callers fold
+    honest with ``jnp.all(axis=0)`` like the layer path. S'=len(bounds)-1.
+    """
+    n = code.n
+    bounds = [int(o) for o in bounds]
+    segs = list(zip(bounds[:-1], bounds[1:]))
+    e_res, e_ims = [], []
+    for a, b in segs:
+        e_re, e_im = ops_coded.complex_project(
+            r_re[:, a:b], r_im[:, a:b], rand_factor[a:b]
+        )
+        e_res.append(e_re)
+        e_ims.append(e_im)
+    e_re_l = jnp.stack(e_res)  # (S', n)
+    e_im_l = jnp.stack(e_ims)
+    if impl == "xla":
+        v_re_l, v_im_l, honest_l, health_l = jax.vmap(
+            lambda er, ei: _locate_v(code, er, ei, present, rel_tol, lam)
+        )(e_re_l, e_im_l)
+        decoded = _recombine_layers_fused(n, v_re_l / n, v_im_l / n,
+                                          bounds, r_re, r_im)
+        if with_health:
+            health = {"residual": jnp.max(health_l["residual"]),
+                      "flagged": jnp.any(health_l["flagged"], axis=0),
+                      "loud": jnp.any(health_l["loud"], axis=0),
+                      "dev_rel": jnp.max(health_l["dev_rel"], axis=0)}
+            return decoded, honest_l, health
+        return decoded, honest_l
+    v_re_l, v_im_l, honest_l, flagged_l, loud_l, resid_l = _run_locator(
+        code, e_re_l, e_im_l, present, rel_tol, impl, lam=lam)
+    from draco_tpu.ops import decode_kernels
+
+    if (impl in ("pallas", "pallas_interpret")
+            and decode_kernels.narrow_kernel_ok(wire)):
+        # per-segment narrow ingest: each segment's recombination streams
+        # its own slice of the narrow buffers (decode-on-arrival unit)
+        out = jnp.zeros((r_re.shape[1],), jnp.float32)
+        for i, (a, b) in enumerate(segs):
+            seg = decode_kernels.cyclic_narrow_recombine_segment(
+                v_re_l[i] / n, v_im_l[i] / n, wire, a, b,
+                interpret=(impl == "pallas_interpret"))
+            out = jax.lax.dynamic_update_slice(out, seg, (a,))
+        decoded = out
+    else:
+        decoded = _recombine_layers_fused(n, v_re_l / n, v_im_l / n,
+                                          bounds, r_re, r_im)
     if with_health:
         health = {"residual": jnp.max(resid_l),
                   "flagged": jnp.any(flagged_l, axis=0),

@@ -260,6 +260,24 @@ class TrainConfig:
     # a candidate dtype against the f32 wire, which a narrow wire no
     # longer ships).
     wire_dtype: str = "f32"  # f32 | bf16 | int8
+    # --- streaming segmented wire (ISSUE 16; ROADMAP item 3) ---
+    # Split the d dimension of the coded wire into this many segments:
+    # workers emit per-segment codeword buffers (narrow under wire_dtype,
+    # with per-segment int8 block scales) and the aggregator decodes each
+    # segment as it arrives instead of waiting for the full (n, d) wire —
+    # the arXiv:1903.01974 multi-message communication pattern. 1 (the
+    # default) keeps today's single-message wire bit-for-bit. S > 1 cuts
+    # at multiples of the segment quantum (obs/numerics.wire_segment_bounds:
+    # TILE_D when d admits it, else shadow_block), which keeps the int8
+    # per-block scales and the shared stochastic-rounding draws segment-
+    # invariant — quantize-then-slice equals slice-then-quantize bitwise,
+    # so the narrow buffers are unchanged and only the DECODE is
+    # segmented. Syndromes and located-row sets are computed per segment;
+    # the health/forensics columns fold across segments (residual = max,
+    # flagged/loud = union) so guards, detection P/R, incidents and the
+    # autopilot see one verdict per step. Coded approaches (cyclic/approx)
+    # only; d smaller than the quantum collapses back to one segment.
+    wire_segments: int = 1
     # Shadow-quantized wire (obs/numerics.py): round the codewords to the
     # narrow dtype INSIDE the step body, decode the shadow copy alongside
     # the f32 path, and emit shadow_err / shadow_residual /
@@ -559,6 +577,20 @@ class TrainConfig:
                         f"approach=approx (no locator to amplify the "
                         f"quantization noise)"
                     )
+        if self.wire_segments < 1:
+            raise ValueError(
+                f"wire_segments must be >= 1, got {self.wire_segments}"
+            )
+        if self.wire_segments > 1 and self.approach not in (
+                "cyclic", "maj_vote", "approx"):
+            # segmentation slices the coded wire; the baseline path ships
+            # raw rows with no decode to segment. (maj_vote's group-replica
+            # vote is row-wise, not d-separable — its segmentation is
+            # wire/ledger-only and the vote verdict is unchanged.)
+            raise ValueError(
+                "wire_segments > 1 requires a coded approach "
+                f"(cyclic|maj_vote|approx), got {self.approach!r}"
+            )
         if self.shadow_round not in ("nearest", "stochastic"):
             raise ValueError(
                 f"shadow_round must be nearest|stochastic, got "

@@ -89,6 +89,16 @@ DEFAULT_POLICY: Dict[str, float] = {
     # narrows one step back toward the configured dtype
     "wire_widen_boundaries": 1.0,
     "wire_narrow_boundaries": 4.0,
+    # streaming-segment dial (ISSUE 16): boundaries of straggle evidence
+    # before the wire segment count doubles (decode-on-arrival shortens
+    # the tail a slow worker's last byte adds), capped at segments_max;
+    # boundaries of straggle-quiet evidence before it halves back toward
+    # the configured count (never past it). The segment dial fires BEFORE
+    # the family dial-down — it keeps the exactness certificate, so it is
+    # the cheap first rung of the straggler escalation ladder.
+    "segments_up_boundaries": 1.0,
+    "segments_down_boundaries": 4.0,
+    "segments_max": 4.0,
 }
 
 # incident types that count as ADVERSARY evidence: any of these open (or
@@ -134,6 +144,10 @@ class Regime:
     redundancy: float
     shadow_wire: str
     wire_dtype: str = "f32"
+    # streaming segmented wire (ISSUE 16): the segments_up/segments_down
+    # remediations move this along 1 ↔ 2 ↔ 4 ... (capped by policy
+    # segments_max) as warm cached program swaps
+    wire_segments: int = 1
 
     @property
     def tag(self) -> str:
@@ -142,19 +156,23 @@ class Regime:
             t += f"_{self.shadow_wire}"
         if self.wire_dtype != "f32":
             t += f"_wire{self.wire_dtype}"
+        if self.wire_segments != 1:
+            t += f"_seg{self.wire_segments}"
         return t
 
     def as_dict(self) -> dict:
         return {"approach": self.approach, "redundancy": self.redundancy,
                 "shadow_wire": self.shadow_wire,
-                "wire_dtype": self.wire_dtype, "tag": self.tag}
+                "wire_dtype": self.wire_dtype,
+                "wire_segments": self.wire_segments, "tag": self.tag}
 
 
 def base_regime(cfg) -> Regime:
     r = (2 * cfg.worker_fail + 1 if cfg.approach == "cyclic"
          else float(cfg.code_redundancy))
     return Regime(cfg.approach, float(r), cfg.shadow_wire,
-                  getattr(cfg, "wire_dtype", "f32"))
+                  getattr(cfg, "wire_dtype", "f32"),
+                  int(getattr(cfg, "wire_segments", 1)))
 
 
 def regime_cfg(base_cfg, regime: Regime, quarantined: int = 0):
@@ -167,7 +185,8 @@ def regime_cfg(base_cfg, regime: Regime, quarantined: int = 0):
     from draco_tpu.resilience.faults import INGRAPH_KINDS, plan_from_cfg
 
     kw = {"approach": regime.approach, "shadow_wire": regime.shadow_wire,
-          "wire_dtype": regime.wire_dtype}
+          "wire_dtype": regime.wire_dtype,
+          "wire_segments": regime.wire_segments}
     plan = plan_from_cfg(base_cfg)
     if plan is not None:
         kw["fault_spec"] = ",".join(ev.spec() for ev in plan.events
@@ -340,6 +359,28 @@ class Autopilot:
                                                shadow_wire="off"),
                            "shadow_off", open_eps.get("numerics_drift"),
                            {"drift_boundaries": self._drift_hot})
+            elif (self.regime.approach in ("cyclic", "approx")
+                  and self._strag_hot
+                  >= self.policy["segments_up_boundaries"]
+                  and self.regime.wire_segments
+                  < int(self.policy["segments_max"])):
+                # segments_up (ISSUE 16): the first rung of the straggler
+                # ladder — double the wire segment count so the aggregator
+                # decodes segments on arrival instead of waiting for the
+                # slowest worker's LAST byte. Keeps the family (and its
+                # exactness certificate); the family dial-down only fires
+                # once the segment dial is maxed out.
+                trigger = (open_eps.get("straggle")
+                           or open_eps.get("starvation"))
+                target = dataclasses.replace(
+                    self.regime,
+                    wire_segments=min(max(2 * self.regime.wire_segments, 2),
+                                      int(self.policy["segments_max"])))
+                self._swap(step, client, target, "segments_up", trigger, {
+                    "straggle_boundaries": self._strag_hot,
+                    "wire_segments_before": self.regime.wire_segments,
+                    "wire_segments_after": target.wire_segments,
+                })
             elif (self.regime.approach == "cyclic"
                   and self._strag_hot >= self.policy["dial_down_boundaries"]
                   and self._adv_quiet >= self.policy["clean_boundaries"]
@@ -370,13 +411,32 @@ class Autopilot:
                                                shadow_wire=self.regime
                                                .shadow_wire,
                                                wire_dtype=self.regime
-                                               .wire_dtype),
+                                               .wire_dtype,
+                                               wire_segments=self.regime
+                                               .wire_segments),
                            "dial_up", trigger, {
                                "straggle_quiet_boundaries":
                                    self._strag_quiet,
                                "restores": "exact decode + Byzantine "
                                            "certificate",
                            })
+            elif (self.regime.wire_segments > self.base.wire_segments
+                  and self._strag_quiet
+                  >= self.policy["segments_down_boundaries"]):
+                # segments_down: sustained straggle-quiet evidence halves
+                # the segment count back toward the configured one (never
+                # past it) — single-message wires pay no per-segment
+                # locator overhead on a quiet fleet
+                trigger = self._last_cleared(_STRAGGLE_TYPES)
+                target = dataclasses.replace(
+                    self.regime,
+                    wire_segments=max(self.regime.wire_segments // 2,
+                                      self.base.wire_segments))
+                self._swap(step, client, target, "segments_down", trigger, {
+                    "straggle_quiet_boundaries": self._strag_quiet,
+                    "wire_segments_before": self.regime.wire_segments,
+                    "wire_segments_after": target.wire_segments,
+                })
         self.heartbeat.set_control(self.status_block())
 
     def _dial_down_allowed(self, step: int) -> bool:
@@ -477,6 +537,9 @@ class Autopilot:
         label = (client.BASE_LABEL if target == self.base
                  else f"{client.BASE_LABEL}@{target.tag}")
         client.switch_regime(setup, label)
+        # keep the engine's dispatch-span segment tag in step with the
+        # regime actually dispatched (segments_up/segments_down swaps)
+        client.wire_segments = target.wire_segments
         prev, self.regime = self.regime, target
         self.swaps += 1
         # counters reset so the NEW regime earns its own evidence window

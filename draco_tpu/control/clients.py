@@ -31,6 +31,10 @@ class TrainerChunkClient:
         self.tr = tr
         self.label = self.BASE_LABEL
         self.setup = tr.setup
+        # current regime's wire segmentation (ISSUE 16) — re-stamped by the
+        # autopilot on segments_up/segments_down swaps so the engine's
+        # dispatch spans carry the live S
+        self.wire_segments = int(getattr(tr.cfg, "wire_segments", 1) or 1)
         self._pre_quarantine = {}  # worker -> schedule column before it
 
     @property
@@ -131,6 +135,9 @@ class TokenChunkClient:
         self._boundary = boundary_eval_ckpt
         self._rebuild = rebuild
         self.label = self.BASE_LABEL
+        # current regime's wire segmentation (ISSUE 16) — see
+        # TrainerChunkClient.wire_segments
+        self.wire_segments = int(getattr(cfg, "wire_segments", 1) or 1)
         self._device_gen = cfg.token_gen == "device"
         self._pre_quarantine = {}  # worker -> schedule column before it
 

@@ -128,7 +128,15 @@ class ChunkedEngine:
                 # capture snaps to whole chunks; the chunk start rides
                 # along so the anchor's steps_profiled reflects the window
                 win.maybe_start(end, first_step=start)
-                with tracer.span("dispatch", chunk_start=start, k=k), \
+                # segmented wire (ISSUE 16): tag dispatch spans with the
+                # live segment count ONLY when the regime actually splits
+                # the wire — S=1 trace records stay byte-identical to the
+                # pre-segmentation suites (the bitwise rail)
+                span_kw = {"chunk_start": start, "k": k}
+                seg = int(getattr(client, "wire_segments", 1) or 1)
+                if seg > 1:
+                    span_kw["segments"] = seg
+                with tracer.span("dispatch", **span_kw), \
                         watch.expect(client.label, key=k):
                     state, block = client.dispatch(state, chunk)
                 self.state, self.last_end = state, end
@@ -190,3 +198,110 @@ class ChunkedEngine:
             finally:
                 client.cleanup()
         return state, deferred.last
+
+
+class SegmentPipeline:
+    """Decode-on-arrival driver over a segmented wire (ISSUE 16).
+
+    The production chunked regime decodes segments IN-GRAPH
+    (coding/cyclic.decode_segments / coding/approx.decode_segments — one
+    jitted program, zero host seams), so nothing here sits on the training
+    path. This driver is the measurement harness over the seam the wire
+    actually crosses in a multi-host deployment: the per-segment
+    host→device transfer of narrow codeword buffers. In ``pipelined``
+    mode each loop turn async-dispatches segment ``j``'s decode, pushes
+    segment ``j+1``'s transfer WHILE that decode executes, and only then
+    drains ``j`` — so the transfer wall hides under the decode wall. The
+    serial rail (``pipelined=False``) drains before the next transfer,
+    forbidding overlap; the delta between the rails is the pipeline win
+    tools/segment_study.py commits behind perf_watch (PERF.md §18).
+
+    Hooks (duck-typed, like the engine's client protocol):
+
+      put(j, host_segment) -> device buffer      (the wire transfer)
+      decode(j, device buffer) -> result          (async dispatch — must
+                                                  NOT block)
+      drain(result) -> None                       (block until the decode
+                                                  actually finished)
+
+    Every hook call is wrapped in a tracer span (``segment_xfer`` /
+    ``segment_decode`` / ``segment_drain``, each tagged ``segment=j``) and
+    mirrored into ``self.events`` with host perf_counter stamps, so the
+    study can both compute the overlap fraction in-process and merge the
+    spans against a device-profiler capture (obs/device_attr
+    .merge_timeline)."""
+
+    def __init__(self, tracer, put, decode, drain=None, *,
+                 pipelined: bool = True):
+        self.tracer = tracer
+        self.put = put
+        self.decode = decode
+        self.drain = drain
+        self.pipelined = pipelined
+        self.events = []  # [{name, segment, t0_s, t1_s}] host wall stamps
+
+    def _timed(self, name, j, fn):
+        t0 = time.perf_counter()
+        with self.tracer.span(name, segment=j):
+            out = fn()
+        self.events.append({"name": name, "segment": j,
+                            "t0_s": t0, "t1_s": time.perf_counter()})
+        return out
+
+    def run(self, host_segments):
+        """Drive all segments; returns the per-segment decode results
+        (drained when a ``drain`` hook was given)."""
+        n = len(host_segments)
+        results = []
+        if n == 0:
+            return results
+        dev = self._timed("segment_xfer", 0,
+                          lambda: self.put(0, host_segments[0]))
+        for j in range(n):
+            out = self._timed("segment_decode", j,
+                              lambda j=j, dev=dev: self.decode(j, dev))
+            if self.pipelined:
+                # transfer j+1 rides under decode j's async execution;
+                # the drain AFTER it is what exposes the overlap
+                if j + 1 < n:
+                    dev = self._timed(
+                        "segment_xfer", j + 1,
+                        lambda j=j: self.put(j + 1, host_segments[j + 1]))
+                if self.drain is not None:
+                    self._timed("segment_drain", j,
+                                lambda out=out: self.drain(out))
+            else:
+                # serial rail: drain FIRST, so the next transfer cannot
+                # overlap — the no-pipeline control
+                if self.drain is not None:
+                    self._timed("segment_drain", j,
+                                lambda out=out: self.drain(out))
+                if j + 1 < n:
+                    dev = self._timed(
+                        "segment_xfer", j + 1,
+                        lambda j=j: self.put(j + 1, host_segments[j + 1]))
+            results.append(out)
+        return results
+
+    def overlap_us(self):
+        """(overlapped transfer µs, decode in-flight µs): each pipelined
+        turn's in-flight window runs from decode ``j``'s dispatch end to
+        its drain end; transfer ``j+1`` wall inside that window is wire
+        time the pipeline hid. Serial runs report 0 overlap by
+        construction (the drain precedes the transfer)."""
+        by_seg = {}
+        for ev in self.events:
+            by_seg.setdefault(ev["segment"], {})[ev["name"]] = ev
+        total_inflight = 0.0
+        overlapped = 0.0
+        for j, evs in sorted(by_seg.items()):
+            dec, drn = evs.get("segment_decode"), evs.get("segment_drain")
+            if dec is None or drn is None:
+                continue
+            lo, hi = dec["t1_s"], drn["t1_s"]
+            total_inflight += max(hi - lo, 0.0)
+            nxt = by_seg.get(j + 1, {}).get("segment_xfer")
+            if nxt is not None:
+                overlapped += max(min(nxt["t1_s"], hi)
+                                  - max(nxt["t0_s"], lo), 0.0)
+        return overlapped * 1e6, total_inflight * 1e6

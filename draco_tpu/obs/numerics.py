@@ -253,6 +253,77 @@ def wire_rows(approach: str) -> int:
     return 2 if approach == "cyclic" else 1
 
 
+# Segment quantum for the streaming segmented wire (ISSUE 16): cuts land
+# on multiples of this so every segment is a whole number of kernel d-tiles.
+# Mirrors ops/coded.TILE_D — pinned equal by tests/test_segments.py; kept a
+# literal here so the ledger (and wire_study --check) stays jax-free.
+SEGMENT_QUANTUM = 4096
+
+
+def wire_segment_bounds(d: int, segments: int, block: int = 1) -> tuple:
+    """Jax-free cut points for the streaming segmented wire: ``(b_0=0 <
+    b_1 < ... < b_S=d)`` splitting the d axis into at most ``segments``
+    pieces, every interior cut a multiple of the segment quantum
+    (SEGMENT_QUANTUM when ``block`` divides it, else ``block`` itself).
+
+    Quantum alignment is the bitwise-invariance contract: the int8
+    per-block scales (one per ``block`` elements) and the (d,)-shaped
+    shared stochastic-rounding draws never straddle an interior cut, so
+    quantize-the-full-row-then-slice equals quantize-per-segment
+    bit-for-bit — the narrow buffers are segment-invariant and only the
+    decode is segmented. A ``d`` smaller than ``segments`` quanta yields
+    fewer (possibly one) segments rather than sub-quantum slivers."""
+    d = int(d)
+    segments = max(int(segments), 1)
+    block = max(int(block), 1)
+    if d <= 0:
+        return (0, 0)
+    quantum = SEGMENT_QUANTUM if SEGMENT_QUANTUM % block == 0 else block
+    units = -(-d // quantum)  # whole quanta covering d
+    s_eff = max(min(segments, units), 1)
+    per, rem = divmod(units, s_eff)
+    bounds = [0]
+    for i in range(s_eff):
+        step = (per + (1 if i < rem else 0)) * quantum
+        bounds.append(min(bounds[-1] + step, d))
+    bounds[-1] = d
+    # dedupe (clamping can only collapse trailing cuts onto d)
+    out = [bounds[0]]
+    for b in bounds[1:]:
+        if b > out[-1]:
+            out.append(b)
+    return tuple(out)
+
+
+def cfg_segment_bounds(cfg, dim: int) -> tuple:
+    """The segment bounds a config induces at flat-gradient size ``dim``
+    — THE one bounds source for the in-graph decode seams, the ledger and
+    the tools, so they cannot drift. int8 wires align cuts to the
+    per-block scale granularity; f32/bf16 only to the kernel d-tile."""
+    block = (int(getattr(cfg, "shadow_block", DEFAULT_BLOCK))
+             if getattr(cfg, "wire_dtype", "f32") == "int8" else 1)
+    return wire_segment_bounds(dim, getattr(cfg, "wire_segments", 1),
+                               block)
+
+
+def _segment_bytes(bounds: tuple, rows: int, dtype: str,
+                   block: int) -> list:
+    """Per-segment wire bytes for one worker at ``dtype`` — the physical
+    bytes of each [a, b) slice of the narrow buffers. Because interior
+    cuts are block-aligned, per-segment int8 scale counts sum exactly to
+    the unsegmented ledger's count (no padding hidden at the seams)."""
+    out = []
+    for a, b in zip(bounds[:-1], bounds[1:]):
+        w = rows * (b - a)
+        if dtype == "f32":
+            out.append(4 * w)
+        elif dtype == "bf16":
+            out.append(2 * w)
+        else:  # int8: 1 byte/elem + f32 per-block scales
+            out.append(w + 4 * rows * (-(-(b - a) // block)))
+    return out
+
+
 def wire_ledger(cfg, dim: int) -> dict:
     """Logical worker→aggregator wire bytes per step at the program's
     registered shapes, per dtype candidate — and, since ISSUE 15, the
@@ -275,6 +346,8 @@ def wire_ledger(cfg, dim: int) -> dict:
         "int8": words + 4 * blocks,  # 1 byte/elem + f32 per-block scales
     }
     wire_dtype = getattr(cfg, "wire_dtype", "f32")
+    bounds = cfg_segment_bounds(cfg, dim)
+    seg_worker = _segment_bytes(bounds, rows, wire_dtype, block)
     return {
         "family": cfg.approach,
         "dim": int(dim),
@@ -287,6 +360,16 @@ def wire_ledger(cfg, dim: int) -> dict:
         "physical_bytes_per_step": per_worker[wire_dtype] * n,
         "shadow_wire": cfg.shadow_wire,
         "shadow_block": block,
+        # streaming segmented wire (ISSUE 16): the per-segment physical
+        # bytes MUST sum to the per-worker/per-step rows above — block-
+        # aligned cuts hide no padding at the seams (wire_study --check
+        # re-verifies the sum on the committed matrix)
+        "segments": {
+            "count": len(bounds) - 1,
+            "bounds": list(bounds),
+            "physical_bytes_per_worker": seg_worker,
+            "physical_bytes_per_step": [v * n for v in seg_worker],
+        },
     }
 
 

@@ -405,6 +405,70 @@ def cyclic_narrow_recombine(v_re, v_im, wire, interpret: bool = False):
         block=int(block), interpret=interpret)
 
 
+# ---------------------------------------------------------------------------
+# streaming segmented wire (ISSUE 16): segment-offset entry points — the
+# existing kernels already tile over d, so a segment is just a [a, b) slice
+# of the operands (and, for the narrow wire, of the q/scale buffers); no new
+# kernels, only sliced dispatch
+# ---------------------------------------------------------------------------
+
+
+def _slice_narrow_buf(buf, a, b, block):
+    """[a, b) d-slice of one narrow buffer dict ({"q"[, "scale"]}) — the
+    int8 per-block scale columns slice at block granularity, which is why
+    interior segment cuts MUST be block-aligned
+    (obs/numerics.wire_segment_bounds guarantees it)."""
+    out = {"q": buf["q"][:, a:b]}
+    if "scale" in buf:
+        blk = max(int(block), 1)
+        if a % blk:
+            raise ValueError(
+                f"segment cut {a} not aligned to int8 scale block {blk}")
+        out["scale"] = buf["scale"][:, a // blk:-(-b // blk)]
+    return out
+
+
+def wire_slice_pair(wire, a: int, b: int):
+    """Segment [a, b) view of a narrow_wire_pair tuple
+    ``(mode, buf_re, buf_im, block)`` — same tuple shape, sliced buffers,
+    so the unsegmented narrow-ingest kernels consume it unchanged."""
+    if wire is None:
+        return None
+    mode, buf_re, buf_im, block = wire
+    return (mode, _slice_narrow_buf(buf_re, a, b, block),
+            _slice_narrow_buf(buf_im, a, b, block), block)
+
+
+def wire_slice_single(wire, a: int, b: int):
+    """Segment [a, b) view of a narrow_wire_single tuple
+    ``(mode, buf, block)`` (the approx family's real wire)."""
+    if wire is None:
+        return None
+    mode, buf, block = wire
+    return (mode, _slice_narrow_buf(buf, a, b, block), block)
+
+
+def cyclic_narrow_recombine_segment(v_re, v_im, wire, a: int, b: int,
+                                    interpret: bool = False):
+    """Per-segment narrow-ingest recombination: the [a, b) slice of
+    ``cyclic_narrow_recombine`` with this segment's own recombination
+    vector — the decode-on-arrival unit of the cyclic streaming wire."""
+    return cyclic_narrow_recombine(v_re, v_im, wire_slice_pair(wire, a, b),
+                                   interpret=interpret)
+
+
+def approx_decode_segment(rows, batch_grads, v, pres_b, a: int, b: int,
+                          interpret: bool = False, wire=None):
+    """Per-segment approx decode tail: the [a, b) slice of
+    ``approx_decode`` — returns this segment's ``(decoded (b-a,),
+    Σ(decoded − true_mean)², Σ batch_grads²)``; the caller folds the
+    scalar accumulators across segments BEFORE the final residual sqrt so
+    the health verdict stays per-step."""
+    w_seg = None if wire is None else wire_slice_single(wire, a, b)
+    return approx_decode(rows[:, a:b], batch_grads[:, a:b], v, pres_b,
+                         interpret=interpret, wire=w_seg)
+
+
 def narrow_kernel_ok(wire) -> bool:
     """Static feasibility of the narrow-ingest kernels for this wire:
     int8 per-block scales must tile evenly into the TILE_D grid."""

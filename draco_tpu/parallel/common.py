@@ -29,6 +29,22 @@ def build_code_from_cfg(cfg):
     return None
 
 
+def segment_decode_bounds(cfg, dim: int, leaf_offsets=None):
+    """The decode partition the streaming segmented wire induces (ISSUE
+    16): the quantum-aligned segment cuts (obs/numerics.cfg_segment_bounds
+    — THE bounds source the ledger and tools share), refined by the static
+    leaf boundaries when the decode runs at layer granularity so every
+    parameter tensor keeps its own locator."""
+    from draco_tpu.obs import numerics as numerics_mod
+
+    bounds = list(numerics_mod.cfg_segment_bounds(cfg, dim))
+    if leaf_offsets is not None:
+        cuts = sorted({int(o) for o in leaf_offsets}
+                      | {int(b) for b in bounds})
+        bounds = [c for c in cuts if 0 <= c <= dim]
+    return bounds
+
+
 def approx_aggregate(code, grads: jnp.ndarray, present=None, constrain=None,
                      cfg=None, adv_mask=None, step=None):
     """The approx family's whole aggregation sequence — ingest forensics →
@@ -71,10 +87,22 @@ def approx_aggregate(code, grads: jnp.ndarray, present=None, constrain=None,
                 cfg, rows, step=step, constrain=constrain)
         elif constrain is not None:
             rows = constrain(rows)
+    segments = (int(getattr(cfg, "wire_segments", 1))
+                if cfg is not None else 1)
     with jax.named_scope("draco_decode"):
-        agg, _v, health = approx_mod.decode(
-            code, rows, present=present, with_health=True,
-            batch_grads=grads, impl=decode_impl, wire=wire)
+        if segments > 1:
+            # streaming segmented wire (ISSUE 16): the presence-only
+            # weight solve runs once; each segment combines on arrival and
+            # the residual accumulators fold to one per-step verdict
+            bounds = numerics_mod.cfg_segment_bounds(
+                cfg, int(rows.shape[-1]))
+            agg, _v, health = approx_mod.decode_segments(
+                code, rows, bounds, present=present, with_health=True,
+                batch_grads=grads, impl=decode_impl, wire=wire)
+        else:
+            agg, _v, health = approx_mod.decode(
+                code, rows, present=present, with_health=True,
+                batch_grads=grads, impl=decode_impl, wire=wire)
     health["bad_rows"] = bad_rows
     if cfg is not None:
         from draco_tpu.obs import numerics as numerics_mod
@@ -172,6 +200,7 @@ def aggregate_flat_grads(grads: jnp.ndarray, adv_mask, cfg, code, rand_factor,
         wire_tol, wire_lam = numerics_mod.wire_decode_params(cfg)
         rel_tol = (cyclic_mod.HEALTH_REL_TOL if wire_tol is None
                    else wire_tol)
+        segments = int(getattr(cfg, "wire_segments", 1))
         with jax.named_scope("draco_decode"):
             if cfg.decode_granularity == "layer":
                 if leaf_offsets is None:
@@ -179,11 +208,36 @@ def aggregate_flat_grads(grads: jnp.ndarray, adv_mask, cfg, code, rand_factor,
                         "decode_granularity='layer' needs leaf_offsets from "
                         "_make_unravel"
                     )
-                agg, _honest, health = cyclic_mod.decode_layers(
-                    code, enc_re, enc_im, rand_factor, leaf_offsets,
+                if segments > 1:
+                    # streaming segmented wire (ISSUE 16) at layer
+                    # granularity: the decode partition is the REFINEMENT
+                    # of the leaf boundaries by the quantum-aligned segment
+                    # cuts — every layer still gets (at least) its own
+                    # locator, and the health fold is unchanged (max /
+                    # union over a finer partition)
+                    bounds = segment_decode_bounds(cfg, int(grads.shape[-1]),
+                                                   leaf_offsets)
+                    agg, _honest, health = cyclic_mod.decode_segments(
+                        code, enc_re, enc_im, rand_factor, bounds,
+                        present=present, with_health=True, impl=decode_impl,
+                        rel_tol=rel_tol, lam=wire_lam, wire=wire)
+                else:
+                    agg, _honest, health = cyclic_mod.decode_layers(
+                        code, enc_re, enc_im, rand_factor, leaf_offsets,
+                        present=present, with_health=True, impl=decode_impl,
+                        rel_tol=rel_tol, lam=wire_lam,
+                    )
+            elif segments > 1:
+                # streaming segmented wire (ISSUE 16): per-segment
+                # syndromes/locators, one folded verdict per step
+                from draco_tpu.obs import numerics as numerics_mod
+
+                bounds = numerics_mod.cfg_segment_bounds(
+                    cfg, int(grads.shape[-1]))
+                agg, _honest, health = cyclic_mod.decode_segments(
+                    code, enc_re, enc_im, rand_factor, bounds,
                     present=present, with_health=True, impl=decode_impl,
-                    rel_tol=rel_tol, lam=wire_lam,
-                )
+                    rel_tol=rel_tol, lam=wire_lam, wire=wire)
             else:
                 agg, _honest, health = cyclic_mod.decode(
                     code, enc_re, enc_im, rand_factor, present=present,

@@ -20,9 +20,13 @@ from draco_tpu.control import autopilot as ap
 
 # compressed hysteresis for the short test scenarios (production defaults
 # are sized for long runs); straggle.streak=2 fires the detector after a
-# 2-step absence streak
+# 2-step absence streak. segments_up_boundaries parks the segment rung of
+# the straggler ladder (ISSUE 16 — it would otherwise fire before the
+# family dial this suite is about); the segment dial's own lifecycle is
+# pinned in tests/test_segments.py.
 POLICY = ("dial_down_boundaries=1,clean_boundaries=1,"
-          "dial_up_boundaries=2,readmit_boundaries=2")
+          "dial_up_boundaries=2,readmit_boundaries=2,"
+          "segments_up_boundaries=99")
 THRESHOLDS = "straggle.streak=2"
 
 

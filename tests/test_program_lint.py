@@ -67,15 +67,19 @@ def test_registry_covers_every_route():
     assert {"kernel_cyclic_locator", "kernel_approx_decode"} <= {
         p.name for p in programs if p.fast}
     # out of the --fast budget: the big-d constant-bloat guard (~3.3M
-    # params) and the ISSUE 12 fused/approx impl VARIANTS of fast-swept
-    # step bodies (the full tool + the committed-artifact coverage test
-    # still guard them)
+    # params), the ISSUE 12 fused/approx impl VARIANTS of fast-swept
+    # step bodies, and the ISSUE 16 segmented-wire variants (the full
+    # tool + the committed-artifact coverage test still guard them)
     big = {p.name for p in programs if not p.fast}
     assert big == {"lm_fold_big_bf16_many_k2",
                    "cnn_cyclic_layer_step", "cnn_cyclic_layer_pallas_step",
                    "cnn_approx_pallas_step",
                    "lm_sp_ring_approx_pallas_many_k2",
-                   "lm_tp2_approx_many_k2", "lm_tp2_approx_pallas_many_k2"}
+                   "lm_tp2_approx_many_k2", "lm_tp2_approx_pallas_many_k2",
+                   "cnn_cyclic_seg2_many_k2",
+                   "cnn_cyclic_seg2_wire_bf16_many_k2",
+                   "cnn_approx_seg2_step",
+                   "cnn_approx_seg2_wire_int8_step"}
 
 
 @pytest.mark.core

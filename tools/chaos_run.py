@@ -110,6 +110,20 @@ EAGER_FAULTS = ("nan_grad", "over_budget", "sigterm")
 # the live adversary would just re-test the same locator failure.
 APPROX_FAULTS = ("straggle", "nan_grad", "sigterm")
 STRAGGLE_WORKER = 3  # the named straggle victim (absent ≠ accused target)
+# the segmented-wire loops (ISSUE 16): the same production loops with the
+# wire split into S=2 segments and the live-adversary budget released
+# (adversary_count=0), so the cell's fault is the only one in play.
+# `sigterm` lands between chunk dispatches of the SEGMENTED regime and
+# must round-trip through the existing preemption/resume machinery
+# bitwise against the loop's own S=2 clean run (`preempted_resumed`).
+# `straggle` runs on the vote-family segmented loop (mv_seg2), where a
+# mid-stream drop is bitwise-MASKED — the vote picks among bitwise-equal
+# replicas, so segmenting the wire must leave the clean-run equality
+# intact. The cyclic segmented loops skip straggle here: per-segment
+# recombination legitimately rounds differently from S=1 once the honest
+# support shifts, so their straggle/adversary equivalence is the
+# tolerance-based pin in tests/test_segments.py, not a bitwise chaos cell.
+SEG_FAULTS = ("straggle", "sigterm")
 
 FAULT_STEP = 5  # mid-run, between the two eval/ckpt boundaries (4 and 8)
 # sigterm lands ON the first chunk boundary so the K=4 loops stop with
@@ -201,6 +215,15 @@ def _loops():
     def with_k(cfg_fn, k, **fixed):
         return lambda **kw: cfg_fn(steps_per_call=k, **fixed, **kw)
 
+    # the segmented-wire loops (ISSUE 16): wire_segments rides as a
+    # DEFAULT so the straggle cell can rebuild the same loop at S=1 for
+    # its bitwise segment-invariance reference
+    def with_seg(cfg_fn, k, **fixed):
+        def make(**kw):
+            kw.setdefault("wire_segments", 2)
+            return cfg_fn(steps_per_call=k, **fixed, **kw)
+        return make
+
     # the approx family rejects live adversaries (config.validate: no
     # Byzantine certificate), so its cells run worker_fail=0 with the
     # ISSUE 8 design point r=1.5 / α=0.25 on the same FC loop
@@ -234,6 +257,14 @@ def _loops():
         "cnn_rand_k1": (with_k(cnn_cfg, 1, **rand_kw), cnn_run),
         "cnn_rand_k4": (with_k(cnn_cfg, 4, **rand_kw), cnn_run),
         "ap_wire_k4": (with_k(cnn_cfg, 4, **ap_wire_kw), cnn_run),
+        # the segmented-wire loops (ISSUE 16): adversary_count=0 releases
+        # the code budget so the cell's injected fault is the only one in
+        # play; mv_seg2 is the vote family (group replication), where the
+        # straggle drop must stay bitwise-masked under the segmented wire
+        "cnn_seg2_k4": (with_seg(cnn_cfg, 4, adversary_count=0), cnn_run),
+        "lm_seg2_k4": (with_seg(lm_cfg, 4, adversary_count=0), lm_fold_run),
+        "mv_seg2_k4": (with_seg(cnn_cfg, 4, approach="maj_vote",
+                                group_size=4, adversary_count=0), cnn_run),
     }
 
 
@@ -575,6 +606,41 @@ def run_case(loop: str, fault: str, make_cfg, run, clean_vec, workdir):
     # stayed absent, and absence was never accused)
     row["bitwise_equal_clean"] = bool(np.array_equal(clean_vec, vec))
     row["final_finite"] = bool(np.all(np.isfinite(vec)))
+    if fault == "straggle" and "_seg" in loop:
+        # the segmented-wire straggle cell (ISSUE 16, vote family): the
+        # mid-stream drop must stay bitwise-MASKED with the wire split
+        # into segments — the vote picks among bitwise-equal replicas, so
+        # the S=2 run's final params land on the fault-free clean run of
+        # the same loop; plus the victim really stayed absent and absence
+        # was never accused (erasure, not evidence)
+        from draco_tpu.obs import replay
+        from draco_tpu.obs.forensics import record_masks
+
+        recs = replay.train_records(os.path.join(d, "metrics.jsonl"))
+        dropped = never_accused = bool(recs)
+        for r in recs:
+            masks = record_masks(r, NUM_WORKERS)
+            if masks is None:
+                dropped = never_accused = False
+                break
+            if (r.get("step", 0) >= step
+                    and masks["present"][STRAGGLE_WORKER]):
+                dropped = False
+            if masks["accused"][STRAGGLE_WORKER]:
+                never_accused = False
+        row["dropped"] = dropped
+        row["never_accused"] = never_accused
+        if (row["final_finite"] and status.get("state") == "done"
+                and row["guard_trips"] == 0 and dropped and never_accused
+                and row["bitwise_equal_clean"]):
+            row.update(ok=True, outcome="masked")
+        else:
+            row["detail"] = (f"segmented straggle not masked: "
+                             f"bitwise={row['bitwise_equal_clean']} "
+                             f"dropped={dropped} "
+                             f"never_accused={never_accused} "
+                             f"guard_trips={row['guard_trips']}")
+        return row
     if fault == "straggle":
         verdict = _straggle_verdict(d, STRAGGLE_WORKER, step)
         row.update(verdict)
@@ -712,6 +778,11 @@ def main(argv=None) -> int:
         elif loop.startswith("ap_wire"):
             # the autopilot wire-dial loop runs exactly the drift episode
             faults = [f for f in pick_faults if f in WIRE_FAULTS]
+        elif "_seg" in loop:
+            # the segmented-wire loops run the ISSUE 16 pair; straggle is
+            # the vote loop's cell (bitwise-masked there — see SEG_FAULTS)
+            faults = [f for f in pick_faults if f in SEG_FAULTS
+                      and (f != "straggle" or loop.startswith("mv_"))]
         else:
             faults = [f for f in pick_faults
                       if f not in ("straggle",) + RAND_FAULTS + WIRE_FAULTS

@@ -19,6 +19,9 @@ exits nonzero NAMING THE FIRST FAILURE:
   decode_kernel_bench --check: ratio arithmetic + gated-rung
                       kernel-not-slower pins of the committed fused-decode
                       microbench (ISSUE 12)
+  segment_study       --check: per-segment bytes sums + bounds algebra and
+                      the overlap/ms-per-step-win acceptance pins of the
+                      committed streaming-wire evidence (ISSUE 16)
   program_lint        committed all_ok roll-up
   chaos_matrix        committed all_ok roll-up
   straggler_study     committed all_ok roll-up
@@ -89,6 +92,14 @@ def _check_wire_study(root):
     artifact = os.path.join(root, "baselines_out", "wire_study.json")
     rc = wire_study.main(["--check", "--artifact", artifact])
     return None if rc == 0 else f"wire_study --check exited {rc}"
+
+
+def _check_segment_study(root):
+    from tools import segment_study
+
+    artifact = os.path.join(root, "baselines_out", "segment_study.json")
+    rc = segment_study.main(["--check", "--artifact", artifact])
+    return None if rc == 0 else f"segment_study --check exited {rc}"
 
 
 def _check_decode_bench(root):
@@ -266,6 +277,7 @@ CHECKS = (
     ("device_profile --check", _check_device_profile),
     ("wire_study --check", _check_wire_study),
     ("decode_kernel_bench --check", _check_decode_bench),
+    ("segment_study --check", _check_segment_study),
     ("program_lint all_ok",
      _flag_check(os.path.join("baselines_out", "program_lint.json"))),
     ("chaos_matrix all_ok",

@@ -67,6 +67,15 @@ Folded sources (all optional — a missing artifact folds nothing):
                                 detection P/R + det_preserved as
                                 0-tolerance ok flags, logical wire bytes
                                 at the bytes tolerance
+  baselines_out/segment_study.json
+                                the streaming segmented wire's pipeline
+                                evidence (tools/segment_study.py, ISSUE
+                                16): the winning S>1 cell's positive
+                                overlap fraction and ms/step win as
+                                0-tolerance ok flags, the measured
+                                fractions at the ratio tolerance, segment
+                                counts + per-segment physical bytes
+                                pinned tolerance-0 in both directions
   baselines_out/decode_kernel_bench.json
                                 the fused-decode microbench
                                 (tools/decode_kernel_bench.py, ISSUE 12):
@@ -490,6 +499,68 @@ def fold_wire_study(root: str, metrics: dict) -> None:
                 "value": float(per[dtype]), "kind": "bytes", "source": src}
 
 
+def fold_segment_study(root: str, metrics: dict) -> None:
+    """Segment-study artifact (tools/segment_study.py, ISSUE 16): the
+    streaming segmented wire's pipeline evidence. The ACCEPTANCE bools
+    gate at tolerance 0 — the winning pipelined S>1 cell must keep a
+    strictly positive wire/decode overlap fraction and a strictly
+    positive ms/step win over the S=1 base (the flipped-row control in
+    tests/test_segments.py proves both gates live). The measured overlap
+    and win fractions ride as ratio-kind (wall-clock noisy, 10%); the
+    per-cell segment COUNTS and per-segment physical bytes are PINNED at
+    tolerance 0 in BOTH directions — a segment silently appearing,
+    vanishing, or changing size is a wire-format change, never noise.
+    S=1 rows pin overlap at exactly 0: the no-pipeline base measuring
+    overlap would mean the overlap metric itself broke."""
+    path = os.path.join(root, "baselines_out", "segment_study.json")
+    data = _read_json(path)
+    if not isinstance(data, dict):
+        return
+    src = "baselines_out/segment_study.json"
+    if "all_ok" in data:
+        metrics["segment.all_ok"] = {"value": float(bool(data["all_ok"])),
+                                     "kind": "ok", "source": src}
+    win = data.get("win") or {}
+    if win:
+        metrics["segment.win.positive"] = {
+            "value": float(float(win.get("ms_per_step_win", 0.0)) > 0.0),
+            "kind": "ok", "source": src}
+        metrics["segment.win.overlap_positive"] = {
+            "value": float(float(win.get("overlap_frac", 0.0)) > 0.0),
+            "kind": "ok", "source": src}
+        for col in ("win_frac", "overlap_frac"):
+            if isinstance(win.get(col), (int, float)):
+                metrics[f"segment.win.{col}"] = {
+                    "value": float(win[col]), "kind": "ratio",
+                    "source": src}
+    for row in data.get("rows", []):
+        dtype, s = row.get("dtype"), row.get("segments")
+        if dtype is None or s is None:
+            continue
+        key = f"segment.{dtype}.s{s}"
+        if isinstance(row.get("ms_per_step"), (int, float)):
+            metrics[f"{key}.ms_per_step"] = {
+                "value": float(row["ms_per_step"]), "kind": "time_ms",
+                "source": src}
+        if s == 1:
+            metrics[f"{key}.overlap_frac"] = {
+                "value": float(row.get("overlap_frac", 0.0)),
+                "kind": "pinned", "source": src}
+        elif isinstance(row.get("overlap_frac"), (int, float)):
+            metrics[f"{key}.overlap_frac"] = {
+                "value": float(row["overlap_frac"]), "kind": "ratio",
+                "source": src}
+        seg = (row.get("wire") or {}).get("segments") or {}
+        if isinstance(seg.get("count"), (int, float)):
+            metrics[f"{key}.segments_count"] = {
+                "value": float(seg["count"]), "kind": "pinned",
+                "source": src}
+        for i, b in enumerate(seg.get("physical_bytes_per_worker") or []):
+            if isinstance(b, (int, float)):
+                metrics[f"{key}.seg{i}_bytes_per_worker"] = {
+                    "value": float(b), "kind": "pinned", "source": src}
+
+
 def fold_decode_bench(root: str, metrics: dict) -> None:
     """Fused-decode microbench (tools/decode_kernel_bench.py, ISSUE 12):
     absolute per-impl decode times and the pallas/xla ratio ride at the
@@ -588,6 +659,7 @@ def fold_all(root: str) -> dict:
     fold_straggler(root, metrics)
     fold_autopilot(root, metrics)
     fold_wire_study(root, metrics)
+    fold_segment_study(root, metrics)
     fold_decode_bench(root, metrics)
     fold_device_profile(root, metrics)
     return metrics

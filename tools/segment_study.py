@@ -1,0 +1,467 @@
+#!/usr/bin/env python
+"""Segment study: the streaming segmented wire's committed pipeline
+evidence (ISSUE 16).
+
+The production chunked regime decodes a segmented wire IN-GRAPH
+(coding/cyclic.decode_segments / coding/approx.decode_segments — one
+jitted program per step, bounds from obs/numerics.cfg_segment_bounds).
+What segmentation BUYS is at the seam the codewords physically cross in a
+multi-host deployment: with the row split into S wire segments the
+aggregator can decode segment ``j`` while segment ``j+1`` is still in
+flight, hiding transfer wall under decode wall. This study measures that
+seam with the decode-on-arrival driver (control/engine.SegmentPipeline)
+over the sp LM route's REAL coded shape: the TransformerLM parameter
+vector is raveled to its flat d, encoded under the production cyclic
+(n, s) code, narrowed to the wire dtype (obs/numerics.narrow_wire_rows —
+the same buffers the real narrow wire ships), segmented on the committed
+bounds, and driven through per-segment host→device transfer + jitted
+λ-regularized decode:
+
+  * **pipelined** — decode ``j`` async-dispatches, transfer ``j+1`` rides
+    under it, THEN ``j`` drains (decode-on-arrival);
+  * **serial** — drain before the next transfer: the no-overlap control;
+  * **S=1** — one transfer, one decode: today's wire, the ms/step base.
+
+Each (dtype, S) cell records the median wire+decode ms/step of both
+rails, the measured overlap fraction (transfer wall that landed inside a
+decode's in-flight window, SegmentPipeline.overlap_us), and the ledger's
+per-segment physical bytes (obs/numerics.wire_ledger ``segments`` block —
+which must SUM to the per-step ledger, the satellite-3 pin). The winning
+pipelined S>1 cell re-runs once under the span tracer + a jax profiler
+capture and the two event streams merge onto one clock
+(obs/device_attr.merge_timeline, the PR 9 machinery) — the
+``merged_timeline`` block records the artifact written into the work dir.
+
+``tools/perf_watch.py`` folds the committed artifact: the overlap
+fraction and the ms/step win gate round-over-round; the segment counts
+and per-segment bytes are pinned tolerance-0 in BOTH directions.
+``--check`` re-verifies a committed artifact jax-free (segment-bytes
+sums, bounds algebra, the overlap/win acceptance pins) — wired into
+tools/check_artifacts.py.
+
+Usage (CPU, ~2-4 min):
+  python tools/segment_study.py
+  python tools/segment_study.py --check
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N_WORKERS = 8
+S_FAULTS = 1
+SEGMENTS = (1, 2, 4)
+DTYPES = ("f32", "int8")
+TRIALS = 5
+SEED = 428
+
+
+def _study_cfg(dtype: str, segments: int, args):
+    """The sp-route TrainConfig the cells share: the ONE source of the
+    committed bounds, ledger, and decode params (rel_tol, λ)."""
+    from draco_tpu.config import TrainConfig
+
+    return TrainConfig(
+        network="TransformerLM", dataset="synthetic-text", batch_size=2,
+        num_workers=N_WORKERS, approach="cyclic", redundancy="shared",
+        worker_fail=S_FAULTS, err_mode="rev_grad",
+        seq_len=64, vocab=args.vocab, model_dim=args.model_dim,
+        model_heads=args.model_heads, model_layers=args.model_layers,
+        max_steps=2, eval_freq=0, train_dir="", log_every=10 ** 9,
+        wire_dtype=dtype, wire_segments=segments,
+    )
+
+
+def _lm_dim(args) -> int:
+    """Flat parameter count of the sp route's TransformerLM at the study
+    shape — the d the coded wire actually carries on that route."""
+    import jax
+    import jax.numpy as jnp
+    from jax.flatten_util import ravel_pytree
+
+    from draco_tpu.models.transformer import TransformerLM
+
+    model = TransformerLM(vocab=args.vocab, dim=args.model_dim,
+                          heads=args.model_heads, layers=args.model_layers,
+                          attn_fn=None, dtype=jnp.float32)
+    params = model.init({"params": jax.random.key(SEED)},
+                        jnp.zeros((1, 8), jnp.int32), train=True)["params"]
+    flat, _ = ravel_pytree(params)
+    return int(flat.size)
+
+
+def _build_wire(code, d: int, dtype: str, block: int):
+    """Host-side wire payloads: encoded rows (with one live rev_grad-style
+    corrupt row, so the per-segment locators have something to locate),
+    narrowed to the wire dtype — numpy, so every put() is a REAL copy."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from draco_tpu.coding import cyclic as cyclic_mod
+    from draco_tpu.obs import numerics as nx
+
+    rs = np.random.RandomState(SEED)
+    g = rs.randn(code.n, d).astype(np.float32) * 0.05
+    enc_re, enc_im = cyclic_mod.encode_shared(code, jnp.asarray(g))
+    adv = jnp.zeros((code.n, 1), bool).at[0, 0].set(True)
+    enc_re = jnp.where(adv, -100.0 * enc_re, enc_re)
+    enc_im = jnp.where(adv, -100.0 * enc_im, enc_im)
+    f = rs.randn(d).astype(np.float32)
+    if dtype == "f32":
+        return np.asarray(enc_re), np.asarray(enc_im), f
+    buf_re = {k: np.asarray(v) for k, v in
+              nx.narrow_wire_rows(enc_re, dtype, block).items()}
+    buf_im = {k: np.asarray(v) for k, v in
+              nx.narrow_wire_rows(enc_im, dtype, block).items()}
+    return buf_re, buf_im, f
+
+
+def _segment_payloads(bounds, wire_re, wire_im, f, dtype, block):
+    """Slice the host buffers on the committed bounds — the narrow slices
+    go through the same segment-offset entry point the kernels use
+    (ops/decode_kernels.wire_slice_pair)."""
+    from draco_tpu.ops import decode_kernels
+
+    segs = []
+    for a, b in zip(bounds[:-1], bounds[1:]):
+        if dtype == "f32":
+            segs.append((wire_re[:, a:b], wire_im[:, a:b], f[a:b]))
+        else:
+            _, sr, si, _ = decode_kernels.wire_slice_pair(
+                (dtype, wire_re, wire_im, block), a, b)
+            segs.append((sr, si, f[a:b]))
+    return segs
+
+
+def _make_decode(code, dtype, block, rel_tol, lam):
+    import jax
+
+    from draco_tpu.coding import cyclic as cyclic_mod
+    from draco_tpu.obs import numerics as nx
+
+    kw = {} if rel_tol is None else {"rel_tol": rel_tol}
+
+    @jax.jit
+    def dec(pr, pi, f_seg):
+        if dtype == "f32":
+            er, ei = pr, pi
+        else:
+            er = nx.widen_wire_rows(pr, dtype, block)
+            ei = nx.widen_wire_rows(pi, dtype, block)
+        return cyclic_mod.decode(code, er, ei, f_seg, with_health=True,
+                                 lam=lam, **kw)
+
+    return dec
+
+
+def _drive(segs, dec, pipelined: bool, trials: int, tracer=None):
+    """Median wall ms + overlap stats over ``trials`` (first run is the
+    compile warmup and is discarded)."""
+    import jax
+
+    from draco_tpu.control.engine import SegmentPipeline
+    from draco_tpu.obs.tracer import NULL_TRACER
+
+    tracer = tracer or NULL_TRACER
+
+    def put(j, seg):
+        return jax.device_put(seg)
+
+    def decode(j, dev):
+        pr, pi, f_seg = dev
+        return dec(pr, pi, f_seg)
+
+    walls, ofracs = [], []
+    for t in range(trials + 1):
+        pipe = SegmentPipeline(tracer, put, decode, jax.block_until_ready,
+                               pipelined=pipelined)
+        t0 = time.perf_counter()
+        pipe.run(segs)
+        wall = time.perf_counter() - t0
+        if t == 0:
+            continue
+        walls.append(wall * 1e3)
+        o_us, infl_us = pipe.overlap_us()
+        ofracs.append(o_us / infl_us if infl_us > 0 else 0.0)
+    return (statistics.median(walls), statistics.median(ofracs))
+
+
+def run_cell(code, d: int, dtype: str, segments: int, args) -> dict:
+    from draco_tpu.obs import numerics as nx
+
+    cfg = _study_cfg(dtype, segments, args)
+    block = cfg.shadow_block if dtype == "int8" else 1
+    bounds = nx.cfg_segment_bounds(cfg, d)
+    ledger = nx.wire_ledger(cfg, d)
+    rel_tol, lam = nx.wire_decode_params(cfg)
+    wire_re, wire_im, f = _build_wire(code, d, dtype, block)
+    segs = _segment_payloads(bounds, wire_re, wire_im, f, dtype, block)
+    dec = _make_decode(code, dtype, block, rel_tol, lam)
+
+    pipe_ms, ofrac = _drive(segs, dec, True, args.trials)
+    serial_ms, _ = _drive(segs, dec, False, args.trials)
+
+    seg_block = ledger["segments"]
+    row = {
+        "route": "sp_lm", "family": "cyclic", "dtype": dtype,
+        "segments": segments, "d": d,
+        "bounds_count": len(bounds) - 1,
+        "ms_per_step": round(pipe_ms, 3),
+        "ms_per_step_serial": round(serial_ms, 3),
+        "overlap_frac": round(ofrac, 4),
+        "wire": ledger,
+    }
+    # structural pins: the effective segment count is what the bounds
+    # algebra says (small d collapses S), and the ledger's per-segment
+    # physical bytes SUM to the per-step row — satellite 3's honesty pin
+    sums_ok = (
+        sum(seg_block["physical_bytes_per_worker"])
+        == ledger["physical_bytes_per_worker"]
+        and sum(seg_block["physical_bytes_per_step"])
+        == ledger["physical_bytes_per_step"]
+        and seg_block["count"] == len(bounds) - 1
+        and seg_block["bounds"] == list(bounds))
+    # a pipelined multi-segment run must measure overlap; single-segment
+    # and serial rails must measure none (the control that proves the
+    # overlap metric live)
+    row["ok"] = bool(sums_ok and (ofrac > 0.0 if segments > 1
+                                  and len(bounds) > 2 else ofrac == 0.0))
+    return row
+
+
+def capture_timeline(code, d: int, row: dict, args, work_dir: str) -> dict:
+    """Re-run the winning pipelined cell once under the span tracer + a
+    jax profiler capture; merge both event streams onto one clock
+    (obs/device_attr.merge_timeline) into the work dir."""
+    import gzip
+
+    from draco_tpu.obs import device_attr, numerics as nx
+    from draco_tpu.obs.profiling import ANCHOR_FILE, ProfilerWindow
+    from draco_tpu.obs.tracer import make_tracer
+
+    cfg = _study_cfg(row["dtype"], row["segments"], args)
+    block = cfg.shadow_block if row["dtype"] == "int8" else 1
+    bounds = nx.cfg_segment_bounds(cfg, d)
+    rel_tol, lam = nx.wire_decode_params(cfg)
+    wire_re, wire_im, f = _build_wire(code, d, row["dtype"], block)
+    segs = _segment_payloads(bounds, wire_re, wire_im, f, row["dtype"],
+                             block)
+    dec = _make_decode(code, row["dtype"], block, rel_tol, lam)
+    _drive(segs, dec, True, 1)  # compile outside the capture
+
+    cell_dir = os.path.join(work_dir, "segment_pipeline")
+    os.makedirs(cell_dir, exist_ok=True)
+    tracer = make_tracer(cell_dir)
+    win = ProfilerWindow(cell_dir, (0, 10 ** 9), tracer=tracer)
+    win.maybe_start(0, first_step=0)
+    try:
+        _drive(segs, dec, True, 1, tracer=tracer)
+    finally:
+        win.stop()
+        tracer.close()
+
+    host = device_attr.load_json(os.path.join(cell_dir, "trace.json"))
+    host_events = (host or {}).get("traceEvents") or []
+    anchor = device_attr.load_json(os.path.join(cell_dir, ANCHOR_FILE))
+    cap = device_attr.find_capture(cell_dir)
+    dev_events = []
+    if cap is not None:
+        dev_events, _ = device_attr.load_trace(cap)
+    merged = device_attr.merge_timeline(host_events, dev_events, None,
+                                        anchor, max_device_events=50_000)
+    out_path = os.path.join(cell_dir, "merged_timeline.json.gz")
+    with gzip.open(out_path, "wt") as fh:
+        json.dump(merged, fh)
+    mt = merged["mergedTimeline"]
+    seg_spans = sum(1 for e in host_events
+                    if str(e.get("name", "")).startswith("segment_"))
+    # path relative to the work dir (device_profile.py discipline: the
+    # committed artifact must not embed a machine-local temp path)
+    rel = os.path.join(os.path.basename(cell_dir.rstrip(os.sep)),
+                       os.path.basename(out_path))
+    return {"path": rel, "cell": f"{row['dtype']}.s{row['segments']}",
+            "anchored": mt["anchored"], "anchor_kind": mt.get("anchor_kind"),
+            "host_events": len(host_events), "segment_spans": seg_spans,
+            "device_events": sum(1 for e in merged["traceEvents"]
+                                 if e.get("cat") == "device")}
+
+
+# --------------------------------------------------------------------------
+# --check: jax-free artifact re-verification (tools/check_artifacts.py)
+# --------------------------------------------------------------------------
+
+
+def check_artifact(path: str) -> int:
+    """Re-verify a committed segment_study.json: the per-row segment-bytes
+    sums + bounds algebra, the S=1 base rows, the overlap/win acceptance
+    pins (ISSUE 16), and the roll-up. Exits nonzero naming the first
+    failure."""
+    try:
+        with open(path) as fh:
+            data = json.load(fh)
+    except (OSError, ValueError) as e:
+        print(f"segment_study --check: cannot read {path}: {e}")
+        return 1
+    rows = data.get("rows", [])
+    want = {(dt, s) for dt in DTYPES for s in SEGMENTS}
+    got = {(r.get("dtype"), r.get("segments")) for r in rows}
+    if not want <= got:
+        print(f"segment_study --check: missing cells {sorted(want - got)}")
+        return 1
+    for r in rows:
+        cell = f"{r['dtype']}.s{r['segments']}"
+        w = r.get("wire") or {}
+        seg = w.get("segments") or {}
+        bounds = seg.get("bounds") or []
+        if seg.get("count") != len(bounds) - 1 or r.get("bounds_count") \
+                != seg.get("count"):
+            print(f"segment_study --check: {cell}: segment count "
+                  f"{seg.get('count')} disagrees with bounds {bounds}")
+            return 1
+        if bounds[0] != 0 or bounds[-1] != w.get("dim") \
+                or any(a >= b for a, b in zip(bounds[:-1], bounds[1:])):
+            print(f"segment_study --check: {cell}: bounds not a monotone "
+                  f"cover of [0, dim): {bounds}")
+            return 1
+        if sum(seg.get("physical_bytes_per_worker", [])) \
+                != w.get("physical_bytes_per_worker"):
+            print(f"segment_study --check: {cell}: per-segment worker "
+                  f"bytes do not sum to the per-step ledger row")
+            return 1
+        if sum(seg.get("physical_bytes_per_step", [])) \
+                != w.get("physical_bytes_per_step"):
+            print(f"segment_study --check: {cell}: per-segment step bytes "
+                  f"do not sum to the per-step ledger row")
+            return 1
+        if r["segments"] == 1 and r.get("overlap_frac") != 0.0:
+            print(f"segment_study --check: {cell}: S=1 row measured "
+                  f"nonzero overlap — the no-pipeline base is broken")
+            return 1
+        if not r.get("ok"):
+            print(f"segment_study --check: {cell}: row not ok")
+            return 1
+    win = data.get("win") or {}
+    if not (win.get("segments", 0) > 1 and win.get("overlap_frac", 0.0)
+            > 0.0 and win.get("ms_per_step_win", 0.0) > 0.0):
+        print(f"segment_study --check: no pipelined S>1 cell beats the "
+              f"S=1 base with measured overlap (win={win}) — the ISSUE 16 "
+              f"acceptance pin")
+        return 1
+    mt = data.get("merged_timeline") or {}
+    if not mt.get("segment_spans", 0) > 0:
+        print("segment_study --check: merged timeline carries no "
+              "segment_* spans")
+        return 1
+    if not data.get("all_ok"):
+        print("segment_study --check: all_ok is false")
+        return 1
+    print(f"segment_study --check: {len(rows)} cells verified ({path})")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", type=str,
+                    default=os.path.join("baselines_out",
+                                         "segment_study.json"))
+    ap.add_argument("--trials", type=int, default=TRIALS)
+    ap.add_argument("--model-dim", type=int, default=256)
+    ap.add_argument("--model-heads", type=int, default=4)
+    ap.add_argument("--model-layers", type=int, default=2)
+    ap.add_argument("--vocab", type=int, default=512)
+    ap.add_argument("--work-dir", type=str, default="",
+                    help="dir for the merged-timeline artifact "
+                         "(default: a temp dir, printed at exit)")
+    ap.add_argument("--check", action="store_true",
+                    help="re-verify a committed artifact (jax-free)")
+    ap.add_argument("--artifact", type=str, default="",
+                    help="artifact path for --check (default --out)")
+    args = ap.parse_args(argv)
+    if args.check:
+        return check_artifact(args.artifact or args.out)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from draco_tpu.coding import cyclic as cyclic_mod
+
+    d = _lm_dim(args)
+    code = cyclic_mod.build_cyclic_code(N_WORKERS, S_FAULTS)
+    print(f"segment_study: sp LM route d={d} n={N_WORKERS} s={S_FAULTS}",
+          flush=True)
+    rows = []
+    for dtype in DTYPES:
+        for s in SEGMENTS:
+            row = run_cell(code, d, dtype, s, args)
+            rows.append(row)
+            print(f"segment_study: {dtype:4s} S={s} -> "
+                  f"pipelined={row['ms_per_step']:.1f}ms "
+                  f"serial={row['ms_per_step_serial']:.1f}ms "
+                  f"overlap={row['overlap_frac']:.3f} ok={row['ok']}",
+                  flush=True)
+
+    # the win block perf_watch gates: the best pipelined S>1 cell vs its
+    # own dtype's S=1 base
+    base = {r["dtype"]: r["ms_per_step"] for r in rows
+            if r["segments"] == 1}
+    best, best_win = None, 0.0
+    for r in rows:
+        if r["segments"] <= 1:
+            continue
+        w = base[r["dtype"]] - r["ms_per_step"]
+        if w > best_win:
+            best, best_win = r, w
+    win = {}
+    if best is not None:
+        win = {"route": best["route"], "dtype": best["dtype"],
+               "segments": best["segments"],
+               "ms_per_step": best["ms_per_step"],
+               "ms_per_step_s1": base[best["dtype"]],
+               "ms_per_step_win": round(best_win, 3),
+               "win_frac": round(best_win / base[best["dtype"]], 4),
+               "overlap_frac": best["overlap_frac"]}
+        print(f"segment_study: win {best['dtype']} S={best['segments']} "
+              f"-> -{best_win:.1f}ms/step "
+              f"({100 * win['win_frac']:.1f}%)", flush=True)
+
+    work_dir = args.work_dir or tempfile.mkdtemp(prefix="segment_study_")
+    merged = {}
+    if best is not None:
+        merged = capture_timeline(code, d, best, args, work_dir)
+        print(f"segment_study: merged timeline -> "
+              f"{os.path.join(work_dir, merged['path'])} "
+              f"(anchored={merged['anchored']}, "
+              f"{merged['segment_spans']} segment spans)", flush=True)
+
+    payload = {
+        "schema": 1,
+        "tool": "tools/segment_study.py",
+        "num_workers": N_WORKERS, "s": S_FAULTS, "d": d,
+        "model": {"network": "TransformerLM", "dim": args.model_dim,
+                  "heads": args.model_heads, "layers": args.model_layers,
+                  "vocab": args.vocab},
+        "trials": args.trials,
+        "rows": rows,
+        "win": win,
+        "merged_timeline": merged,
+        "all_ok": bool(rows) and all(r["ok"] for r in rows)
+        and bool(win) and win["ms_per_step_win"] > 0.0
+        and win["overlap_frac"] > 0.0
+        and merged.get("segment_spans", 0) > 0,
+    }
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+    with open(args.out, "w") as fh:
+        json.dump(payload, fh, indent=1, sort_keys=True)
+    print(f"segment_study: {len(rows)} cells -> {args.out} "
+          f"(all_ok={payload['all_ok']})")
+    return 0 if payload["all_ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

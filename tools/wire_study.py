@@ -54,9 +54,10 @@ flag-agreement columns gate round-over-round as pinned tolerance-0 kinds
 (proven live by the flipped-row control in tests/test_cli_tools.py), the
 detection bools at tolerance 0, wire bytes at the bytes tolerance.
 
-``--check`` re-verifies a committed artifact jax-free (ledger arithmetic,
-bf16 detection-preserved pins, all_ok roll-up) — wired into
-tools/check_artifacts.py.
+``--check`` re-verifies a committed artifact jax-free (ledger arithmetic
+— including the ISSUE 16 pin that the ledger's per-segment physical bytes
+sum exactly to the per-worker/per-step rows — bf16 detection-preserved
+pins, all_ok roll-up) — wired into tools/check_artifacts.py.
 
 Usage (CPU, ~2 min):
   python tools/wire_study.py --cpu-mesh 8
@@ -409,6 +410,25 @@ def check_artifact(path: str) -> int:
                 < per.get("f32", 0)):
             print(f"wire_study --check: {cell}: dtype ordering broken "
                   f"({per})")
+            return 1
+        # ISSUE 16: the ledger's per-segment physical bytes must SUM to
+        # the per-worker/per-step rows exactly — a segment boundary can
+        # never create or destroy wire bytes
+        seg = w.get("segments")
+        if not isinstance(seg, dict):
+            print(f"wire_study --check: {cell}: ledger carries no "
+                  f"segments block — regenerate with the segmented "
+                  f"wire_ledger (ISSUE 16)")
+            return 1
+        bounds = seg.get("bounds") or []
+        if (sum(seg.get("physical_bytes_per_worker", []))
+                != w.get("physical_bytes_per_worker")
+                or sum(seg.get("physical_bytes_per_step", []))
+                != w.get("physical_bytes_per_step")
+                or seg.get("count") != len(bounds) - 1
+                or bounds[:1] != [0] or bounds[-1:] != [dim]):
+            print(f"wire_study --check: {cell}: per-segment bytes do not "
+                  f"sum to the per-step ledger row (segments={seg})")
             return 1
         if r["dtype"] == "bf16" and not r.get("det_preserved"):
             print(f"wire_study --check: {cell}: bf16 wire lost "
