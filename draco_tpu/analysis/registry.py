@@ -158,13 +158,14 @@ def collect() -> "list[LintProgram]":
     """All registered programs, by importing each route module and asking it
     for ``lint_programs()``. Import order is the route order; names must be
     unique across routes."""
+    from draco_tpu.coding import topology
     from draco_tpu.ops import decode_kernels
     from draco_tpu.parallel import ep_step, pp_step, sp_step, tp_step
     from draco_tpu.training import step as cnn_step
 
     programs: list[LintProgram] = []
     for mod in (cnn_step, sp_step, tp_step, pp_step, ep_step,
-                decode_kernels):
+                decode_kernels, topology):
         programs.extend(mod.lint_programs())
     names = [p.name for p in programs]
     dupes = {n for n in names if names.count(n) > 1}

@@ -226,6 +226,28 @@ def add_fit_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                         "bit-for-bit; cuts align to the segment quantum "
                         "(TILE_D, else --shadow-block) so narrow buffers "
                         "are segment-invariant. Coded approaches only")
+    p.add_argument("--topology", type=str, default="flat",
+                   choices=["flat", "tree"],
+                   help="aggregation topology (ISSUE 17, CodedReduce "
+                        "arXiv:1902.01981): flat keeps the star — all n "
+                        "codewords decode at one logical point; tree "
+                        "partitions the worker axis into n/g leaf groups "
+                        "of constant fan-in g (--tree-fanout), each "
+                        "running the ONE shared small code at the per-"
+                        "group budget s_g = min(s, (g-1)//4), decoded "
+                        "partials combining level-structured — per-node "
+                        "decode cost and ingest bytes are O(g·d), "
+                        "independent of n. Cyclic/approx families, "
+                        "shared redundancy, global decode granularity")
+    p.add_argument("--tree-fanout", type=int, default=4,
+                   help="leaf-group fan-in g under --topology tree: must "
+                        "divide num-workers with at least 2 groups; the "
+                        "per-group Byzantine budget is min(worker-fail, "
+                        "(g-1)//4)")
+    p.add_argument("--tree-levels", type=int, default=0,
+                   help="tree depth L under --topology tree (0 = auto: "
+                        "1 + ceil(log_g(n/g))); interior levels combine "
+                        "decoded partials with fan-in ≤ g")
     p.add_argument("--shadow-wire", type=str, default="off",
                    choices=["off", "bf16", "int8"],
                    help="shadow-quantized coded wire: round the codewords "
@@ -400,6 +422,9 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         numerics_watch=args.numerics_watch,
         wire_dtype=args.wire_dtype,
         wire_segments=args.wire_segments,
+        topology=args.topology,
+        tree_fanout=args.tree_fanout,
+        tree_levels=args.tree_levels,
         shadow_wire=args.shadow_wire,
         shadow_round=args.shadow_round,
         shadow_block=args.shadow_block,

@@ -278,6 +278,25 @@ class TrainConfig:
     # autopilot see one verdict per step. Coded approaches (cyclic/approx)
     # only; d smaller than the quantum collapses back to one segment.
     wire_segments: int = 1
+    # --- hierarchical CodedReduce aggregation (ISSUE 17; ROADMAP item 2) ---
+    # topology="tree" partitions the (n,) worker axis into n/tree_fanout
+    # leaf groups of constant fan-in g (coding/topology.py — the
+    # clustered-assignment window algebra); each group runs its OWN small
+    # code (cyclic at s_g = min(worker_fail, (g-1)//4), capped further by
+    # the per-(g, s, dtype) narrow-wire threshold table; approx at the
+    # configured fractional redundancy), decodes locally, and parents
+    # combine decoded (d,) partials level by level — per-node decode cost
+    # and ingest bytes stay O(g·d) as n grows (arXiv:1902.01981). The
+    # per-group health verdicts fold to one per-step verdict exactly like
+    # the wire-segment fold (residual=max, flagged/accused=union), so
+    # detection P/R is identical to flat. Coded families only
+    # (cyclic/approx, shared redundancy, global decode granularity);
+    # composes with wire_dtype and wire_segments.
+    topology: str = "flat"  # flat | tree
+    tree_fanout: int = 4  # leaf-group size g (must divide num_workers)
+    # total tree levels including the leaf level; 0 = auto
+    # (1 + ceil(log_g(n/g)), coding/topology.auto_levels)
+    tree_levels: int = 0
     # Shadow-quantized wire (obs/numerics.py): round the codewords to the
     # narrow dtype INSIDE the step body, decode the shadow copy alongside
     # the f32 path, and emit shadow_err / shadow_residual /
@@ -396,6 +415,14 @@ class TrainConfig:
         return self.num_workers // self.group_size
 
     @property
+    def tree_group_fail(self) -> int:
+        """Per-group cyclic error budget under topology='tree':
+        min(worker_fail, (g-1)//4) — coding/topology.group_worker_fail."""
+        from draco_tpu.coding.topology import group_worker_fail
+
+        return group_worker_fail(self.tree_fanout, self.worker_fail)
+
+    @property
     def num_adversaries(self) -> int:
         """Live adversaries per step (defaults to the code parameter s)."""
         return self.worker_fail if self.adversary_count is None else self.adversary_count
@@ -457,7 +484,7 @@ class TrainConfig:
                     f"maj_vote with worker_fail={self.worker_fail} requires "
                     f"group_size >= {2 * self.worker_fail + 1} (r = 2s+1)"
                 )
-        if self.approach == "cyclic":
+        if self.approach == "cyclic" and self.topology == "flat":
             if self.num_workers <= 4 * self.worker_fail:
                 # decode needs n-2s honest rows to span C1's n-2s columns and
                 # the locator solve needs 2s syndrome equations
@@ -501,6 +528,58 @@ class TrainConfig:
 
             build_assignment(self.num_workers, self.code_redundancy,
                              self.assignment_scheme)
+        from draco_tpu.coding.topology import TOPOLOGIES, tree_plan
+
+        if self.topology not in TOPOLOGIES:
+            raise ValueError(
+                f"topology must be one of {'|'.join(TOPOLOGIES)}, got "
+                f"{self.topology!r}"
+            )
+        if self.topology == "tree":
+            if self.approach not in ("cyclic", "approx"):
+                raise ValueError(
+                    "topology='tree' supports the algebraic code families "
+                    f"(cyclic|approx), got approach={self.approach!r} — "
+                    "maj_vote's repetition groups are already a one-level "
+                    "tree of constant fan-in 2s+1"
+                )
+            if self.redundancy != "shared":
+                raise ValueError(
+                    "topology='tree' requires redundancy='shared': each "
+                    "leaf group's code mixes its own batch rows in place "
+                    "(the simulate lanes have no per-group shape)"
+                )
+            if self.decode_granularity != "global":
+                raise ValueError(
+                    "topology='tree' requires decode_granularity='global' "
+                    "— the tree already partitions the locator per group; "
+                    "per-layer cuts do not align with the per-group wire "
+                    "blocks (compose with --wire-segments instead)"
+                )
+            if self.shadow_wire != "off":
+                raise ValueError(
+                    "topology='tree' composes with the REAL narrow wire "
+                    "(--wire-dtype) but not the flat shadow decode "
+                    "(--shadow-wire measures the FLAT locator's "
+                    "quantization amplification; run it at topology='flat' "
+                    "before narrowing, then ship the tree)"
+                )
+            # shape errors (divisibility, group count, level feasibility)
+            # surface at config time
+            tree_plan(self.num_workers, self.tree_fanout, self.tree_levels)
+            if self.approach == "cyclic":
+                s_g = self.tree_group_fail
+                if self.num_adversaries > s_g:
+                    # worst case every adversary lands in ONE leaf group
+                    # (the schedules are independent): the small code must
+                    # carry them alone
+                    raise ValueError(
+                        f"tree per-group budget exceeded: adversary_count="
+                        f"{self.num_adversaries} > s_g={s_g} (= min("
+                        f"worker_fail, (tree_fanout-1)//4) — raise "
+                        f"tree_fanout past {4 * self.num_adversaries} or "
+                        f"reduce the adversary load)"
+                    )
         if self.worker_fail > self.num_workers:
             raise ValueError("worker_fail cannot exceed num_workers")
         if self.compute_dtype not in ("float32", "bfloat16"):
@@ -567,11 +646,15 @@ class TrainConfig:
                 # the committed threshold table is the contract
                 from draco_tpu.obs.numerics import wire_rel_tol
 
-                if not (wire_rel_tol(self.num_workers, self.worker_fail,
-                                     self.wire_dtype) < 1.0):
+                # tree decodes per GROUP: the threshold that matters is the
+                # small code's shape (g, s_g), not (n, s)
+                wn, ws = ((self.tree_fanout, self.tree_group_fail)
+                          if self.topology == "tree"
+                          else (self.num_workers, self.worker_fail))
+                if not (wire_rel_tol(wn, ws, self.wire_dtype) < 1.0):
                     raise ValueError(
                         f"no usable narrow-wire flag threshold at "
-                        f"(n={self.num_workers}, s={self.worker_fail}, "
+                        f"(n={wn}, s={ws}, "
                         f"{self.wire_dtype}) — run tools/wire_study.py at "
                         f"this shape, or route the narrow wire through "
                         f"approach=approx (no locator to amplify the "
@@ -731,13 +814,20 @@ class TrainConfig:
                 # Erasures cost one redundancy unit, unknown errors two. The
                 # decoder covers erasure-only (t=0, e <= 2s) and the joint
                 # regime (t + e <= s), where the locator treats missing rows
-                # as one error each.
-                if not ((t == 0 and e <= 2 * s) or (t + e <= s)):
+                # as one error each. Under topology='tree' the budget is the
+                # PER-GROUP one (worst case every straggler and adversary
+                # lands in a single leaf group — the schedules are
+                # independent of the group partition).
+                s_eff = self.tree_group_fail if self.topology == "tree" \
+                    else s
+                if not ((t == 0 and e <= 2 * s_eff) or (t + e <= s_eff)):
+                    label = ("per-group (tree) " if self.topology == "tree"
+                             else "")
                     raise ValueError(
-                        f"cyclic straggler budget exceeded: need "
-                        f"adversary_count + straggle_count <= worker_fail "
-                        f"({t}+{e} <= {s}), or adversary_count == 0 with "
-                        f"straggle_count <= 2*worker_fail ({e} <= {2 * s})"
+                        f"cyclic {label}straggler budget exceeded: need "
+                        f"adversary_count + straggle_count <= s "
+                        f"({t}+{e} <= {s_eff}), or adversary_count == 0 "
+                        f"with straggle_count <= 2*s ({e} <= {2 * s_eff})"
                     )
             if self.approach == "approx":
                 import math

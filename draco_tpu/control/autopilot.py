@@ -99,6 +99,16 @@ DEFAULT_POLICY: Dict[str, float] = {
     "segments_up_boundaries": 1.0,
     "segments_down_boundaries": 4.0,
     "segments_max": 4.0,
+    # tree-fanout dial (ISSUE 17): SECOND rung of the straggler ladder —
+    # once the segment dial is maxed and straggle evidence persists, the
+    # tree fanout halves (each combine node waits on fewer children, so a
+    # slow child stalls a smaller subtree), never past fanout_min; sustained
+    # straggle-quiet evidence doubles it back toward the configured fanout.
+    # Same family, warm cached program swaps under `_g{fanout}` tags. Only
+    # live when the run was launched with --topology tree.
+    "fanout_down_boundaries": 2.0,
+    "fanout_up_boundaries": 4.0,
+    "fanout_min": 2.0,
 }
 
 # incident types that count as ADVERSARY evidence: any of these open (or
@@ -148,6 +158,10 @@ class Regime:
     # remediations move this along 1 ↔ 2 ↔ 4 ... (capped by policy
     # segments_max) as warm cached program swaps
     wire_segments: int = 1
+    # tree topology (ISSUE 17): the leaf-group fan-in, 0 = flat. The
+    # fanout_down/fanout_up remediations halve/double it along
+    # base ↔ ... ↔ fanout_min as warm cached program swaps
+    tree_fanout: int = 0
 
     @property
     def tag(self) -> str:
@@ -158,21 +172,26 @@ class Regime:
             t += f"_wire{self.wire_dtype}"
         if self.wire_segments != 1:
             t += f"_seg{self.wire_segments}"
+        if self.tree_fanout:
+            t += f"_g{self.tree_fanout}"
         return t
 
     def as_dict(self) -> dict:
         return {"approach": self.approach, "redundancy": self.redundancy,
                 "shadow_wire": self.shadow_wire,
                 "wire_dtype": self.wire_dtype,
-                "wire_segments": self.wire_segments, "tag": self.tag}
+                "wire_segments": self.wire_segments,
+                "tree_fanout": self.tree_fanout, "tag": self.tag}
 
 
 def base_regime(cfg) -> Regime:
     r = (2 * cfg.worker_fail + 1 if cfg.approach == "cyclic"
          else float(cfg.code_redundancy))
+    fanout = (int(cfg.tree_fanout)
+              if getattr(cfg, "topology", "flat") == "tree" else 0)
     return Regime(cfg.approach, float(r), cfg.shadow_wire,
                   getattr(cfg, "wire_dtype", "f32"),
-                  int(getattr(cfg, "wire_segments", 1)))
+                  int(getattr(cfg, "wire_segments", 1)), fanout)
 
 
 def regime_cfg(base_cfg, regime: Regime, quarantined: int = 0):
@@ -187,6 +206,17 @@ def regime_cfg(base_cfg, regime: Regime, quarantined: int = 0):
     kw = {"approach": regime.approach, "shadow_wire": regime.shadow_wire,
           "wire_dtype": regime.wire_dtype,
           "wire_segments": regime.wire_segments}
+    # tree topology rides the regime (ISSUE 17): a dialed fanout keeps the
+    # family's tree shape; depth re-derives (auto) when the fanout moved
+    # off the launch value, since the pinned level count may be infeasible
+    # at the new group count
+    if regime.tree_fanout:
+        kw["topology"] = "tree"
+        kw["tree_fanout"] = regime.tree_fanout
+        if regime.tree_fanout != int(getattr(base_cfg, "tree_fanout", 0)):
+            kw["tree_levels"] = 0
+    else:
+        kw["topology"] = "flat"
     plan = plan_from_cfg(base_cfg)
     if plan is not None:
         kw["fault_spec"] = ",".join(ev.spec() for ev in plan.events
@@ -381,6 +411,28 @@ class Autopilot:
                     "wire_segments_before": self.regime.wire_segments,
                     "wire_segments_after": target.wire_segments,
                 })
+            elif (self.regime.tree_fanout
+                  and self._strag_hot
+                  >= self.policy["fanout_down_boundaries"]
+                  and self.regime.tree_fanout % 2 == 0
+                  and self.regime.tree_fanout // 2
+                  >= int(self.policy["fanout_min"])
+                  and self._fanout_ok(self.regime.tree_fanout // 2)):
+                # fanout_down (ISSUE 17): the straggler ladder's SECOND
+                # rung — the segment dial is maxed (or spent) and straggle
+                # persists, so the tree fanout halves: every combine node
+                # waits on half the children, shrinking the subtree one
+                # slow worker can stall. Same family, same certificate;
+                # a warm cached program swap under the `_g{fanout}` tag.
+                trigger = (open_eps.get("straggle")
+                           or open_eps.get("starvation"))
+                target = dataclasses.replace(
+                    self.regime, tree_fanout=self.regime.tree_fanout // 2)
+                self._swap(step, client, target, "fanout_down", trigger, {
+                    "straggle_boundaries": self._strag_hot,
+                    "tree_fanout_before": self.regime.tree_fanout,
+                    "tree_fanout_after": target.tree_fanout,
+                })
             elif (self.regime.approach == "cyclic"
                   and self._strag_hot >= self.policy["dial_down_boundaries"]
                   and self._adv_quiet >= self.policy["clean_boundaries"]
@@ -389,7 +441,8 @@ class Autopilot:
                            or open_eps.get("starvation"))
                 target = Regime("approx", float(self.policy["r_low"]),
                                 self.regime.shadow_wire,
-                                self.regime.wire_dtype)
+                                self.regime.wire_dtype,
+                                tree_fanout=self.regime.tree_fanout)
                 self._swap(step, client, target, "dial_down", trigger, {
                     "straggle_boundaries": self._strag_hot,
                     "adversary_quiet_boundaries": self._adv_quiet,
@@ -420,6 +473,24 @@ class Autopilot:
                                "restores": "exact decode + Byzantine "
                                            "certificate",
                            })
+            elif (self.regime.tree_fanout and self.base.tree_fanout
+                  and self.regime.tree_fanout < self.base.tree_fanout
+                  and self._strag_quiet
+                  >= self.policy["fanout_up_boundaries"]):
+                # fanout_up: sustained straggle-quiet evidence doubles the
+                # fanout back toward the configured one (never past it) —
+                # wider groups restore the per-group budget s_g and cut
+                # the level count on a quiet fleet
+                trigger = self._last_cleared(_STRAGGLE_TYPES)
+                target = dataclasses.replace(
+                    self.regime,
+                    tree_fanout=min(2 * self.regime.tree_fanout,
+                                    self.base.tree_fanout))
+                self._swap(step, client, target, "fanout_up", trigger, {
+                    "straggle_quiet_boundaries": self._strag_quiet,
+                    "tree_fanout_before": self.regime.tree_fanout,
+                    "tree_fanout_after": target.tree_fanout,
+                })
             elif (self.regime.wire_segments > self.base.wire_segments
                   and self._strag_quiet
                   >= self.policy["segments_down_boundaries"]):
@@ -438,6 +509,24 @@ class Autopilot:
                     "wire_segments_after": target.wire_segments,
                 })
         self.heartbeat.set_control(self.status_block())
+
+    def _fanout_ok(self, fanout: int) -> bool:
+        """A dialed fanout must keep a buildable tree (divisibility, ≥2
+        groups) and — for cyclic — a per-group budget s_g that still
+        carries the DECLARED adversary load (the worst case lands every
+        adversary in one leaf group, config.validate's rule mirrored
+        dynamically)."""
+        from draco_tpu.coding.topology import group_worker_fail, tree_plan
+
+        try:
+            tree_plan(self.cfg.num_workers, fanout)
+        except ValueError:
+            return False
+        if self.regime.approach == "cyclic":
+            s_g = group_worker_fail(fanout, self.cfg.worker_fail)
+            if self.cfg.num_adversaries > s_g:
+                return False
+        return True
 
     def _dial_down_allowed(self, step: int) -> bool:
         """The approx family cannot express a Byzantine attack — the

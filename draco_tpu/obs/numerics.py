@@ -348,7 +348,7 @@ def wire_ledger(cfg, dim: int) -> dict:
     wire_dtype = getattr(cfg, "wire_dtype", "f32")
     bounds = cfg_segment_bounds(cfg, dim)
     seg_worker = _segment_bytes(bounds, rows, wire_dtype, block)
-    return {
+    ledger = {
         "family": cfg.approach,
         "dim": int(dim),
         "num_workers": n,
@@ -371,6 +371,18 @@ def wire_ledger(cfg, dim: int) -> dict:
             "physical_bytes_per_step": [v * n for v in seg_worker],
         },
     }
+    # hierarchical tree wire (ISSUE 17): per-level ingest bytes. Level 0
+    # (leaves) carries the same n physical codewords as the flat wire —
+    # level_bytes_per_step[0] == physical_bytes_per_step EXACTLY — and
+    # each parent level carries one f32 decoded partial per child group
+    # (perf_watch pins the sum identity on the committed study).
+    if getattr(cfg, "topology", "flat") == "tree":
+        from draco_tpu.coding.topology import tree_ledger_block
+
+        ledger["tree"] = tree_ledger_block(
+            n, int(cfg.tree_fanout), int(getattr(cfg, "tree_levels", 0)),
+            int(dim), per_worker[wire_dtype])
+    return ledger
 
 
 # --------------------------------------------------------------------------
@@ -637,16 +649,20 @@ def widen_wire_rows(buf: dict, mode: str, block: int = DEFAULT_BLOCK):
     return q.astype(jnp.float32) * wide
 
 
-def wire_decode_params(cfg):
+def wire_decode_params(cfg, n=None, s=None):
     """(rel_tol, lam) the cyclic decode runs with at ``cfg``'s wire dtype:
     (None, 0.0) on the f32 wire — the caller keeps HEALTH_REL_TOL and the
     exact λ=0 solve bitwise — else the committed per-(n, s, dtype)
-    threshold and the dtype's locator λ."""
+    threshold and the dtype's locator λ. ``n``/``s`` override the flat
+    (num_workers, worker_fail) shape — the tree route decodes each leaf
+    group at the GROUP shape (fanout, s_g), so its thresholds come from
+    that row of the table, not the flat one."""
     dtype = getattr(cfg, "wire_dtype", "f32")
     if dtype == "f32":
         return None, 0.0
-    return (wire_rel_tol(cfg.num_workers, cfg.worker_fail, dtype),
-            wire_locator_lambda(dtype))
+    n = cfg.num_workers if n is None else n
+    s = cfg.worker_fail if s is None else s
+    return wire_rel_tol(n, s, dtype), wire_locator_lambda(dtype)
 
 
 def narrow_wire_pair(cfg, enc_re, enc_im, step=None, constrain=None):

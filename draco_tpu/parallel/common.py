@@ -20,13 +20,30 @@ from draco_tpu.coding import cyclic as cyclic_mod
 def build_code_from_cfg(cfg):
     """The route-shared code constructor: CyclicCode for approach="cyclic",
     ApproxCode for "approx", None otherwise — one place so the CNN path and
-    every LM route build the identical code from a config."""
+    every LM route build the identical code from a config. Under
+    ``topology == "tree"`` (ISSUE 17) the constructor returns a TreeCode
+    wrapping ONE small group code at the (fanout, s_g) shape — the
+    aggregation tails below dispatch on the code type, so every route gets
+    the hierarchical path through the same seam."""
+    if (cfg.approach in ("cyclic", "approx")
+            and getattr(cfg, "topology", "flat") == "tree"):
+        from draco_tpu.coding import topology as topology_mod
+
+        return topology_mod.build_tree_code(cfg)
     if cfg.approach == "cyclic":
         return cyclic_mod.build_cyclic_code(cfg.num_workers, cfg.worker_fail)
     if cfg.approach == "approx":
         return approx_mod.build_approx_code(
             cfg.num_workers, cfg.code_redundancy, cfg.assignment_scheme)
     return None
+
+
+def _is_tree(code) -> bool:
+    """Code-type dispatch for the aggregation tails (lazy import so the
+    flat path's import graph is untouched)."""
+    from draco_tpu.coding import topology as topology_mod
+
+    return isinstance(code, topology_mod.TreeCode)
 
 
 def segment_decode_bounds(cfg, dim: int, leaf_offsets=None):
@@ -71,9 +88,15 @@ def approx_aggregate(code, grads: jnp.ndarray, present=None, constrain=None,
 
     decode_impl = resolve_decode_impl(
         getattr(cfg, "decode_impl", "xla") if cfg is not None else "xla")
+    tree = _is_tree(code)
     bad_rows = forensics_mod.nonfinite_rows(grads)
     with jax.named_scope("draco_encode"):
-        rows = approx_mod.encode_shared(code, grads)
+        if tree:
+            from draco_tpu.coding import topology as topology_mod
+
+            rows = topology_mod.encode_tree(code, grads)
+        else:
+            rows = approx_mod.encode_shared(code, grads)
         if present is not None:
             rows = jnp.where(jnp.asarray(present).astype(bool)[:, None],
                              rows, jnp.zeros_like(rows))
@@ -90,7 +113,18 @@ def approx_aggregate(code, grads: jnp.ndarray, present=None, constrain=None,
     segments = (int(getattr(cfg, "wire_segments", 1))
                 if cfg is not None else 1)
     with jax.named_scope("draco_decode"):
-        if segments > 1:
+        if tree:
+            # hierarchical tree aggregation (ISSUE 17): per-group optimal
+            # decoding at the (g, d) block, level-structured combine, root
+            # residual + Cauchy-Schwarz-folded bound (decode_tree_approx)
+            from draco_tpu.coding import topology as topology_mod
+
+            bounds = (numerics_mod.cfg_segment_bounds(
+                cfg, int(rows.shape[-1])) if segments > 1 else None)
+            agg, _v, health = topology_mod.decode_tree_approx(
+                code, rows, present=present, batch_grads=grads,
+                impl=decode_impl, wire=wire, bounds=bounds)
+        elif segments > 1:
             # streaming segmented wire (ISSUE 16): the presence-only
             # weight solve runs once; each segment combines on arrival and
             # the residual accumulators fold to one per-step verdict
@@ -170,8 +204,17 @@ def aggregate_flat_grads(grads: jnp.ndarray, adv_mask, cfg, code, rand_factor,
         # codeword (0·NaN = NaN in the masked matmul), so the wire rows
         # cannot (obs/forensics.nonfinite_rows docstring)
         bad_rows = forensics_mod.nonfinite_rows(grads)
+        tree = _is_tree(code)
         with jax.named_scope("draco_encode"):
-            if grads.ndim == 3:
+            if tree:
+                # hierarchical tree encode (ISSUE 17): each leaf group
+                # encodes with the ONE shared small code — rows stay
+                # worker-indexed (n, d), so injection/presence/wire below
+                # are byte-identical to flat
+                from draco_tpu.coding import topology as topology_mod
+
+                enc_re, enc_im = topology_mod.encode_tree(code, grads)
+            elif grads.ndim == 3:
                 # (n, hat_s, d): true per-worker redundant lanes
                 # (cfg.redundancy == "simulate" — the reference's r× compute,
                 # cyclic_worker.py:122-146); each worker encodes its own rows
@@ -197,12 +240,31 @@ def aggregate_flat_grads(grads: jnp.ndarray, adv_mask, cfg, code, rand_factor,
         # Tikhonov-regularized locator. Identity on the f32 wire.
         enc_re, enc_im, wire = numerics_mod.narrow_wire_pair(
             cfg, enc_re, enc_im, step=step)
-        wire_tol, wire_lam = numerics_mod.wire_decode_params(cfg)
+        if tree:
+            # the tree decodes each leaf group at the GROUP shape — its
+            # narrow-wire thresholds come from the (fanout, s_g) table row
+            wire_tol, wire_lam = numerics_mod.wire_decode_params(
+                cfg, n=code.plan.fanout, s=code.group_code.s)
+        else:
+            wire_tol, wire_lam = numerics_mod.wire_decode_params(cfg)
         rel_tol = (cyclic_mod.HEALTH_REL_TOL if wire_tol is None
                    else wire_tol)
         segments = int(getattr(cfg, "wire_segments", 1))
         with jax.named_scope("draco_decode"):
-            if cfg.decode_granularity == "layer":
+            if tree:
+                # hierarchical decode (ISSUE 17): per-group small-n decode
+                # (segmented when the streaming wire is on), level-
+                # structured combine, PR 16-style health fold — same
+                # health keys as flat, so every consumer below is shared
+                from draco_tpu.coding import topology as topology_mod
+
+                bounds = (numerics_mod.cfg_segment_bounds(
+                    cfg, int(grads.shape[-1])) if segments > 1 else None)
+                agg, _honest, health = topology_mod.decode_tree_cyclic(
+                    code, enc_re, enc_im, rand_factor, present=present,
+                    rel_tol=rel_tol, impl=decode_impl, lam=wire_lam,
+                    wire=wire, bounds=bounds)
+            elif cfg.decode_granularity == "layer":
                 if leaf_offsets is None:
                     raise ValueError(
                         "decode_granularity='layer' needs leaf_offsets from "

@@ -403,10 +403,19 @@ def build_train_setup(cfg: TrainConfig, mesh, dataset_name: Optional[str] = None
             return new_state, out
 
     elif cfg.approach == "cyclic":
-        code = cyclic_mod.build_cyclic_code(n, cfg.worker_fail)
+        # one shared constructor with the LM routes: CyclicCode flat, or —
+        # under topology="tree" (ISSUE 17) — a TreeCode wrapping the ONE
+        # small group code at the (fanout, s_g) shape
+        from draco_tpu.parallel.common import build_code_from_cfg
+
+        code = build_code_from_cfg(cfg)
+        tree = getattr(cfg, "topology", "flat") == "tree"
+        if tree:
+            from draco_tpu.coding import topology as topology_mod
         rep_code = None
-        batch_ids = jnp.asarray(code.batch_ids)  # (n, hat_s)
-        hat_s = code.hat_s
+        if not tree:
+            batch_ids = jnp.asarray(code.batch_ids)  # (n, hat_s)
+            hat_s = code.hat_s
         # decode lowering (ISSUE 12): resolved ONCE per setup — dispatch
         # depends only on cfg + the attached backend, so the jitted step
         # bodies close over a static tag (no retraces)
@@ -435,7 +444,14 @@ def build_train_setup(cfg: TrainConfig, mesh, dataset_name: Optional[str] = None
                     "grad", [grads], cfg.shadow_block)
                     if cfg.numerics_watch == "on" else {})
                 with jax.named_scope("draco_encode"):
-                    enc_re, enc_im = cyclic_mod.encode_shared(code, grads)
+                    if tree:
+                        # each leaf group encodes with the shared small
+                        # code; rows stay worker-indexed (n, d)
+                        enc_re, enc_im = topology_mod.encode_tree(code,
+                                                                  grads)
+                    else:
+                        enc_re, enc_im = cyclic_mod.encode_shared(code,
+                                                                  grads)
                 return (enc_re, enc_im, new_stats, losses, precs, bad_rows,
                         grad_watch)
 
@@ -520,12 +536,31 @@ def build_train_setup(cfg: TrainConfig, mesh, dataset_name: Optional[str] = None
             # quantization-aware flag threshold + locator λ for the narrow
             # wire (obs/numerics.wire_decode_params; f32 keeps the exact
             # HEALTH_REL_TOL / λ=0 path bitwise)
-            wire_tol, wire_lam = numerics_mod.wire_decode_params(cfg)
+            if tree:
+                # per-group decode runs at the GROUP shape: thresholds
+                # come from the (fanout, s_g) table row, not the flat one
+                wire_tol, wire_lam = numerics_mod.wire_decode_params(
+                    cfg, n=code.plan.fanout, s=code.group_code.s)
+            else:
+                wire_tol, wire_lam = numerics_mod.wire_decode_params(cfg)
             rel_tol = (cyclic_mod.HEALTH_REL_TOL if wire_tol is None
                        else wire_tol)
             segments = int(getattr(cfg, "wire_segments", 1))
             with jax.named_scope("draco_decode"):
-                if cfg.decode_granularity == "layer":
+                if tree:
+                    # hierarchical decode (ISSUE 17): per-group small-n
+                    # decode (segmented under the streaming wire), level-
+                    # structured combine, PR 16-style fold — honest comes
+                    # back already folded to (n,)
+                    bounds = (numerics_mod.cfg_segment_bounds(cfg, dim)
+                              if segments > 1 else None)
+                    decoded, honest, health = (
+                        topology_mod.decode_tree_cyclic(
+                            code, enc_re, enc_im, rand_factor,
+                            present=present, rel_tol=rel_tol,
+                            impl=decode_impl, lam=wire_lam, wire=wire,
+                            bounds=bounds))
+                elif cfg.decode_granularity == "layer":
                     if segments > 1:
                         # streaming segmented wire (ISSUE 16): the decode
                         # partition refines the leaf boundaries by the
@@ -845,4 +880,33 @@ def lint_programs():
                     code_redundancy=1.5, wire_segments=2,
                     wire_dtype="int8", shadow_round="stochastic"),
            require=("i8",), fast=False),
+        # hierarchical tree production programs (ISSUE 17): topology="tree"
+        # partitions the worker axis into n/g leaf groups of constant
+        # fan-in, each running the ONE shared small code; decoded partials
+        # combine level-structured IN-GRAPH (reshape+sum — algebraically
+        # the per-level psum tree, still zero explicit collectives on the
+        # GSPMD production route; the explicit shard_map tree form with its
+        # pinned per-level all_reduce counts registers from
+        # coding/topology.lint_programs). Same six-rule discipline; the
+        # narrow-wire tree row pins that the per-group (g, d) wire blocks
+        # keep the real bf16 buffers (required_dtypes). fast=False:
+        # topology variants of already-fast-swept step bodies.
+        mk("cnn_cyclic_tree_g4_step",
+           cfg=_cfg(topology="tree", tree_fanout=4, adversary_count=0,
+                    redundancy="shared"),
+           fast=False),
+        mk("cnn_cyclic_tree_g4_many_k2",
+           cfg=_cfg(topology="tree", tree_fanout=4, adversary_count=0,
+                    redundancy="shared", step_guard="on"),
+           many=True, fast=False),
+        mk("cnn_cyclic_tree_g4_wire_bf16_many_k2",
+           cfg=_cfg(topology="tree", tree_fanout=4, adversary_count=0,
+                    redundancy="shared", wire_dtype="bf16",
+                    step_guard="on"),
+           many=True, bf16=True, require=("bf16",), fast=False),
+        mk("cnn_approx_tree_g4_step",
+           cfg=_cfg(approach="approx", worker_fail=0, redundancy="shared",
+                    code_redundancy=2.0, assignment_scheme="pairwise",
+                    topology="tree", tree_fanout=4),
+           fast=False),
     ]

@@ -66,10 +66,15 @@ def test_registry_covers_every_route():
     # export IS the per-commit Mosaic lowering check
     assert {"kernel_cyclic_locator", "kernel_approx_decode"} <= {
         p.name for p in programs if p.fast}
+    # the ISSUE 17 mesh-sub-axis tree combine programs ride the fast
+    # sweep — their collectives manifest pins one psum per level
+    assert {"tree_combine_g2_l3", "tree_combine_g4_l2"} <= {
+        p.name for p in programs if p.fast}
     # out of the --fast budget: the big-d constant-bloat guard (~3.3M
     # params), the ISSUE 12 fused/approx impl VARIANTS of fast-swept
-    # step bodies, and the ISSUE 16 segmented-wire variants (the full
-    # tool + the committed-artifact coverage test still guard them)
+    # step bodies, the ISSUE 16 segmented-wire variants, and the ISSUE 17
+    # tree-topology step variants (the full tool + the committed-artifact
+    # coverage test still guard them)
     big = {p.name for p in programs if not p.fast}
     assert big == {"lm_fold_big_bf16_many_k2",
                    "cnn_cyclic_layer_step", "cnn_cyclic_layer_pallas_step",
@@ -79,7 +84,10 @@ def test_registry_covers_every_route():
                    "cnn_cyclic_seg2_many_k2",
                    "cnn_cyclic_seg2_wire_bf16_many_k2",
                    "cnn_approx_seg2_step",
-                   "cnn_approx_seg2_wire_int8_step"}
+                   "cnn_approx_seg2_wire_int8_step",
+                   "cnn_cyclic_tree_g4_step", "cnn_cyclic_tree_g4_many_k2",
+                   "cnn_cyclic_tree_g4_wire_bf16_many_k2",
+                   "cnn_approx_tree_g4_step"}
 
 
 @pytest.mark.core

@@ -628,6 +628,21 @@ def test_committed_chaos_matrix_covers_every_fault_class():
         assert approx[(k, "nan_grad")]["outcome"] == "guarded"
         assert approx[(k, "nan_grad")]["attributed"]
         assert approx[(k, "sigterm")]["outcome"] == "preempted_resumed"
+    # the tree topology cells (ISSUE 17): sigterm round-trips on both tree
+    # loops, and the subtree-straggle cell (an entire leaf group absent at
+    # once) degrades boundedly with the straggle incident attributed to
+    # exactly the victim group — none of them ever accused
+    assert {"cnn_tree_k4", "approx_tree_k4"} <= loops
+    tree = {(r["loop"], r["fault"]): r for r in data["rows"]
+            if "_tree" in r["loop"]}
+    assert tree[("cnn_tree_k4", "sigterm")]["outcome"] == \
+        "preempted_resumed"
+    assert tree[("approx_tree_k4", "sigterm")]["outcome"] == \
+        "preempted_resumed"
+    sub = tree[("approx_tree_k4", "subtree_straggle")]
+    assert sub["outcome"] == "degraded_bounded"
+    assert sub["never_accused"]
+    assert sub["incident"]["raised"] == ["straggle"]
     # every committed cell carries an incident verdict with ok true
     # (obs/incidents.py, ISSUE 13): the expected incident type raised with
     # the right worker attribution, nothing spurious — and the attributed

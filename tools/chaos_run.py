@@ -79,7 +79,7 @@ from draco_tpu.cli import maybe_force_cpu_mesh  # noqa: E402
 
 FAULTS = ("nan_grad", "over_budget", "prefetch_crash", "prefetch_hang",
           "sigterm", "ckpt_corrupt", "ckpt_truncate", "straggle",
-          "adversary", "drift_grad")
+          "adversary", "drift_grad", "subtree_straggle")
 # the autopilot REAL-wire cell (ISSUE 15): an int8-wire run under the
 # declarative drift_grad window must raise the numerics_drift incident AND
 # the autopilot must actuate — a `wire_widen` remediation moving the wire
@@ -124,6 +124,19 @@ STRAGGLE_WORKER = 3  # the named straggle victim (absent ≠ accused target)
 # support shifts, so their straggle/adversary equivalence is the
 # tolerance-based pin in tests/test_segments.py, not a bitwise chaos cell.
 SEG_FAULTS = ("straggle", "sigterm")
+# the tree-topology loops (ISSUE 17): sigterm lands BETWEEN chunk
+# dispatches of the hierarchical regime and must round-trip through the
+# existing preemption/resume machinery bitwise against the loop's own tree
+# clean run (`preempted_resumed` — the level structure lives inside the
+# jitted program, so a boundary checkpoint is level-consistent by
+# construction). `subtree_straggle` drops an ENTIRE leaf group (the
+# worst-case-one-group shape the per-group budget is sized for) on the
+# approx tree loop: the group's partial recovers nothing, the root
+# residual must still sit under the Cauchy-Schwarz-folded bound every
+# step, and NO member of the victim group is ever accused — absence is an
+# erasure, not evidence, even when a whole subtree goes dark.
+TREE_FAULTS = ("sigterm", "subtree_straggle")
+SUBTREE_WORKERS = (4, 5, 6, 7)  # the whole second leaf group at g=4, n=8
 
 FAULT_STEP = 5  # mid-run, between the two eval/ckpt boundaries (4 and 8)
 # sigterm lands ON the first chunk boundary so the K=4 loops stop with
@@ -224,6 +237,15 @@ def _loops():
             return cfg_fn(steps_per_call=k, **fixed, **kw)
         return make
 
+    # the tree-topology loops (ISSUE 17): topology/fanout ride as DEFAULTS
+    # so resume runs rebuild the identical hierarchical program
+    def with_tree(cfg_fn, k, **fixed):
+        def make(**kw):
+            kw.setdefault("topology", "tree")
+            kw.setdefault("tree_fanout", 4)
+            return cfg_fn(steps_per_call=k, **fixed, **kw)
+        return make
+
     # the approx family rejects live adversaries (config.validate: no
     # Byzantine certificate), so its cells run worker_fail=0 with the
     # ISSUE 8 design point r=1.5 / α=0.25 on the same FC loop
@@ -265,6 +287,17 @@ def _loops():
         "lm_seg2_k4": (with_seg(lm_cfg, 4, adversary_count=0), lm_fold_run),
         "mv_seg2_k4": (with_seg(cnn_cfg, 4, approach="maj_vote",
                                 group_size=4, adversary_count=0), cnn_run),
+        # the tree-topology loops (ISSUE 17): adversary_count=0 (the g=4
+        # per-group budget s_g = min(1, 0) carries no live adversary — the
+        # detection-parity pin lives in tests/test_tree.py at g=8);
+        # approx_tree runs the whole-leaf-group drop at the α=0.5 design
+        # point that covers it
+        "cnn_tree_k4": (with_tree(cnn_cfg, 4, adversary_count=0), cnn_run),
+        "approx_tree_k4": (with_tree(cnn_cfg, 4, approach="approx",
+                                     worker_fail=0, redundancy="shared",
+                                     code_redundancy=2.0,
+                                     assignment_scheme="pairwise",
+                                     straggler_alpha=0.5), cnn_run),
     }
 
 
@@ -310,18 +343,22 @@ def _accusation(train_dir, fault, step):
     return injected, accused, attributed
 
 
-def _straggle_verdict(train_dir, worker, step):
+def _straggle_verdict(train_dir, workers, step):
     """The approx straggle cell's bounded-degradation evidence, from the
-    run's own metrics.jsonl (log_every=1): ``dropped`` — the victim's
+    run's own metrics.jsonl (log_every=1): ``dropped`` — every victim's
     present bit is off on every record from the fault step on (the
     sustained drop really landed); ``bounded`` — every train record's
     measured decode_residual sits under its analytic
-    decode_residual_bound (the ISSUE 8 certificate); ``never_accused`` —
-    the scheduled straggler's accused bit never fires (absence is an
-    erasure, not evidence; obs/forensics)."""
+    decode_residual_bound (the ISSUE 8 certificate; under topology="tree"
+    the bound is the Cauchy-Schwarz fold across groups and must hold even
+    with a whole leaf group dark); ``never_accused`` — no scheduled
+    straggler's accused bit ever fires (absence is an erasure, not
+    evidence; obs/forensics). ``workers``: the victim set — one worker for
+    the classic cell, a whole leaf group for subtree_straggle."""
     from draco_tpu.obs import replay
     from draco_tpu.obs.forensics import record_masks
 
+    workers = list(workers)
     recs = replay.train_records(os.path.join(train_dir, "metrics.jsonl"))
     if not recs:
         return {"dropped": False, "bounded": False, "never_accused": False}
@@ -331,9 +368,10 @@ def _straggle_verdict(train_dir, worker, step):
         if masks is None:
             dropped = bounded = never_accused = False
             break
-        if r.get("step", 0) >= step and masks["present"][worker]:
+        if r.get("step", 0) >= step \
+                and any(masks["present"][w] for w in workers):
             dropped = False
-        if masks["accused"][worker]:
+        if any(masks["accused"][w] for w in workers):
             never_accused = False
         if not (r.get("decode_residual", float("nan"))
                 <= r.get("decode_residual_bound", float("-inf")) + 1e-5):
@@ -404,6 +442,10 @@ def _expected_incidents(loop, fault):
         # fire once the victim's absence streak crosses its threshold,
         # attributed to the named victim; the decode itself stays clean
         return [("straggle", [STRAGGLE_WORKER])], set()
+    if fault == "subtree_straggle":
+        # an ENTIRE leaf group drops (ISSUE 17): the detector must fire
+        # naming every member of the dark subtree — and nobody else
+        return [("straggle", list(SUBTREE_WORKERS))], set()
     if fault == "adversary":
         # a single within-budget attack step: detected, attributed and
         # excised by the decode — one accusation cannot collapse EW trust
@@ -540,6 +582,11 @@ def run_case(loop: str, fault: str, make_cfg, run, clean_vec, workdir):
     # loop simply rides out (4 s keeps the matrix quick)
     step = SIGTERM_STEP if fault == "sigterm" else FAULT_STEP
     spec = f"{fault}@{step}"
+    if fault == "subtree_straggle":
+        # one sustained straggle event per member of the victim leaf group
+        # (the fault grammar attributes per-event :w victims) — the whole
+        # subtree goes dark at once
+        spec = ",".join(f"straggle@{step}:w{w}" for w in SUBTREE_WORKERS)
     if fault == "drift_grad":
         # declarative window covering the rest of the run, so the widened
         # regime's chunk dispatches while the drift is still live
@@ -641,14 +688,16 @@ def run_case(loop: str, fault: str, make_cfg, run, clean_vec, workdir):
                              f"never_accused={never_accused} "
                              f"guard_trips={row['guard_trips']}")
         return row
-    if fault == "straggle":
-        verdict = _straggle_verdict(d, STRAGGLE_WORKER, step)
+    if fault in ("straggle", "subtree_straggle"):
+        victims = (SUBTREE_WORKERS if fault == "subtree_straggle"
+                   else [STRAGGLE_WORKER])
+        verdict = _straggle_verdict(d, victims, step)
         row.update(verdict)
         if (row["final_finite"] and status.get("state") == "done"
                 and row["guard_trips"] == 0 and all(verdict.values())):
             row.update(ok=True, outcome="degraded_bounded")
         else:
-            row["detail"] = ("straggle cell not bounded-degraded: "
+            row["detail"] = (f"{fault} cell not bounded-degraded: "
                              f"{verdict}")
         return row
     if fault == "drift_grad":
@@ -769,7 +818,16 @@ def main(argv=None) -> int:
     for loop in pick_loops:
         make_cfg, run = loops[loop]
         eager = loop.endswith("_k1")
-        if loop.startswith("approx"):
+        if "_tree" in loop:
+            # the tree-topology loops (ISSUE 17): sigterm round-trips on
+            # both; the whole-leaf-group drop is the approx tree's cell
+            # (its bounded certificate is what absorbs a dark subtree) —
+            # checked FIRST so approx_tree does not fall into the flat
+            # approx family's fault triple
+            faults = [f for f in pick_faults if f in TREE_FAULTS
+                      and (f != "subtree_straggle"
+                           or loop.startswith("approx"))]
+        elif loop.startswith("approx"):
             # both regimes run the family's own fault triple (ISSUE 8)
             faults = [f for f in pick_faults if f in APPROX_FAULTS]
         elif loop.startswith("cnn_rand"):
@@ -785,7 +843,8 @@ def main(argv=None) -> int:
                       and (f != "straggle" or loop.startswith("mv_"))]
         else:
             faults = [f for f in pick_faults
-                      if f not in ("straggle",) + RAND_FAULTS + WIRE_FAULTS
+                      if f not in ("straggle", "subtree_straggle")
+                      + RAND_FAULTS + WIRE_FAULTS
                       and not (eager and f not in EAGER_FAULTS)]
         if not faults:
             continue

@@ -22,6 +22,12 @@ exits nonzero NAMING THE FIRST FAILURE:
   segment_study       --check: per-segment bytes sums + bounds algebra and
                       the overlap/ms-per-step-win acceptance pins of the
                       committed streaming-wire evidence (ISSUE 16)
+  tree_study          --check: plan algebra + per-level byte sums +
+                      detection-parity pins + crossover honesty of the
+                      committed tree-aggregation evidence (ISSUE 17)
+  decode_study        --check: no stale error rows, numeric granularity
+                      cells, tree crossover columns self-consistent
+                      (ISSUE 17)
   program_lint        committed all_ok roll-up
   chaos_matrix        committed all_ok roll-up
   straggler_study     committed all_ok roll-up
@@ -272,12 +278,30 @@ def _check_autopilot_study(root):
     return None
 
 
+def _check_tree_study(root):
+    from tools import tree_study
+
+    artifact = os.path.join(root, "baselines_out", "tree_study.json")
+    rc = tree_study.check_artifact(artifact)
+    return None if rc == 0 else f"tree_study --check exited {rc}"
+
+
+def _check_decode_study(root):
+    from tools import decode_study
+
+    artifact = os.path.join(root, "baselines_out", "decode_study.json")
+    rc = decode_study.check_artifact(artifact)
+    return None if rc == 0 else f"decode_study --check exited {rc}"
+
+
 CHECKS = (
     ("perf_watch", _check_perf_watch),
     ("device_profile --check", _check_device_profile),
     ("wire_study --check", _check_wire_study),
     ("decode_kernel_bench --check", _check_decode_bench),
     ("segment_study --check", _check_segment_study),
+    ("tree_study --check", _check_tree_study),
+    ("decode_study --check", _check_decode_study),
     ("program_lint all_ok",
      _flag_check(os.path.join("baselines_out", "program_lint.json"))),
     ("chaos_matrix all_ok",

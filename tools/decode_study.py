@@ -14,8 +14,28 @@ Two questions the round-2 evidence left at two data points:
 
 Writes after every point; a mid-run tunnel loss keeps completed points.
 
+ISSUE 17 additions:
+
+  * ``--merge PATCH`` folds a partial re-run (e.g. the regenerated n=32
+    rows measured after the PR 15 regularized locator landed) into the
+    committed artifact: every (n, s) scaling row the patch carries
+    WITHOUT an error replaces the main artifact's row, numeric
+    granularity cells replace errored ones, and the merge provenance is
+    recorded in the artifact ("merged_from");
+  * ``--tree-fanout G`` measures, next to every flat (n, s) scaling row,
+    the tree topology's per-node critical path at the same d (leaf
+    decode at the (G, s_g) group code + per-level combine,
+    coding/topology.py) and records the tree-vs-flat crossover column —
+    the light companion of tools/tree_study.py;
+  * ``--check`` re-verifies a committed artifact jax-free: NO scaling
+    row may carry an error, granularity cells must be numeric, and every
+    present tree column must agree with its own timings — wired into
+    tools/check_artifacts.py.
+
 Usage: python tools/decode_study.py [--out baselines_out/decode_study.json]
        [--d 11173962] [--cpu-mesh 8 for smoke]
+       python tools/decode_study.py --merge baselines_out/decode_study_n32.json
+       python tools/decode_study.py --check
 """
 
 from __future__ import annotations
@@ -48,10 +68,145 @@ def geomedian_ms(n, d, iters=80, reps=10):
     return timeit_chained(step, g, reps=reps) * 1e3
 
 
+def tree_phase_times(n, d, s, fanout, reps=10):
+    """Per-node critical path of the tree topology at (n, d): the leaf
+    decode at the (fanout, s_g) group code plus each combine level's
+    fan-in partial sum (coding/topology.py algebra). Returns
+    ``(critical_ms, leaf_ms, s_g, levels)`` or None when (n, fanout) has
+    no valid tree (n % g != 0 or fewer than 2 groups)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from draco_tpu.coding import cyclic as cyc
+    from draco_tpu.coding import topology as topo
+    from draco_tpu.utils.timing import timeit_chained
+
+    if n % fanout != 0 or n // fanout < 2:
+        return None
+    plan = topo.tree_plan(n, fanout)
+    s_g = topo.group_worker_fail(fanout, s)
+    code = cyc.build_cyclic_code(fanout, s_g)
+    r = np.random.RandomState(0)
+    g = jnp.asarray(r.randn(fanout, d).astype(np.float32))
+    rf = jnp.asarray(r.randn(d).astype(np.float32))
+    e_re, e_im = cyc.encode_shared(code, g)
+
+    def dec_step(carry, rf):
+        er, ei = carry
+        dec, _honest = cyc.decode(code, er, ei, rf)
+        return (er.at[0, 0].add(1e-30 * jnp.sum(dec ** 2)), ei)
+
+    leaf_ms = timeit_chained(dec_step, (e_re, e_im), (rf,), reps=reps) * 1e3
+    combine_ms = 0.0
+    for f in plan.level_fanouts:
+        parts = jnp.asarray(r.randn(f, d).astype(np.float32))
+
+        def node_step(pc):
+            t = jnp.sum(pc, axis=0)
+            return pc.at[0, 0].add(1e-30 * jnp.sum(t ** 2))
+
+        combine_ms += timeit_chained(node_step, parts, reps=reps) * 1e3
+    return leaf_ms + combine_ms, leaf_ms, s_g, plan.levels
+
+
+def merge_artifact(out_path: str, patch_path: str) -> int:
+    """Fold a partial re-run into the committed artifact: error-free
+    (n, s) scaling rows from the patch replace the main artifact's rows
+    (stale errors included), numeric granularity cells replace errored
+    ones. Jax-free; records provenance under ``merged_from``."""
+    try:
+        with open(out_path) as fh:
+            main_doc = json.load(fh)
+        with open(patch_path) as fh:
+            patch = json.load(fh)
+    except (OSError, ValueError) as e:
+        print(f"decode_study --merge: cannot read artifacts: {e}")
+        return 1
+    by_key = {(r.get("n"), r.get("s")): r
+              for r in patch.get("scaling", []) if "error" not in r}
+    replaced = []
+    rows = []
+    for row in main_doc.get("scaling", []):
+        key = (row.get("n"), row.get("s"))
+        if key in by_key:
+            rows.append(by_key.pop(key))
+            replaced.append(key)
+        else:
+            rows.append(row)
+    rows.extend(by_key.values())  # patch rows the main artifact lacked
+    replaced.extend(by_key)
+    main_doc["scaling"] = sorted(rows, key=lambda r: (r["n"], r["s"]))
+    for gran, val in (patch.get("granularity") or {}).items():
+        if isinstance(val, (int, float)):
+            main_doc.setdefault("granularity", {})[gran] = val
+    for meta in ("granularity_network", "granularity_batch_size"):
+        if meta in patch:
+            main_doc[meta] = patch[meta]
+    main_doc["merged_from"] = {
+        "patch": os.path.basename(patch_path),
+        "replaced": sorted(f"n{n}s{s}" for n, s in replaced),
+    }
+    with open(out_path, "w") as fh:
+        json.dump(main_doc, fh, indent=1)
+    print(f"decode_study --merge: {len(replaced)} rows from {patch_path} "
+          f"-> {out_path}")
+    return 0
+
+
+def check_artifact(path: str) -> int:
+    """Re-verify a committed decode_study.json jax-free: no error rows
+    anywhere (ISSUE 17 satellite — the stale n=32 tunnel failures must
+    stay purged), numeric granularity cells, and any tree crossover
+    columns consistent with their own timings."""
+    try:
+        with open(path) as fh:
+            data = json.load(fh)
+    except (OSError, ValueError) as e:
+        print(f"decode_study --check: cannot read {path}: {e}")
+        return 1
+    rows = data.get("scaling", [])
+    if not rows:
+        print(f"decode_study --check: no scaling rows in {path}")
+        return 1
+    for r in rows:
+        cell = f"n{r.get('n')}s{r.get('s')}"
+        if "error" in r:
+            print(f"decode_study --check: {cell}: error row committed "
+                  f"({r['error'][:80]}) — re-measure and --merge")
+            return 1
+        if "skipped" in r:
+            continue  # n <= 4s existence gaps are honest, not stale
+        for col in ("encode_ms", "decode_ms", "geomedian_ms_same_n"):
+            if not isinstance(r.get(col), (int, float)):
+                print(f"decode_study --check: {cell}: non-numeric {col}")
+                return 1
+        if isinstance(r.get("tree_critical_ms"), (int, float)):
+            want = bool(r["tree_critical_ms"] < r["decode_ms"])
+            if bool(r.get("tree_win")) != want:
+                print(f"decode_study --check: {cell}: tree_win disagrees "
+                      f"with its own timings")
+                return 1
+    for gran, val in (data.get("granularity") or {}).items():
+        if not isinstance(val, (int, float)):
+            print(f"decode_study --check: granularity[{gran}] is not a "
+                  f"number: {str(val)[:80]}")
+            return 1
+    print(f"decode_study --check: {len(rows)} scaling rows clean ({path})")
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", type=str,
                     default="baselines_out/decode_study.json")
+    ap.add_argument("--merge", type=str, default="",
+                    help="fold a partial re-run artifact into --out "
+                         "(jax-free)")
+    ap.add_argument("--check", action="store_true",
+                    help="re-verify a committed artifact (jax-free)")
+    ap.add_argument("--tree-fanout", type=int, default=0,
+                    help="also measure the tree per-node critical path at "
+                         "this fan-in next to every scaling row (0 = off)")
     ap.add_argument("--d", type=int, default=0,
                     help="gradient dimension (0 = flagship ResNet-18 dim)")
     ap.add_argument("--ns", type=str, default="8,16,32")
@@ -65,6 +220,10 @@ def main(argv=None) -> int:
     ap.add_argument("--gran-batch-size", type=int, default=32)
     ap.add_argument("--cpu-mesh", type=int, default=0)
     args = ap.parse_args(argv)
+    if args.merge:
+        return merge_artifact(args.out, args.merge)
+    if args.check:
+        return check_artifact(args.out)
 
     from draco_tpu.cli import maybe_force_cpu_mesh
 
@@ -130,6 +289,17 @@ def main(argv=None) -> int:
                 "decode_vs_geomedian": round(gm / dec_ms, 2),
                 "measure_s": round(time.time() - t0, 1),
             }
+            if args.tree_fanout:
+                tp = tree_phase_times(n, d, s, args.tree_fanout,
+                                      reps=args.reps)
+                if tp is not None:
+                    crit, leaf, s_g, levels = tp
+                    row.update(
+                        tree_fanout=args.tree_fanout, tree_s_g=s_g,
+                        tree_levels=levels,
+                        tree_leaf_ms=round(leaf, 3),
+                        tree_critical_ms=round(crit, 3),
+                        tree_win=bool(crit < dec_ms))
             report["scaling"].append(row)
             print(f"[decode_study] n={n} s={s}: enc {row['encode_ms']} ms, "
                   f"dec {row['decode_ms']} ms, geomed {row['geomedian_ms_same_n']} ms",

@@ -76,6 +76,17 @@ Folded sources (all optional — a missing artifact folds nothing):
                                 fractions at the ratio tolerance, segment
                                 counts + per-segment physical bytes
                                 pinned tolerance-0 in both directions
+  baselines_out/tree_study.json
+                                the hierarchical tree-aggregation
+                                evidence (tools/tree_study.py, ISSUE 17):
+                                the per-cell win / bytes_ok / detection-
+                                parity bools at tolerance 0, the
+                                crossover n pinned in both directions,
+                                per-LEVEL ingest bytes pinned tolerance-0
+                                both ways (the leaf level must keep
+                                summing exactly to the flat per-step
+                                bytes), decode/critical-path ms at the
+                                time tolerance
   baselines_out/decode_kernel_bench.json
                                 the fused-decode microbench
                                 (tools/decode_kernel_bench.py, ISSUE 12):
@@ -561,6 +572,71 @@ def fold_segment_study(root: str, metrics: dict) -> None:
                     "value": float(b), "kind": "pinned", "source": src}
 
 
+def fold_tree_study(root: str, metrics: dict) -> None:
+    """Tree-study artifact (tools/tree_study.py, ISSUE 17): the
+    hierarchical CodedReduce evidence. The per-cell ACCEPTANCE bools gate
+    at tolerance 0 — win (critical path beats flat decode), bytes_ok
+    (leaf-level ingest sums exactly to the flat per-step bytes), and the
+    detection-parity pin on every s_g >= 1 cell (tree flags == flat
+    flags under the same live adversary; the flipped-row control in
+    tests/test_tree.py proves the gate live). The crossover n and the
+    per-LEVEL byte columns are PINNED in both directions — the tree
+    silently winning earlier/later or a level's bytes moving at all is a
+    topology/wire-format change, never noise. Decode and critical-path
+    ms ride at the time tolerance."""
+    path = os.path.join(root, "baselines_out", "tree_study.json")
+    data = _read_json(path)
+    if not isinstance(data, dict):
+        return
+    src = "baselines_out/tree_study.json"
+    if "all_ok" in data:
+        metrics["tree.all_ok"] = {"value": float(bool(data["all_ok"])),
+                                  "kind": "ok", "source": src}
+    cx = data.get("crossover") or {}
+    for col in ("critical_path_n", "sequential_n"):
+        if isinstance(cx.get(col), (int, float)):
+            metrics[f"tree.crossover.{col}"] = {
+                "value": float(cx[col]), "kind": "pinned", "source": src}
+    for row in data.get("rows", []):
+        n = row.get("n")
+        if row.get("kind") == "flat":
+            if isinstance(row.get("decode_ms"), (int, float)):
+                metrics[f"tree.flat.n{n}.decode_ms"] = {
+                    "value": float(row["decode_ms"]), "kind": "time_ms",
+                    "source": src}
+            continue
+        g = row.get("fanout")
+        if n is None or g is None:
+            continue
+        key = f"tree.n{n}.g{g}"
+        for col, kind in (("critical_path_ms", "time_ms"),
+                          ("leaf_decode_ms", "time_ms"),
+                          ("sequential_total_ms", "time_ms")):
+            if isinstance(row.get(col), (int, float)):
+                metrics[f"{key}.{col}"] = {
+                    "value": float(row[col]), "kind": kind, "source": src}
+        metrics[f"{key}.win"] = {"value": float(bool(row.get("win"))),
+                                 "kind": "ok", "source": src}
+        metrics[f"{key}.bytes_ok"] = {
+            "value": float(bool(row.get("bytes_ok"))), "kind": "ok",
+            "source": src}
+        det = row.get("detection") or {}
+        if det.get("checked"):
+            metrics[f"{key}.detection_ok"] = {
+                "value": float(bool(det.get("ok"))), "kind": "ok",
+                "source": src}
+            for col in ("precision_tree", "recall_tree"):
+                if isinstance(det.get(col), (int, float)):
+                    metrics[f"{key}.{col}"] = {
+                        "value": float(det[col]), "kind": "ok",
+                        "source": src}
+        tb = (row.get("ledger") or {}).get("tree") or {}
+        for i, b in enumerate(tb.get("level_bytes_per_step") or []):
+            if isinstance(b, (int, float)):
+                metrics[f"{key}.level{i}_bytes_per_step"] = {
+                    "value": float(b), "kind": "pinned", "source": src}
+
+
 def fold_decode_bench(root: str, metrics: dict) -> None:
     """Fused-decode microbench (tools/decode_kernel_bench.py, ISSUE 12):
     absolute per-impl decode times and the pallas/xla ratio ride at the
@@ -660,6 +736,7 @@ def fold_all(root: str) -> dict:
     fold_autopilot(root, metrics)
     fold_wire_study(root, metrics)
     fold_segment_study(root, metrics)
+    fold_tree_study(root, metrics)
     fold_decode_bench(root, metrics)
     fold_device_profile(root, metrics)
     return metrics
