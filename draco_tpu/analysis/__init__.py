@@ -1,9 +1,14 @@
 """Static analysis of the chip-bound jitted programs (the program linter).
 
 ``registry``  — catalog of every hot-loop program + its Manifest
-``rules``     — the six rules (constant_bloat, donation, dtype,
-                collectives, host_traffic, memory_budget) over jaxpr +
-                exported StableHLO + compiled memory/cost analysis
+``rules``     — the nine rules (constant_bloat, donation, dtype,
+                collectives, host_traffic, memory_budget,
+                sharding_contract, collective_axes, replication_leaks)
+                over jaxpr + exported StableHLO + compiled memory/cost
+                analysis and I/O shardings
+``sharding``  — the static sharding auditor (rules 7-9): partition-table
+                coverage, per-axis collective classification and the
+                replication-leak check against parallel/partition.py
 ``controls``  — seeded-defect programs proving each rule is live
 
 Driver: ``tools/program_lint.py`` (artifact
@@ -25,4 +30,11 @@ from draco_tpu.analysis.rules import (  # noqa: F401
     lint_built,
     lint_program,
     trace_and_export,
+)
+from draco_tpu.analysis.sharding import (  # noqa: F401
+    classify_collective,
+    parse_module_collectives,
+    rule_collective_axes,
+    rule_replication_leaks,
+    rule_sharding_contract,
 )

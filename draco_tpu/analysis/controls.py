@@ -264,6 +264,169 @@ def _build_memory_hog() -> BuiltProgram:
                                  max_peak_bytes=4 << 20))
 
 
+# arg naming + per-axis budget shared by the sharding-auditor controls
+# (rules 7-9); the partition table is built lazily (module stays jax-free)
+_MINI_ARGS = ("state", "batch")
+_MINI_AXES = {"w": {"all_reduce": 1}}
+
+
+def _mini_rules():
+    """The honest miniature's partition table: replicated carry, batch
+    rows over w."""
+    from jax.sharding import PartitionSpec as P
+
+    return (("^state/", P()), ("^batch$", P("w")))
+
+
+def _build_resharded_carry() -> BuiltProgram:
+    """Defect (the PR 6 bug shape, statically): the donated carry enters
+    replicated but the step's output pins it to ``P('w')`` — compiled
+    input sharding != output sharding, so the SECOND dispatch of the real
+    training loop reshards (and retraces) the carry every step. Only the
+    carry half of sharding_contract can see it: counts, dtypes, donation
+    and memory are all unchanged."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = _mini_mesh()
+    shard_w = NamedSharding(mesh, P("w"))
+
+    def f(state, x):
+        w, step = state
+        g = _psum_grads(mesh)(x).sum(0)
+        w = jax.lax.with_sharding_constraint(w - 0.01 * g, shard_w)
+        return (w, step + 1), jnp.sum(w)
+
+    with mesh:
+        fn = jax.jit(f, donate_argnums=(0,))
+    return BuiltProgram("control_resharded_carry", fn,
+                        (_mini_state(mesh), _mini_batch(mesh)), mesh,
+                        Manifest(collectives=_MINI_COLLECTIVES,
+                                 collective_axes=_MINI_AXES),
+                        partition_rules=_mini_rules(), arg_names=_MINI_ARGS)
+
+
+def _build_unnormalized_spec() -> BuiltProgram:
+    """Defect (PR 6's other half): the partition table declares the batch
+    as ``P('w', None)`` — NOT a ``norm_spec`` fixed-point. XLA reports
+    shardings normalized, so any spec comparison or jit-boundary pin made
+    with the trailing-None form compares unequal and silently reshards/
+    retraces. The program itself is clean; only the table is wrong."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    mesh = _mini_mesh()
+
+    def f(state, x):
+        w, step = state
+        g = _psum_grads(mesh)(x).sum(0)
+        return (w - 0.01 * g, step + 1), jnp.sum(w)
+
+    with mesh:
+        fn = jax.jit(f, donate_argnums=(0,))
+    return BuiltProgram("control_unnormalized_spec", fn,
+                        (_mini_state(mesh), _mini_batch(mesh)), mesh,
+                        Manifest(collectives=_MINI_COLLECTIVES,
+                                 collective_axes=_MINI_AXES),
+                        partition_rules=(("^state/", P()),
+                                         ("^batch$", P("w", None))),
+                        arg_names=_MINI_ARGS)
+
+
+def _build_unmatched_param() -> BuiltProgram:
+    """Defect: the partition table has no rule for the batch operand — an
+    array leaf whose sharding nobody declared. Coverage holes are how new
+    buffers (a fresh optimizer slot, a new wire tensor) silently pick up
+    compiler-chosen layouts; the table subcheck makes the hole itself the
+    failure."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    mesh = _mini_mesh()
+
+    def f(state, x):
+        w, step = state
+        g = _psum_grads(mesh)(x).sum(0)
+        return (w - 0.01 * g, step + 1), jnp.sum(w)
+
+    with mesh:
+        fn = jax.jit(f, donate_argnums=(0,))
+    return BuiltProgram("control_unmatched_param", fn,
+                        (_mini_state(mesh), _mini_batch(mesh)), mesh,
+                        Manifest(collectives=_MINI_COLLECTIVES,
+                                 collective_axes=_MINI_AXES),
+                        partition_rules=(("^state/", P()),),
+                        arg_names=_MINI_ARGS)
+
+
+def _build_wrong_axis_psum() -> BuiltProgram:
+    """Defect: on a 2-D (w, tp) mesh the gradient psum reduces over ``tp``
+    instead of ``w`` — the COUNT budget (rule 4) still sees exactly one
+    all_reduce, but the reduction spans the wrong device groups (summing a
+    worker's tensor-parallel replicas instead of folding workers). Only
+    the per-axis classification (rule 8) can see it."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from draco_tpu.runtime import shard_map
+
+    devs = np.asarray(jax.devices())
+    mesh = Mesh(devs.reshape(len(devs) // 2, 2), ("w", "tp"))
+
+    fold = shard_map(lambda x: lax.psum(x, "tp"),  # <- should be "w"
+                     mesh=mesh, in_specs=P("w", None),
+                     out_specs=P("w", None), check_vma=False)
+
+    def f(state, x):
+        w, step = state
+        g = fold(x).sum(0)
+        return (w - 0.01 * g, step + 1), jnp.sum(w)
+
+    with mesh:
+        fn = jax.jit(f, donate_argnums=(0,))
+    return BuiltProgram("control_wrong_axis_psum", fn,
+                        (_mini_state(mesh), _mini_batch(mesh)), mesh,
+                        Manifest(collectives=_MINI_COLLECTIVES,
+                                 collective_axes=_MINI_AXES),
+                        partition_rules=_mini_rules(), arg_names=_MINI_ARGS)
+
+
+def _build_replicated_wire() -> BuiltProgram:
+    """Defect (the PR 7 neighborhood): the table declares the batch wire
+    sharded over ``w`` but the program commits it fully replicated — every
+    device holds all n workers' rows, the silent O(n*d) memory/bandwidth
+    regression. The shard_map boundary reshards internally so the psum
+    (and every count/dtype/donation invariant) is unchanged; only
+    replication_leaks compares the compiled input against the table."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = _mini_mesh()
+    n = mesh.devices.size
+    batch = jax.device_put(jnp.ones((n, 64), jnp.float32),
+                           NamedSharding(mesh, P()))  # <- replicated wire
+
+    def f(state, x):
+        w, step = state
+        g = _psum_grads(mesh)(x).sum(0)
+        return (w - 0.01 * g, step + 1), jnp.sum(w)
+
+    with mesh:
+        fn = jax.jit(f, donate_argnums=(0,))
+    return BuiltProgram("control_replicated_wire", fn,
+                        (_mini_state(mesh), batch), mesh,
+                        Manifest(collectives=_MINI_COLLECTIVES,
+                                 collective_axes=_MINI_AXES),
+                        partition_rules=_mini_rules(), arg_names=_MINI_ARGS)
+
+
 def control_programs() -> Tuple[Control, ...]:
     mk = lambda name, build: LintProgram(  # noqa: E731
         name=name, build=build, route="controls")
@@ -281,4 +444,15 @@ def control_programs() -> Tuple[Control, ...]:
                    _build_host_outfeed_in_scan), "host_traffic"),
         Control(mk("control_memory_hog", _build_memory_hog),
                 "memory_budget"),
+        # the static sharding auditor's live defects (rules 7-9)
+        Control(mk("control_resharded_carry", _build_resharded_carry),
+                "sharding_contract"),
+        Control(mk("control_unnormalized_spec", _build_unnormalized_spec),
+                "sharding_contract"),
+        Control(mk("control_unmatched_param", _build_unmatched_param),
+                "sharding_contract"),
+        Control(mk("control_wrong_axis_psum", _build_wrong_axis_psum),
+                "collective_axes"),
+        Control(mk("control_replicated_wire", _build_replicated_wire),
+                "replication_leaks"),
     )

@@ -70,6 +70,14 @@ class Manifest:
     ``collectives``: expected explicit-collective op counts by kind
     (missing kinds default to 0). ``None`` skips the rule.
 
+    ``collective_axes``: the per-axis extension of that budget (rule 8,
+    analysis/sharding.py): ``{axis: {kind: count}}`` — every explicit
+    collective must classify onto a declared mesh axis with exactly the
+    declared count (a tree combine pins one psum per level ON that
+    level's axis; wrong-axis psums fail even at an unchanged op count).
+    ``{}`` asserts zero explicit collectives on every axis (the
+    GSPMD-deferred routes); ``None`` skips the rule.
+
     ``host_transfer_budget`` is 0 for every registered program: a single
     infeed/outfeed/host-callback inside a scanned body serializes the chunk
     on the host link and defeats the whole scan-chunk design (PERF.md §0).
@@ -99,6 +107,7 @@ class Manifest:
     # requirement (every pre-ISSUE-15 manifest).
     required_dtypes: frozenset = frozenset()
     collectives: Optional[dict] = None
+    collective_axes: Optional[dict] = None  # {axis: {kind: count}}
     host_transfer_budget: int = 0
     max_peak_bytes: Optional[int] = 2 << 30  # memory_budget rule cap
 
@@ -119,6 +128,15 @@ class BuiltProgram:
     real minutes; the lowering audit needs only trace+export) and the
     Pallas kernel rows (tpu_custom_call cannot compile for CPU at all);
     the rule then reports ``skipped`` with the reason.
+
+    ``partition_rules``: the program's declared partition table — a tuple
+    of ``(path_regex, PartitionSpec)`` rows (parallel/partition.py is the
+    single source; routes pass their table). The sharding auditor (rules
+    7/9) holds every array arg leaf to it. ``arg_names`` names the
+    positional args for the leaf-path vocabulary the regexes match
+    (``state/params/...``, ``tokens``); unnamed args fall back to
+    ``arg<i>``. ``None`` partition_rules = the table halves of rules 7/9
+    report skipped (kernel rows with no mesh).
     """
 
     name: str
@@ -129,6 +147,8 @@ class BuiltProgram:
     trace_ctx: Callable = contextlib.nullcontext
     extra: dict = dataclasses.field(default_factory=dict)  # report fields
     capture_memory: bool = True
+    partition_rules: Optional[Tuple] = None  # ((regex, PartitionSpec), ...)
+    arg_names: Optional[Tuple[str, ...]] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -228,17 +248,21 @@ def lm_example_tokens(cfg, k: Optional[int] = None):
 
 
 def built_token_program(name, cfg, mesh, setup, manifest, many=False,
-                        k=2) -> BuiltProgram:
+                        k=2, partition_rules=None) -> BuiltProgram:
     """Wrap an LM route setup's chip-bound callable as a BuiltProgram:
     either the single ``train_step`` or the K-fused ``train_token_many``
     scan (K = leading dim of the example operands; ``cfg.token_gen ==
     'device'`` feeds the (K,) step-index vector the production chunked loop
-    uploads, parallel/token_loop.py)."""
+    uploads, parallel/token_loop.py). ``partition_rules`` is the route's
+    declared partition table (parallel/partition.py); the arg-path
+    vocabulary is fixed here: ``state``, ``tokens``, ``adv_mask``,
+    ``present``."""
     import jax.numpy as jnp
     import numpy as np
 
     from draco_tpu import rng as drng
 
+    arg_names = ("state", "tokens", "adv_mask", "present")
     extra = {"dim": setup.dim, "devices_in_mesh": int(mesh.devices.size)}
     if many:
         if cfg.token_gen == "device":
@@ -252,7 +276,11 @@ def built_token_program(name, cfg, mesh, setup, manifest, many=False,
             toks, masks = lm_example_tokens(cfg, k)
         return BuiltProgram(name, setup.train_token_many,
                             (setup.state, toks, masks, None), mesh,
-                            manifest, extra=extra)
+                            manifest, extra=extra,
+                            partition_rules=partition_rules,
+                            arg_names=arg_names)
     toks, mask = lm_example_tokens(cfg)
     return BuiltProgram(name, setup.train_step, (setup.state, toks, mask),
-                        mesh, manifest, extra=extra)
+                        mesh, manifest, extra=extra,
+                        partition_rules=partition_rules,
+                        arg_names=arg_names)

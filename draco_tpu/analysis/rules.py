@@ -1,4 +1,4 @@
-"""The six static rules run against every registered chip-bound program.
+"""The nine static rules run against every registered chip-bound program.
 
 Each rule inspects the static artifacts of a :class:`~draco_tpu.analysis.
 registry.BuiltProgram` — the closed jaxpr (``jit_fn.trace``), the
@@ -45,6 +45,14 @@ memory/cost analysis — against the program's
                    same program the CI mesh executes — an estimate of
                    shape, not a chip HBM number.
 
+Rules 7-9 are the static sharding auditor (analysis/sharding.py):
+``sharding_contract`` (partition-table coverage + donated-carry sharding
+equality, the static form of PR 6's retrace-on-reshard),
+``collective_axes`` (each collective classified by the mesh axis it
+reduces over, checked against Manifest.collective_axes, with a per-axis
+byte ledger), and ``replication_leaks`` (table-declared-sharded arrays
+must not compile fully-replicated — the PR 7 neighborhood).
+
 Rules degrade gracefully: host callbacks make a program un-exportable on
 this jax (NotImplementedError), so the jaxpr-level half of host_traffic
 still trips while module-level rules report ``skipped`` with the export
@@ -67,7 +75,8 @@ from draco_tpu.analysis.registry import (
 )
 
 RULE_NAMES = ("constant_bloat", "donation", "dtype", "collectives",
-              "host_traffic", "memory_budget")
+              "host_traffic", "memory_budget", "sharding_contract",
+              "collective_axes", "replication_leaks")
 
 # jaxpr primitives that move data to/from the host at run time
 _HOST_PRIMS = frozenset({
@@ -96,7 +105,8 @@ class Artifacts:
 
     def __init__(self, built: BuiltProgram, closed_jaxpr, mlir_text,
                  serialized_bytes, export_error, memory=None,
-                 cost_flops=None, compile_error=None):
+                 cost_flops=None, compile_error=None,
+                 input_shardings=None, output_shardings=None):
         self.built = built
         self.manifest = built.manifest
         self.jaxpr = closed_jaxpr  # ClosedJaxpr | None
@@ -106,6 +116,11 @@ class Artifacts:
         self.memory: Optional[dict] = memory  # _memory_columns() | None
         self.cost_flops: Optional[float] = cost_flops
         self.compile_error: Optional[str] = compile_error
+        # flattened compiled I/O shardings (the sharding auditor's
+        # ground truth, rules 7/9) — None when the host compile is
+        # skipped or failed
+        self.input_shardings: Optional[list] = input_shardings
+        self.output_shardings: Optional[list] = output_shardings
 
 
 def _memory_columns(compiled) -> Optional[dict]:
@@ -152,7 +167,8 @@ def trace_and_export(built: BuiltProgram,
 
     import jax.export
 
-    mesh_ctx = built.mesh if built.mesh is not None else contextlib.nullcontext()
+    mesh_ctx = (built.mesh if built.mesh is not None
+                else contextlib.nullcontext())
     with mesh_ctx, built.trace_ctx():
         closed = built.fn.trace(*built.args).jaxpr
         mlir_text = serialized = export_error = None
@@ -164,21 +180,30 @@ def trace_and_export(built: BuiltProgram,
         except Exception as e:
             export_error = f"{type(e).__name__}: {str(e)[:300]}"
         memory = cost_flops = compile_error = None
+        in_sh = out_sh = None
         if not built.capture_memory:
             compile_error = ("capture_memory disabled for this program "
                              "(chip-tier row: host compile prohibitive or "
                              "impossible)")
         else:
             try:
+                import jax
+
                 compiled = built.fn.lower(*built.args).compile()
                 memory = _memory_columns(compiled)
                 cost_flops = _cost_flops(compiled)
+                # the sharding auditor's ground truth (rules 7/9): the
+                # executable's resolved I/O shardings, flattened in arg /
+                # output pytree order
+                in_sh = jax.tree.leaves(compiled.input_shardings[0])
+                out_sh = jax.tree.leaves(compiled.output_shardings)
             except Exception as e:  # un-compilable on the host backend:
                 # memory_budget skips with the reason, other rules still run
                 compile_error = f"{type(e).__name__}: {str(e)[:300]}"
     return Artifacts(built, closed, mlir_text, serialized, export_error,
                      memory=memory, cost_flops=cost_flops,
-                     compile_error=compile_error)
+                     compile_error=compile_error,
+                     input_shardings=in_sh, output_shardings=out_sh)
 
 
 def _walk_eqns(jaxpr):
@@ -430,6 +455,12 @@ def rule_memory_budget(art: Artifacts) -> dict:
     return {"ok": True, **res}
 
 
+from draco_tpu.analysis.sharding import (  # noqa: E402 (rule wiring)
+    rule_collective_axes,
+    rule_replication_leaks,
+    rule_sharding_contract,
+)
+
 _RULES = {
     "constant_bloat": rule_constant_bloat,
     "donation": rule_donation,
@@ -437,19 +468,27 @@ _RULES = {
     "collectives": rule_collectives,
     "host_traffic": rule_host_traffic,
     "memory_budget": rule_memory_budget,
+    "sharding_contract": rule_sharding_contract,
+    "collective_axes": rule_collective_axes,
+    "replication_leaks": rule_replication_leaks,
 }
 
 
-def lint_built(built: BuiltProgram, platforms=("tpu",)) -> dict:
-    """Run all six rules; returns the report row for this program.
+def lint_built(built: BuiltProgram, platforms=("tpu",), only=None) -> dict:
+    """Run the rules; returns the report row for this program.
 
     ``lint_ok`` is True iff no rule failed AND the export either succeeded
     or was blocked by host traffic that the host rule already flagged (any
     other export failure is reported as the synthetic rule ``export``).
+    ``only`` restricts to a subset of rule names (the
+    ``tools/program_lint.py --only`` fast-iteration path); the row then
+    carries just those rules.
     """
+    names = RULE_NAMES if only is None else tuple(
+        n for n in RULE_NAMES if n in set(only))
     art = trace_and_export(built, platforms=platforms)
-    rules = {name: fn(art) for name, fn in _RULES.items()}
-    failed = [n for n in RULE_NAMES if not rules[n]["ok"]]
+    rules = {name: _RULES[name](art) for name in names}
+    failed = [n for n in names if not rules[n]["ok"]]
     if art.export_error is not None and "host_traffic" not in failed:
         rules["export"] = {"ok": False, "error": art.export_error}
         failed.append("export")
@@ -462,7 +501,8 @@ def lint_built(built: BuiltProgram, platforms=("tpu",)) -> dict:
     }
 
 
-def lint_program(program: LintProgram) -> dict:
+def lint_program(program: LintProgram, only=None) -> dict:
     """Build + lint one registered program (the tools' row thunk)."""
-    row = lint_built(program.build(), platforms=program.export_platforms)
+    row = lint_built(program.build(), platforms=program.export_platforms,
+                     only=only)
     return {"ok": row["lint_ok"], "route": program.route, **row}

@@ -1,0 +1,400 @@
+"""The static sharding auditor: lint rules 7-9 (sharding_contract,
+collective_axes, replication_leaks).
+
+Both GSPMD defects the chaos harness has caught were statically decidable
+and were caught at runtime anyway: PR 6's retrace-on-reshard (an
+unnormalized ``P('tp', None)`` carry spec compared unequal to XLA's
+normalized report, silently retracing every second dispatch) and PR 7's
+sharded bitmask pack (a ``P('w')`` buffer that silently went replicated
+and shifted every bit). These rules make that class fail
+``program_lint.json`` instead of a chaos cell three PRs later:
+
+  sharding_contract  (a) every array arg leaf matches EXACTLY ONE rule of
+                     the program's declared partition table
+                     (parallel/partition.py) and that rule's spec is
+                     normalized (``norm_spec`` fixed-point — the PR 6
+                     class); (b) every donated state input leaf's compiled
+                     sharding equals its corresponding output leaf's (the
+                     static form of retrace-on-reshard: in != out means
+                     the second dispatch reshards the carry)
+  collective_axes    every explicit collective in the exported module is
+                     classified by the mesh axis it reduces over (via
+                     ``replica_groups`` / ``source_target_pairs`` against
+                     the mesh's device grid) and the per-axis {kind:
+                     count} map must equal ``Manifest.collective_axes``
+                     — tree combine programs pin one psum per level ON
+                     that level's axis; the row also carries a per-axis
+                     byte ledger so cross-host vs intra-host traffic is
+                     priced before multi-host lands (ROADMAP item 1)
+  replication_leaks  arrays the partition table declares sharded over a
+                     real (size>1) mesh axis must not compile
+                     fully-replicated — the silent O(n*d) memory /
+                     bandwidth regression class (the PR 7 neighborhood)
+
+The compiled I/O shardings come from the same host compile that already
+records the memory ledger (``rules.trace_and_export``); the collective
+classification reads the exported StableHLO text, where explicit
+(shard_map) collectives carry their device groups and GSPMD-deferred ones
+do not yet exist — the same boundary the count rule (rule 4) pins.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from typing import Optional
+
+from draco_tpu.parallel.partition import (
+    arg_leaf_paths,
+    match_report,
+    norm_spec,
+    spec_axes,
+)
+
+_ITEMSIZE = {
+    "f64": 8, "f32": 4, "f16": 2, "bf16": 2,
+    "i64": 8, "i32": 4, "i16": 2, "i8": 1, "i1": 1,
+    "ui64": 8, "ui32": 4, "ui16": 2, "ui8": 1,
+    "complex<f32>": 8, "complex<f64>": 16,
+}
+
+_COLLECTIVE_RE = re.compile(
+    r"stablehlo\.(all_reduce|all_gather|all_to_all|collective_permute|"
+    r"reduce_scatter)\b")
+
+# the function-type separator that ends an op's operand segment: generic
+# non-region ops print `}> : (tensor<...`, region ops `}) : (tensor<...`;
+# region BODIES pretty-print (`stablehlo.add ... : tensor<f32>`, no
+# parenthesized function type), so the first match is the op's own type
+_OPERAND_TYPE_RE = re.compile(
+    r"\)\s*:\s*\(\s*tensor<((?:\d+x)*)([a-z0-9]+(?:<[a-z0-9]+>)?)>")
+
+_GROUPS_RE = re.compile(
+    r"replica_groups\s*=\s*dense<(.*?)>\s*:\s*tensor<((?:\d+x?)*)xi64>",
+    re.S)
+_PAIRS_RE = re.compile(
+    r"source_target_pairs\s*=\s*dense<(.*?)>\s*:\s*tensor<((?:\d+x?)*)xi64>",
+    re.S)
+
+
+def _skip(reason):
+    return {"ok": True, "skipped": True, "reason": reason}
+
+
+def _parse_id_matrix(body: str, dims_txt: str) -> "list[list[int]]":
+    """A dense<...> i64 matrix attr: JSON-shaped nested lists, or a splat
+    scalar broadcast to the attr's tensor shape."""
+    body = body.strip()
+    dims = [int(d) for d in dims_txt.split("x") if d]
+    if not body:
+        return []
+    if body.startswith("["):
+        mat = json.loads(body)
+        if mat and not isinstance(mat[0], list):
+            mat = [mat]
+        return [[int(v) for v in row] for row in mat]
+    rows, cols = (dims + [1, 1])[:2]
+    return [[int(body)] * cols for _ in range(rows)]
+
+
+def parse_module_collectives(mlir_text: str) -> "list[dict]":
+    """Every explicit collective op in the exported module text, with its
+    device groups (or permute pairs) and per-shard operand bytes."""
+    ops = []
+    for m in _COLLECTIVE_RE.finditer(mlir_text):
+        window = mlir_text[m.start():m.start() + 20000]
+        tm = _OPERAND_TYPE_RE.search(window)
+        nbytes = None
+        if tm is not None:
+            dims = [int(d) for d in tm.group(1).split("x") if d]
+            elems = 1
+            for d in dims:
+                elems *= d
+            nbytes = elems * _ITEMSIZE.get(tm.group(2), 4)
+        attrs = window[:tm.start()] if tm is not None else window
+        op = {"kind": m.group(1), "bytes": nbytes,
+              "groups": None, "pairs": None}
+        gm = _GROUPS_RE.search(attrs)
+        if gm is not None:
+            op["groups"] = _parse_id_matrix(gm.group(1), gm.group(2))
+        pm = _PAIRS_RE.search(attrs)
+        if pm is not None:
+            op["pairs"] = _parse_id_matrix(pm.group(1), pm.group(2))
+        ops.append(op)
+    return ops
+
+
+def _device_grids(mesh):
+    """The two id models a module's device groups may use, as mesh-shaped
+    integer grids: flat position in the mesh's device assignment
+    (partition ids) and the actual jax device ids (use_global_device_ids).
+    On the reshaped-``jax.devices()`` CI meshes they coincide."""
+    import numpy as np
+
+    shape = tuple(mesh.devices.shape)
+    flat = np.arange(int(np.prod(shape))).reshape(shape)
+    ids = np.vectorize(lambda d: d.id)(mesh.devices).reshape(shape)
+    return [flat, ids]
+
+
+def _axis_partitions(mesh):
+    """axis name -> candidate partitions of device ids into groups that a
+    collective over exactly that axis would carry (size-1 axes excluded:
+    a collective over a trivial axis is a no-op and classifies nowhere)."""
+    import numpy as np
+
+    names = list(mesh.axis_names)
+    parts = {}
+    for grid in _device_grids(mesh):
+        for i, name in enumerate(names):
+            size = grid.shape[i]
+            if size <= 1:
+                continue
+            rows = np.moveaxis(grid, i, -1).reshape(-1, size)
+            part = frozenset(frozenset(int(v) for v in row) for row in rows)
+            parts.setdefault(name, set()).add(part)
+    return parts
+
+
+def classify_collective(mesh, op: dict) -> Optional[str]:
+    """The mesh axis a collective reduces/permutes over, or None."""
+    import numpy as np
+
+    parts = _axis_partitions(mesh)
+    if op.get("groups"):
+        observed = frozenset(frozenset(g) for g in op["groups"])
+        for axis, candidates in parts.items():
+            if observed in candidates:
+                return axis
+        return None
+    if op.get("pairs"):
+        names = list(mesh.axis_names)
+        for grid in _device_grids(mesh):
+            coords = {int(grid[idx]): idx
+                      for idx in np.ndindex(*grid.shape)}
+            axes = set()
+            ok = True
+            for s, t in op["pairs"]:
+                if s not in coords or t not in coords:
+                    ok = False
+                    break
+                diff = [i for i in range(len(names))
+                        if coords[s][i] != coords[t][i]]
+                if len(diff) != 1:
+                    ok = False
+                    break
+                axes.add(names[diff[0]])
+            if ok and len(axes) == 1:
+                return axes.pop()
+        return None
+    return None
+
+
+def _spec_of(sharding):
+    return getattr(sharding, "spec", None)
+
+
+def rule_sharding_contract(art) -> dict:
+    """Rule 7: partition-table coverage (exactly-one match, normalized
+    spec) + donated-carry sharding equality (compiled input leaf sharding
+    == corresponding output leaf sharding)."""
+    import jax
+
+    built = art.built
+    res: dict = {}
+    errors = []
+
+    if built.partition_rules is None:
+        res["table"] = {"skipped": True,
+                        "reason": "no partition table registered"}
+    else:
+        paths = arg_leaf_paths(built.args, built.arg_names)
+        report = match_report(built.partition_rules, paths)
+        bad = [r for r in report
+               if r["n_matches"] != 1 or not r["normalized"]]
+        res["table"] = {"leaves_checked": len(report),
+                        "violations": bad[:6]}
+        for r in bad[:3]:
+            if r["n_matches"] == 0:
+                errors.append(f"{r['path']}: matched by NO partition rule "
+                              f"— extend the route table "
+                              f"(parallel/partition.py)")
+            elif r["n_matches"] > 1:
+                errors.append(f"{r['path']}: matched by {r['n_matches']} "
+                              f"partition rules — tables must be disjoint")
+            else:
+                errors.append(f"{r['path']}: rule spec {r['spec']} is not "
+                              f"normalized (trailing None) — the PR 6 "
+                              f"retrace-on-reshard class; declare "
+                              f"norm_spec fixed-points only")
+
+    if art.manifest.require_donated is None:
+        res["carry"] = {"skipped": True,
+                        "reason": "no donated state carry to hold the "
+                                  "in==out contract to"}
+    elif art.input_shardings is None or art.output_shardings is None:
+        res["carry"] = {"skipped": True,
+                        "reason": f"compiled shardings unavailable: "
+                                  f"{art.compile_error or art.export_error}"}
+    else:
+        paths = arg_leaf_paths(built.args, built.arg_names)
+        n_state = len(jax.tree.leaves(built.args[0]))
+        if (len(art.input_shardings) != len(paths)
+                or len(art.output_shardings) < n_state):
+            res["carry"] = {
+                "skipped": True,
+                "reason": f"cannot align leaves to compiled shardings "
+                          f"({len(art.input_shardings)} input shardings "
+                          f"for {len(paths)} arg leaves — jit pruned "
+                          f"unused args)"}
+        else:
+            mismatched = []
+            for i in range(n_state):
+                s_in = _spec_of(art.input_shardings[i])
+                s_out = _spec_of(art.output_shardings[i])
+                if s_in is not None and s_out is not None:
+                    same = norm_spec(s_in) == norm_spec(s_out)
+                else:  # non-Named shardings: compare HLO sharding text
+                    same = str(art.input_shardings[i]) == str(
+                        art.output_shardings[i])
+                if not same:
+                    mismatched.append({"path": paths[i][0],
+                                       "in": str(s_in),
+                                       "out": str(s_out)})
+            res["carry"] = {"state_leaves": n_state,
+                            "mismatched": mismatched[:6]}
+            for mm in mismatched[:3]:
+                errors.append(
+                    f"{mm['path']}: donated carry enters {mm['in']} but "
+                    f"returns {mm['out']} — the second dispatch reshards "
+                    f"(static retrace-on-reshard, the PR 6 bug shape); "
+                    f"commit the state sharding and pin out_shardings")
+
+    if res["table"].get("skipped") and res["carry"].get("skipped"):
+        return {**_skip(f"{res['table']['reason']}; "
+                        f"{res['carry']['reason']}"), **res}
+    if errors:
+        return {"ok": False, **res, "error": "; ".join(errors)}
+    return {"ok": True, **res}
+
+
+def rule_collective_axes(art) -> dict:
+    """Rule 8: per-axis collective budget + the per-axis byte ledger."""
+    from draco_tpu.analysis.registry import COLLECTIVE_KINDS
+
+    m = art.manifest
+    if m.collective_axes is None:
+        return _skip("manifest.collective_axes is None (kernel-only or "
+                     "meshless program)")
+    if art.mlir_text is None:
+        return _skip(f"export unavailable: {art.export_error}")
+    mesh = art.built.mesh
+    if mesh is None:
+        return {"ok": False,
+                "error": "manifest.collective_axes declared but the "
+                         "program registered no mesh to classify against"}
+    unknown_axes = set(m.collective_axes) - set(mesh.axis_names)
+    unknown_kinds = {k for per in m.collective_axes.values()
+                     for k in per} - set(COLLECTIVE_KINDS)
+    if unknown_axes or unknown_kinds:
+        return {"ok": False,
+                "error": f"manifest.collective_axes names unknown "
+                         f"axes {sorted(unknown_axes)} / kinds "
+                         f"{sorted(unknown_kinds)}"}
+
+    observed: dict = {}
+    ledger: dict = {}
+    for op in parse_module_collectives(art.mlir_text):
+        axis = classify_collective(mesh, op) or "?"
+        observed.setdefault(axis, {}).setdefault(op["kind"], 0)
+        observed[axis][op["kind"]] += 1
+        led = ledger.setdefault(axis, {"ops": 0, "bytes": 0})
+        led["ops"] += 1
+        led["bytes"] += op["bytes"] or 0
+
+    expected = {axis: {k: int(n) for k, n in per.items() if n}
+                for axis, per in m.collective_axes.items()}
+    expected = {axis: per for axis, per in expected.items() if per}
+    res = {"observed": observed, "expected": expected,
+           "axis_ledger": ledger}
+    if observed != expected:
+        return {"ok": False, **res,
+                "error": f"per-axis collective structure drifted from the "
+                         f"manifest (expected {expected}, observed "
+                         f"{observed}; axis '?' = device groups matching "
+                         f"no single mesh axis) — a wrong-axis psum "
+                         f"reduces over the wrong devices even when the "
+                         f"op COUNT is unchanged; a deliberate topology "
+                         f"change updates Manifest.collective_axes"}
+    return {"ok": True, **res}
+
+
+def rule_replication_leaks(art) -> dict:
+    """Rule 9: declared-sharded arrays must not compile fully-replicated
+    (checked on real, size>1 mesh axes; the folded w x 1 meshes make
+    trivial-axis sharding vacuous by construction)."""
+    built = art.built
+    if built.partition_rules is None:
+        return _skip("no partition table registered")
+    if art.input_shardings is None:
+        return _skip(f"compiled shardings unavailable: "
+                     f"{art.compile_error or art.export_error}")
+    mesh_sizes = dict(built.mesh.shape) if built.mesh is not None else {}
+    paths = arg_leaf_paths(built.args, built.arg_names)
+    report = match_report(built.partition_rules, paths)
+    declared = {r["path"]: r for r in report}
+
+    if len(art.input_shardings) != len(paths):
+        # jit pruned unused args -> positional alignment is impossible;
+        # fall back to the aggregate form of the check
+        any_decl = any(
+            r["n_matches"] == 1 and r["spec"] not in (None, "PartitionSpec()")
+            for r in report)
+        all_repl = all(getattr(s, "is_fully_replicated", False)
+                       for s in art.input_shardings)
+        res = {"aggregate_only": True,
+               "reason": f"{len(art.input_shardings)} compiled input "
+                         f"shardings for {len(paths)} arg leaves (jit "
+                         f"pruned unused args)",
+               "inputs_checked": len(art.input_shardings)}
+        if any_decl and art.input_shardings and all_repl:
+            return {"ok": False, **res,
+                    "error": "the table declares sharded buffers but "
+                             "EVERY compiled input is fully replicated — "
+                             "the O(n*d) replication-leak class"}
+        return {"ok": True, **res}
+
+    leaks = []
+    checked = 0
+    for (path, leaf), sh in zip(paths, art.input_shardings):
+        r = declared.get(path)
+        if r is None or r["n_matches"] != 1:
+            continue  # scalars / coverage problems: rule 7's business
+        rule_spec = next(spec for pat, spec in built.partition_rules
+                         if re.search(pat, path))
+        need = {a for a in spec_axes(rule_spec)
+                if mesh_sizes.get(a, 1) > 1}
+        if not need:
+            continue
+        checked += 1
+        compiled_spec = _spec_of(sh)
+        if compiled_spec is None:
+            if getattr(sh, "is_fully_replicated", False):
+                leaks.append({"path": path, "declared": str(rule_spec),
+                              "compiled": "replicated"})
+            continue
+        if not need <= set(spec_axes(compiled_spec)):
+            leaks.append({"path": path, "declared": str(rule_spec),
+                          "compiled": str(compiled_spec)})
+    res = {"declared_sharded_leaves": checked, "leaks": leaks[:6]}
+    if leaks:
+        return {"ok": False, **res,
+                "error": f"{len(leaks)} table-declared-sharded arrays "
+                         f"compile without their declared axes (first: "
+                         f"{leaks[0]['path']} declared "
+                         f"{leaks[0]['declared']}, compiled "
+                         f"{leaks[0]['compiled']}) — a fully-replicated "
+                         f"'sharded' buffer is the silent O(n*d) "
+                         f"memory/bandwidth regression (the PR 7 "
+                         f"sharded-pack neighborhood)"}
+    return {"ok": True, **res}

@@ -103,7 +103,8 @@ def auto_levels(n: int, fanout: int) -> int:
         else 2
 
 
-def level_fanouts(num_groups: int, fanout: int, levels: int) -> Tuple[int, ...]:
+def level_fanouts(num_groups: int, fanout: int,
+                  levels: int) -> Tuple[int, ...]:
     """Split the group-folding into ``levels - 1`` per-level fan-ins, each
     <= ``fanout``, innermost first, product exactly ``num_groups``."""
     fans = []
@@ -442,13 +443,15 @@ def make_tree_decode_shmap(tcode: TreeCode, mesh, impl: str = "xla",
     from draco_tpu.coding import cyclic as cyclic_mod
     from draco_tpu.runtime import shard_map
 
+    from draco_tpu.parallel.partition import tree_rows
+
     code = tcode.group_code
     plan = tcode.plan
     tol = cyclic_mod.HEALTH_REL_TOL if rel_tol is None else rel_tol
     level_axes = tree_axis_names(plan)
     # rows partition over the level axes only: each device (and every "wi"
     # replica) holds its group's full (g, d) block
-    row_spec = P(tuple(reversed(level_axes)))
+    row_spec = tree_rows(level_axes)
 
     def device_decode(r_re, r_im, rand_factor, present):
         dec, _ = cyclic_mod.decode(code, r_re, r_im, rand_factor,
@@ -490,9 +493,12 @@ def lint_programs():
                           topology="tree", tree_fanout=g,
                           dataset="synthetic-mnist", network="LeNet",
                           batch_size=2)
+        from draco_tpu.parallel.partition import tree_combine_rules
+
         tcode = build_tree_code(cfg)
         mesh = tree_mesh(tcode.plan)
         fn = make_tree_decode_shmap(tcode, mesh)
+        level_axes = tree_axis_names(tcode.plan)
         d = 8192
         args = (np.zeros((n, d), np.float32), np.zeros((n, d), np.float32),
                 np.ones((d,), np.float32), np.ones((n,), np.float32))
@@ -501,11 +507,17 @@ def lint_programs():
             max_module_bytes=1 << 20,
             require_donated=None,
             collectives={"all_reduce": tcode.plan.levels - 1},
+            # the combine IS the communication structure: exactly one psum
+            # per level, each on that level's own mesh sub-axis
+            collective_axes={ax: {"all_reduce": 1} for ax in level_axes},
             host_transfer_budget=0,
             max_peak_bytes=1 << 30,
         )
         return BuiltProgram(name=name, fn=fn, args=args, mesh=mesh,
-                            manifest=manifest)
+                            manifest=manifest,
+                            partition_rules=tree_combine_rules(level_axes),
+                            arg_names=("r_re", "r_im", "rand_factor",
+                                       "present"))
 
     return [
         LintProgram(name="tree_combine_g2_l3",

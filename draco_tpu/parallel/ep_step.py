@@ -23,6 +23,7 @@ from jax.sharding import PartitionSpec as P
 
 from draco_tpu.config import TrainConfig
 from draco_tpu.parallel.mesh import EP_AXIS
+from draco_tpu.parallel.partition import EP_STEP_RULES
 from draco_tpu.parallel.token_loop import run_token_loop
 from draco_tpu.parallel.tp_step import (
     TPTrainSetup,
@@ -72,7 +73,10 @@ def lint_programs():
         mesh = make_mesh_wep(4, 2)  # 8 CI devices; n=8 folds 2 lanes/device
         setup = build_ep_train_setup(cfg, mesh)
         return built_token_program(name, cfg, mesh, setup,
-                                   Manifest(collectives={}), many=many)
+                                   Manifest(collectives={},
+                                            collective_axes={}),
+                                   many=many,
+                                   partition_rules=EP_STEP_RULES)
 
     return [
         LintProgram("lm_ep_step", route="ep",

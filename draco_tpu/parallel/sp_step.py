@@ -25,7 +25,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
 from draco_tpu import optim, rng as drng
 from draco_tpu.coding import cyclic as cyclic_mod
@@ -44,6 +44,9 @@ from draco_tpu.parallel.common import (
     token_metric_names,
 )
 from draco_tpu.parallel.mesh import SEQ_AXIS
+from draco_tpu.parallel.partition import (
+    REPLICATED, SP_STEP_RULES, WORKER_ROWS, WORKER_ROWS3, sharding,
+)
 from draco_tpu.parallel.ring_attention import ring_attention
 from draco_tpu.runtime import WORKER_AXIS
 from draco_tpu.training.step import TrainState, _flatten_tree, _make_unravel
@@ -52,7 +55,8 @@ from draco_tpu.training.step import TrainState, _flatten_tree, _make_unravel
 class SPTrainSetup(NamedTuple):
     model: TransformerLM
     state: TrainState
-    train_step: any  # (state, tokens (n,B,T), adv_mask (n,)) -> (state, metrics)
+    # (state, tokens (n,B,T), adv_mask (n,)) -> (state, metrics)
+    train_step: any
     eval_step: any  # (params, tokens) -> loss (no donation, no update)
     code: Optional[cyclic_mod.CyclicCode]
     unravel: any
@@ -64,8 +68,10 @@ class SPTrainSetup(NamedTuple):
     metric_names: tuple = TOKEN_METRIC_NAMES
 
 
-def synthetic_text(seed: int, step: int, n: int, batch: int, seq_len: int, vocab: int):
-    """Deterministic learnable token stream: ramps t_{i+1} = t_i + stride with
+def synthetic_text(seed: int, step: int, n: int, batch: int,
+                   seq_len: int, vocab: int):
+    """Deterministic learnable token stream: ramps t_{i+1} = t_i + stride
+    with
     per-sequence stride ∈ {1, 2}. Same (seed, step) ⇒ same batch everywhere."""
     r = np.random.RandomState((seed * 1_000_003 + step) % (2**31 - 1))
     start = r.randint(0, vocab, size=(n, batch, 1))
@@ -169,8 +175,8 @@ def build_sp_train_setup(cfg: TrainConfig, mesh) -> SPTrainSetup:
     opt = optim.build_optimizer_from_cfg(cfg)
     unravel, dim, leaf_offsets = _make_unravel(params)
 
-    repl = NamedSharding(mesh, P())
-    shard_w = NamedSharding(mesh, P(WORKER_AXIS))
+    repl = sharding(mesh, REPLICATED)
+    shard_w = sharding(mesh, WORKER_ROWS)
     state = TrainState(
         params=jax.device_put(params, repl),
         opt_state=jax.device_put(opt.init(params), repl),
@@ -193,14 +199,16 @@ def build_sp_train_setup(cfg: TrainConfig, mesh) -> SPTrainSetup:
         nxt_first = lax.ppermute(
             toks[:, :1], SEQ_AXIS, [(j, (j - 1) % sp) for j in range(sp)]
         )
-        targets = jnp.concatenate([toks[:, 1:], nxt_first], axis=1)  # (B, t_local)
+        # (B, t_local)
+        targets = jnp.concatenate([toks[:, 1:], nxt_first], axis=1)
         pos_valid = jnp.where(
             idx == sp - 1,
             (jnp.arange(t_local) < t_local - 1).astype(jnp.float32),
             jnp.ones((t_local,), jnp.float32),
         )
         denom = toks.shape[0] * (cfg.seq_len - 1)
-        logits = model.apply({"params": params}, toks, pos_offset=off, train=train)
+        logits = model.apply({"params": params}, toks, pos_offset=off,
+                             train=train)
         logp = jax.nn.log_softmax(logits.astype(jnp.float32))
         nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
         return jnp.sum(nll * pos_valid[None, :]) / denom
@@ -224,7 +232,8 @@ def build_sp_train_setup(cfg: TrainConfig, mesh) -> SPTrainSetup:
         return g, loss
 
     def device_loss(params, tokens):
-        """Forward-only held-out loss (no backward, no gradient ICI traffic)."""
+        """Forward-only held-out loss (no backward, no gradient ICI
+        traffic)."""
         loss = jax.vmap(
             lambda toks: _shard_objective(params, toks, train=False)
         )(tokens)
@@ -264,7 +273,7 @@ def build_sp_train_setup(cfg: TrainConfig, mesh) -> SPTrainSetup:
     code = build_code_from_cfg(cfg)
     simulate = cfg.approach == "cyclic" and cfg.redundancy == "simulate"
     batch_ids = jnp.asarray(code.batch_ids) if simulate else None
-    shard_w3 = NamedSharding(mesh, P(WORKER_AXIS, None, None))
+    shard_w3 = sharding(mesh, WORKER_ROWS3)
 
     def step_body(state: TrainState, tokens, adv_mask, present=None):
         with jax.named_scope("draco_comp"):
@@ -346,10 +355,17 @@ def lint_programs():
     )
     from draco_tpu.parallel.mesh import make_mesh_2d
 
-    manifest = Manifest(collectives=LINT_COLLECTIVES)
+    # every explicit collective in the route lowers over the sp axis (ring
+    # hops + the two gradient/loss psums); a w- or cross-axis collective
+    # here means the coding tail stopped being pure GSPMD
+    LINT_COLLECTIVE_AXES = {"sp": dict(LINT_COLLECTIVES)}
+
+    manifest = Manifest(collectives=LINT_COLLECTIVES,
+                        collective_axes=LINT_COLLECTIVE_AXES)
     # the shadow-watch program's bf16 rounds are whitelisted converts;
     # everything else in its manifest matches the ring budget exactly
     manifest_bf16 = Manifest(collectives=LINT_COLLECTIVES,
+                             collective_axes=LINT_COLLECTIVE_AXES,
                              allowed_dtypes=BF16_DTYPES)
 
     def _build(name, many, mf=None, **overrides):
@@ -357,7 +373,7 @@ def lint_programs():
         mesh = make_mesh_2d(4, 2)  # 8 CI devices; n=8 folds 2 lanes/device
         setup = build_sp_train_setup(cfg, mesh)
         return built_token_program(name, cfg, mesh, setup, mf or manifest,
-                                   many=many)
+                                   many=many, partition_rules=SP_STEP_RULES)
 
     return [
         LintProgram("lm_sp_ring_step", route="sp",
@@ -416,6 +432,7 @@ def lint_programs():
                     build=lambda: _build(
                         "lm_sp_ring_wire_bf16_many_k2", True,
                         mf=Manifest(collectives=LINT_COLLECTIVES,
+                                    collective_axes=LINT_COLLECTIVE_AXES,
                                     allowed_dtypes=BF16_DTYPES,
                                     required_dtypes=frozenset({"bf16"})),
                         wire_dtype="bf16", step_guard="on")),

@@ -44,7 +44,17 @@ from draco_tpu.parallel.common import (
     token_metric_names,
 )
 from draco_tpu.parallel.mesh import TP_AXIS
-from draco_tpu.parallel.token_loop import run_token_loop  # noqa: F401  (re-export: historical home)
+from draco_tpu.parallel.partition import (
+    REPLICATED,
+    TP_STEP_RULES,
+    WORKER_ROWS,
+    WORKER_ROWS3,
+    norm_spec,
+    override,
+    sharding,
+)
+# re-export: historical home
+from draco_tpu.parallel.token_loop import run_token_loop  # noqa: F401
 from draco_tpu.runtime import WORKER_AXIS
 from draco_tpu.training.step import TrainState, _flatten_tree, _make_unravel
 
@@ -52,7 +62,8 @@ from draco_tpu.training.step import TrainState, _flatten_tree, _make_unravel
 class TPTrainSetup(NamedTuple):
     model: TransformerLM
     state: TrainState
-    train_step: any  # (state, tokens (n,B,T), adv_mask (n,)) -> (state, metrics)
+    # (state, tokens (n,B,T), adv_mask (n,)) -> (state, metrics)
+    train_step: any
     eval_step: any  # (params, tokens) -> loss
     code: Optional[cyclic_mod.CyclicCode]
     unravel: any
@@ -91,17 +102,11 @@ def param_partition_spec(path) -> P:
     return P(*spec)
 
 
-def _norm_spec(spec) -> P:
-    """PartitionSpec with trailing Nones stripped — XLA reports output
-    shardings in this normalized spelling (``P('tp', None)`` comes back as
-    ``P('tp')``), and jit's cache compares shardings by equality, so an
-    UN-normalized input spec against a normalized output spec retraces the
-    K-fused program on its second dispatch (the silent steady-state
-    recompile the PR 5 sentinel flags on the real tp/ep meshes)."""
-    parts = tuple(spec)
-    while parts and parts[-1] is None:
-        parts = parts[:-1]
-    return P(*parts)
+# The trailing-None spec normalizer this route's PR 6 fix introduced now
+# lives in parallel/partition.norm_spec (the canonical copy every route
+# and the static sharding auditor share); re-exported under the old name
+# for the retrace-regression tests.
+_norm_spec = norm_spec
 
 
 def shard_params(params, mesh, partition_fn=param_partition_spec):
@@ -135,7 +140,8 @@ def build_tp_train_setup(cfg: TrainConfig, mesh) -> TPTrainSetup:
 
 
 def _build_gspmd_train_setup(cfg: TrainConfig, mesh, *, mp_axis: str,
-                             mp_size: int, partition_fn, experts: int) -> TPTrainSetup:
+                             mp_size: int, partition_fn,
+                             experts: int) -> TPTrainSetup:
     """Shared GSPMD builder for the sharding-annotation model-parallel paths
     (tensor parallelism here; expert parallelism in ep_step.py). The paths
     differ only in the mesh axis, the parameter partition rules, and the
@@ -181,8 +187,8 @@ def _build_gspmd_train_setup(cfg: TrainConfig, mesh, *, mp_axis: str,
     opt = optim.build_optimizer_from_cfg(cfg)
     unravel, dim, leaf_offsets = _make_unravel(params)
 
-    repl = NamedSharding(mesh, P())
-    shard_w = NamedSharding(mesh, P(WORKER_AXIS))
+    repl = sharding(mesh, REPLICATED)
+    shard_w = sharding(mesh, WORKER_ROWS)
     params = shard_params(params, mesh, partition_fn)
     # opt.init is zeros_like on the sharded params, so the slots inherit
     # the tp layout with no host round-trip (multi-host safe) — but its
@@ -245,7 +251,7 @@ def _build_gspmd_train_setup(cfg: TrainConfig, mesh, *, mp_axis: str,
     # deterministic under XLA)
     simulate = cfg.approach == "cyclic" and cfg.redundancy == "simulate"
     batch_ids = jnp.asarray(code.batch_ids) if simulate else None
-    shard_w3 = NamedSharding(mesh, P(WORKER_AXIS, None, None))
+    shard_w3 = sharding(mesh, WORKER_ROWS3)
 
     def step_body(state: TrainState, tokens, adv_mask, present=None):
         def lane(toks):
@@ -255,7 +261,8 @@ def _build_gspmd_train_setup(cfg: TrainConfig, mesh, *, mp_axis: str,
         with jax.named_scope("draco_comp"):
             if simulate:
                 toks_w = tokens[batch_ids]  # (n, hat_s, B, T) redundant rows
-                grads, losses = jax.vmap(jax.vmap(lane))(toks_w)  # (n, hat_s, d)
+                # (n, hat_s, d)
+                grads, losses = jax.vmap(jax.vmap(lane))(toks_w)
                 grads = jax.lax.with_sharding_constraint(grads, shard_w3)
                 losses = jnp.mean(losses, axis=1)
             else:
@@ -282,7 +289,8 @@ def _build_gspmd_train_setup(cfg: TrainConfig, mesh, *, mp_axis: str,
         return new_state, metrics
 
     def eval_body(params, tokens):
-        return jnp.mean(jax.vmap(lambda t: lane_loss(params, t, False))(tokens))
+        return jnp.mean(
+            jax.vmap(lambda t: lane_loss(params, t, False))(tokens))
 
     from draco_tpu.parallel.sp_step import token_fn_from_cfg
 
@@ -339,12 +347,19 @@ def lint_programs():
     )
     from draco_tpu.parallel.mesh import make_folded_wtp_mesh, make_mesh_wtp
 
+    # the devgen program's token input is the (K,) step vector, not a
+    # host batch — it rides replicated (partition.override docstring)
+    devgen_rules = override(TP_STEP_RULES, (r"^tokens$", REPLICATED))
+
     def _tp2(name, many, **overrides):
         cfg = ci_lm_config(tensor_shards=2, **overrides)
         mesh = make_mesh_wtp(4, 2)  # 8 CI devices; n=8 folds 2 lanes/device
         setup = build_tp_train_setup(cfg, mesh)
         return built_token_program(name, cfg, mesh, setup,
-                                   Manifest(collectives={}), many=many)
+                                   Manifest(collectives={},
+                                            collective_axes={}),
+                                   many=many,
+                                   partition_rules=TP_STEP_RULES)
 
     def _fold(name, many, **overrides):
         cfg = ci_lm_config(tensor_shards=1, **overrides)
@@ -352,9 +367,13 @@ def lint_programs():
         setup = build_tp_train_setup(cfg, mesh)
         allowed = (BF16_DTYPES if cfg.compute_dtype == "bfloat16"
                    else Manifest.allowed_dtypes)
+        rules = (devgen_rules if cfg.token_gen == "device"
+                 else TP_STEP_RULES)
         return built_token_program(
             name, cfg, mesh, setup,
-            Manifest(collectives={}, allowed_dtypes=allowed), many=many)
+            Manifest(collectives={}, collective_axes={},
+                     allowed_dtypes=allowed), many=many,
+            partition_rules=rules)
 
     def _fold_big(name):
         cfg = ci_lm_config(
@@ -372,11 +391,12 @@ def lint_programs():
         # a closed-over (d,) f32 would add 4*d bytes; the honest program is
         # a few hundred KB. 2*d sits far from both (test_program_size
         # lineage).
-        manifest = Manifest(collectives={}, allowed_dtypes=BF16_DTYPES,
+        manifest = Manifest(collectives={}, collective_axes={},
+                            allowed_dtypes=BF16_DTYPES,
                             max_module_bytes=2 * setup.dim,
                             max_constant_bytes=1 << 20)
         return built_token_program(name, cfg, mesh, setup, manifest,
-                                   many=True)
+                                   many=True, partition_rules=TP_STEP_RULES)
 
     mk = lambda name, build, **kw: LintProgram(  # noqa: E731
         name=name, route="tp", build=build, **kw)

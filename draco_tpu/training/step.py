@@ -33,8 +33,6 @@ from typing import Any, NamedTuple, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import NamedSharding, PartitionSpec as P
-
 from draco_tpu import aggregation, attacks, optim, rng as drng
 from draco_tpu.config import TrainConfig
 from draco_tpu.coding import cyclic as cyclic_mod
@@ -103,7 +101,8 @@ class TrainSetup(NamedTuple):
     model: Any
     state: TrainState
     train_step: Any  # (state, x, y, adv_mask) -> (state, metrics)
-    eval_step: Any  # (state, x, y, valid) -> (correct@1 count, correct@5 count)
+    # (state, x, y, valid) -> (correct@1 count, correct@5 count)
+    eval_step: Any
     code: Any  # CyclicCode | RepetitionCode | None
     unravel: Any  # flat (d,) -> params pytree
     dim: int
@@ -120,7 +119,8 @@ def _cross_entropy(logits, labels):
 
 
 def _flatten_tree(tree) -> jnp.ndarray:
-    return jnp.concatenate([jnp.reshape(x, (-1,)) for x in jax.tree.leaves(tree)])
+    return jnp.concatenate(
+        [jnp.reshape(x, (-1,)) for x in jax.tree.leaves(tree)])
 
 
 def _make_unravel(params):
@@ -143,8 +143,10 @@ def _make_unravel(params):
     return unravel, int(offsets[-1]), offsets
 
 
-def build_train_setup(cfg: TrainConfig, mesh, dataset_name: Optional[str] = None) -> TrainSetup:
-    """Construct model/state and the jitted train & eval steps for cfg.approach."""
+def build_train_setup(cfg: TrainConfig, mesh,
+                      dataset_name: Optional[str] = None) -> TrainSetup:
+    """Construct model/state and the jitted train & eval steps for
+    cfg.approach."""
     cfg.validate()
     n = cfg.num_workers
     shape = input_shape(dataset_name or cfg.dataset)
@@ -153,13 +155,16 @@ def build_train_setup(cfg: TrainConfig, mesh, dataset_name: Optional[str] = None
 
     root = jax.random.key(cfg.seed)
     init_x = jnp.zeros((2,) + shape, jnp.float32)
-    variables = model.init({"params": root, "dropout": jax.random.fold_in(root, 1)},
+    variables = model.init(
+        {"params": root, "dropout": jax.random.fold_in(root, 1)},
                            init_x, train=True)
     params = variables["params"]
     has_bn = "batch_stats" in variables
-    # per-worker BN statistics (never aggregated — reference worker/utils.py:46-48)
+    # per-worker BN statistics (never aggregated — reference
+    # worker/utils.py:46-48)
     batch_stats = (
-        jax.tree.map(lambda x: jnp.broadcast_to(x, (n,) + x.shape), variables["batch_stats"])
+        jax.tree.map(lambda x: jnp.broadcast_to(x, (n,) + x.shape),
+                     variables["batch_stats"])
         if has_bn
         else None
     )
@@ -168,8 +173,13 @@ def build_train_setup(cfg: TrainConfig, mesh, dataset_name: Optional[str] = None
     opt_state = opt.init(params)
     unravel, dim, leaf_offsets = _make_unravel(params)
 
-    repl = NamedSharding(mesh, P())
-    shard_w = NamedSharding(mesh, P(WORKER_AXIS))
+    # lazy: parallel/__init__ imports this module
+    from draco_tpu.parallel.partition import (
+        REPLICATED, WORKER_ROWS, WORKER_ROWS3, sharding,
+    )
+
+    repl = sharding(mesh, REPLICATED)
+    shard_w = sharding(mesh, WORKER_ROWS)
 
     state = TrainState(
         params=jax.device_put(params, repl),
@@ -204,7 +214,8 @@ def build_train_setup(cfg: TrainConfig, mesh, dataset_name: Optional[str] = None
     lane_loss = jax.checkpoint(loss_fn) if cfg.remat else loss_fn
 
     def lane(p, stats, x, y, dkey):
-        """One logical worker/batch lane -> (flat grad, new_stats, loss, prec1)."""
+        """One logical worker/batch lane ->
+        (flat grad, new_stats, loss, prec1)."""
         # named scope: fwd/bwd ops group under Draco's "comp" phase in XProf
         # device traces (reference segment names, cyclic_worker.py:154-156)
         with jax.named_scope("draco_comp"):
@@ -236,7 +247,8 @@ def build_train_setup(cfg: TrainConfig, mesh, dataset_name: Optional[str] = None
         it is what makes the shared-redundancy encode exact."""
         if use_aug:
             keys = jax.vmap(
-                lambda k: drng.fold(jax.random.key(cfg.seed + 2), state.step, k)
+                lambda k: drng.fold(jax.random.key(cfg.seed + 2),
+                                    state.step, k)
             )(jnp.arange(n))
             x = jax.vmap(augment_mod.augment_batch)(x, keys)
         dkeys = jax.vmap(
@@ -253,24 +265,29 @@ def build_train_setup(cfg: TrainConfig, mesh, dataset_name: Optional[str] = None
             # x, y: (n, B, ...) sharded over w; aug key per (step, worker)
             if use_aug:
                 keys = jax.vmap(
-                    lambda i: drng.fold(jax.random.key(cfg.seed + 2), state.step, i)
+                    lambda i: drng.fold(jax.random.key(cfg.seed + 2),
+                                        state.step, i)
                 )(jnp.arange(n))
                 x = jax.vmap(augment_mod.augment_batch)(x, keys)
             dkeys = jax.vmap(
-                lambda i: drng.fold(jax.random.key(cfg.seed + 3), state.step, i)
+                lambda i: drng.fold(jax.random.key(cfg.seed + 3),
+                                    state.step, i)
             )(jnp.arange(n))
-            grads, new_stats, losses, precs = jax.vmap(lane, in_axes=(None, 0, 0, 0, 0))(
+            grads, new_stats, losses, precs = jax.vmap(
+                lane, in_axes=(None, 0, 0, 0, 0))(
                 state.params, state.batch_stats, x, y, dkeys
             )
             grads = jax.lax.with_sharding_constraint(grads, shard_w)
             grads = faults_mod.corrupt_grads(grads, cfg, state.step)
-            grads = attacks.inject_plain(grads, adv_mask, cfg.err_mode, adv_mag,
+            grads = attacks.inject_plain(grads, adv_mask, cfg.err_mode,
+                                         adv_mag,
                                          n_mal=cfg.num_adversaries,
                                          step=state.step, seed=cfg.seed)
             with jax.named_scope("draco_decode"):
                 agg = aggregation.aggregate(grads, cfg.mode,
                                             s=cfg.worker_fail,
-                                            geomedian_iters=cfg.geomedian_iters,
+                                            geomedian_iters=(
+                                                cfg.geomedian_iters),
                                             present=present)
             new_state = apply_update(state, agg, new_stats)
             out = _metrics(losses, precs, present)
@@ -291,18 +308,22 @@ def build_train_setup(cfg: TrainConfig, mesh, dataset_name: Optional[str] = None
             # bitwise identical within a group — the vote's soundness condition
             if use_aug:
                 keys = jax.vmap(
-                    lambda gid: drng.fold(jax.random.key(cfg.seed + 2), state.step, gid)
+                    lambda gid: drng.fold(jax.random.key(cfg.seed + 2),
+                                          state.step, gid)
                 )(group_ids)
                 x = jax.vmap(augment_mod.augment_batch)(x, keys)
             dkeys = jax.vmap(
-                lambda gid: drng.fold(jax.random.key(cfg.seed + 3), state.step, gid)
+                lambda gid: drng.fold(jax.random.key(cfg.seed + 3),
+                                      state.step, gid)
             )(group_ids)
-            grads, new_stats, losses, precs = jax.vmap(lane, in_axes=(None, 0, 0, 0, 0))(
+            grads, new_stats, losses, precs = jax.vmap(
+                lane, in_axes=(None, 0, 0, 0, 0))(
                 state.params, state.batch_stats, x, y, dkeys
             )
             grads = jax.lax.with_sharding_constraint(grads, shard_w)
             grads = faults_mod.corrupt_grads(grads, cfg, state.step)
-            grads = attacks.inject_plain(grads, adv_mask, cfg.err_mode, adv_mag,
+            grads = attacks.inject_plain(grads, adv_mask, cfg.err_mode,
+                                         adv_mag,
                                          n_mal=cfg.num_adversaries,
                                          step=state.step, seed=cfg.seed)
             # per-step fingerprint salt, identical on every device (folded
@@ -466,7 +487,8 @@ def build_train_setup(cfg: TrainConfig, mesh, dataset_name: Optional[str] = None
                 # worker's BN stats replicated over its hat_s lanes
                 stats_w = (
                     jax.tree.map(
-                        lambda t: jnp.broadcast_to(t[:, None], (n, hat_s) + t.shape[1:]),
+                        lambda t: jnp.broadcast_to(
+                            t[:, None], (n, hat_s) + t.shape[1:]),
                         state.batch_stats,
                     )
                     if has_bn
@@ -480,7 +502,7 @@ def build_train_setup(cfg: TrainConfig, mesh, dataset_name: Optional[str] = None
                     stats_w, xw, yw, kw
                 )  # grads: (n, hat_s, d)
                 grads = jax.lax.with_sharding_constraint(
-                    grads, NamedSharding(mesh, P(WORKER_AXIS, None, None))
+                    grads, sharding(mesh, WORKER_ROWS3)
                 )
                 grads = faults_mod.corrupt_grads(grads, cfg, state.step)
                 # ingest-row forensics: any non-finite value in worker i's
@@ -504,7 +526,8 @@ def build_train_setup(cfg: TrainConfig, mesh, dataset_name: Optional[str] = None
             (enc_re, enc_im, new_stats, losses, precs, bad_rows,
              grad_watch) = compute_encoded(state, x, y)
             with jax.named_scope("draco_encode"):
-                enc_re, enc_im = attacks.inject_cyclic(enc_re, enc_im, adv_mask,
+                enc_re, enc_im = attacks.inject_cyclic(
+                    enc_re, enc_im, adv_mask,
                                                        cfg.err_mode, adv_mag,
                                                        step=state.step,
                                                        seed=cfg.seed)
@@ -648,7 +671,8 @@ def build_train_setup(cfg: TrainConfig, mesh, dataset_name: Optional[str] = None
         means): the trainer pads the final ragged batch up to the compiled
         shape and divides the summed counts by the true test-set size, so no
         tail sample is dropped and every batch weighs by its real length
-        (reference evaluates the full split, distributed_evaluator.py:92-110)."""
+        (reference evaluates the full split,
+        distributed_evaluator.py:92-110)."""
         vs = {"params": state.params}
         if has_bn:
             # evaluate with worker-0's running stats (reference evaluates a
@@ -656,8 +680,10 @@ def build_train_setup(cfg: TrainConfig, mesh, dataset_name: Optional[str] = None
             vs["batch_stats"] = jax.tree.map(lambda t: t[0], state.batch_stats)
         logits = model.apply(vs, x, train=False)
         ok1 = (jnp.argmax(logits, -1) == y) & valid
-        ok5 = jnp.any(jax.lax.top_k(logits, 5)[1] == y[:, None], axis=1) & valid
-        return jnp.sum(ok1.astype(jnp.float32)), jnp.sum(ok5.astype(jnp.float32))
+        ok5 = jnp.any(jax.lax.top_k(logits, 5)[1] == y[:, None],
+                      axis=1) & valid
+        return (jnp.sum(ok1.astype(jnp.float32)),
+                jnp.sum(ok5.astype(jnp.float32)))
 
     # ---- K fused steps in one device program ------------------------------
     # The reference pays its PS round trip once per step; the timing harness
@@ -731,6 +757,7 @@ def lint_programs():
     from draco_tpu.analysis.registry import (
         BF16_DTYPES, DEFAULT_DTYPES, BuiltProgram, LintProgram, Manifest,
     )
+    from draco_tpu.parallel.partition import CNN_STEP_RULES
 
     def _cfg(**overrides):
         kw = dict(
@@ -755,7 +782,7 @@ def lint_programs():
         # sites; those programs carry bf16 element types by design
         # (ISSUES 10/15). ``require``: the narrow-wire manifests PIN their
         # wire dtype in the module (rules.rule_dtype required_dtypes)
-        manifest = Manifest(collectives={},
+        manifest = Manifest(collectives={}, collective_axes={},
                             allowed_dtypes=(BF16_DTYPES if bf16
                                             else DEFAULT_DTYPES),
                             required_dtypes=frozenset(require))
@@ -766,11 +793,15 @@ def lint_programs():
                     jnp.zeros((k, n, b), jnp.int32),
                     jnp.asarray(np.asarray(adv[1:k + 1])), None)
             return BuiltProgram(name, setup.train_many, args, mesh, manifest,
-                                extra=extra)
+                                extra=extra,
+                                partition_rules=CNN_STEP_RULES,
+                                arg_names=("state", "x", "y", "adv_mask",
+                                           "present"))
         args = (setup.state, jnp.zeros((n, b) + shape, jnp.float32),
                 jnp.zeros((n, b), jnp.int32), jnp.asarray(np.asarray(adv[1])))
         return BuiltProgram(name, setup.train_step, args, mesh, manifest,
-                            extra=extra)
+                            extra=extra, partition_rules=CNN_STEP_RULES,
+                            arg_names=("state", "x", "y", "adv_mask"))
 
     mk = lambda name, fast=True, **kw: LintProgram(  # noqa: E731
         name=name, route="cnn", fast=fast,

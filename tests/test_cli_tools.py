@@ -1398,3 +1398,151 @@ def test_device_profile_check_gates_on_pallas_claim(tmp_path, capsys):
     bad.write_text(json.dumps(bad_data))
     assert device_profile.main(["--check", "--artifact", str(bad)]) == 1
     assert pal in capsys.readouterr().out
+
+
+def test_perf_watch_gates_on_flipped_sharding_axis_ledger(tmp_path):
+    """The sharding auditor's per-axis collective ledger (lint rule 8,
+    ISSUE 18) folds as ``lint.<program>.coll.<axis>.{ops,bytes}`` and is
+    PINNED at tolerance 0 in BOTH directions: an all-reduce moving to a
+    different mesh axis — or vanishing, the 'good' direction for a
+    lower-better kind — is a topology change, never an improvement."""
+    import json
+
+    from tools import perf_watch
+
+    root = tmp_path
+    (root / "baselines_out").mkdir()
+
+    def lint(sp_ops=7, sp_bytes=500745, w_bytes=1024):
+        rules = {
+            "constant_bloat": {"ok": True, "module_bytes": 1000},
+            "memory_budget": {"ok": True, "flops": 1e6,
+                              "memory": {"peak_bytes": 5000}},
+            "collective_axes": {
+                "ok": True,
+                "axis_ledger": {"sp": {"ops": sp_ops, "bytes": sp_bytes},
+                                "w": {"ops": 2, "bytes": w_bytes}}},
+        }
+        return {"all_ok": True, "rows": [
+            {"name": "lm_sp_ring_step", "ok": True, "rules": rules},
+            {"name": "control_wrong_axis_psum", "ok": True,
+             "control": True, "expected_fail": "collective_axes",
+             "rules": {}},
+        ]}
+
+    path = root / "baselines_out" / "program_lint.json"
+    path.write_text(json.dumps(lint()))
+    assert perf_watch.main(["--root", str(root), "--snapshot"]) == 0
+    snap = json.loads(
+        (root / "baselines_out" / "perf_watch.json").read_text())
+    for key in ("lint.lm_sp_ring_step.coll.sp.ops",
+                "lint.lm_sp_ring_step.coll.sp.bytes",
+                "lint.lm_sp_ring_step.coll.w.bytes"):
+        assert key in snap["metrics"], key
+        assert snap["metrics"][key]["kind"] == "pinned", key
+    # control rows never fold ledger metrics
+    assert "lint.control_wrong_axis_psum.coll" not in str(snap)
+    assert perf_watch.main(["--root", str(root)]) == 0  # clean
+
+    out = root / "report.json"
+    # bytes growing on an axis gates...
+    path.write_text(json.dumps(lint(sp_bytes=600000)))
+    assert perf_watch.main(["--root", str(root), "--json", str(out)]) == 1
+    regs = {r["metric"] for r in json.loads(out.read_text())["regressions"]}
+    assert "lint.lm_sp_ring_step.coll.sp.bytes" in regs
+
+    # ...and an op VANISHING from an axis (7 -> 6, the 'good' direction)
+    # gates identically: the ledger is pinned, not scored
+    path.write_text(json.dumps(lint(sp_ops=6)))
+    assert perf_watch.main(["--root", str(root), "--json", str(out)]) == 1
+    regs = {r["metric"] for r in json.loads(out.read_text())["regressions"]}
+    assert "lint.lm_sp_ring_step.coll.sp.ops" in regs
+
+    # w-axis bytes shrinking gates too (both-direction on a second axis)
+    path.write_text(json.dumps(lint(w_bytes=512)))
+    assert perf_watch.main(["--root", str(root), "--json", str(out)]) == 1
+    regs = {r["metric"] for r in json.loads(out.read_text())["regressions"]}
+    assert "lint.lm_sp_ring_step.coll.w.bytes" in regs
+
+
+def test_check_artifacts_sharding_audit_and_lint_config(tmp_path):
+    """check_artifacts' ISSUE 18 checks (jax-free): a stale six-rule
+    artifact, a program row missing a rule-7 verdict, and a blunted
+    negative control each fail 'sharding audit coverage' with the first
+    failure named; a repo root without a lint config fails 'lint config
+    present'."""
+    import json
+
+    from tools.check_artifacts import (
+        _check_lint_config, _check_sharding_audit,
+    )
+
+    root = tmp_path
+    (root / "baselines_out").mkdir()
+    path = root / "baselines_out" / "program_lint.json"
+
+    def artifact():
+        rules7_9 = {"sharding_contract": {"ok": True},
+                    "collective_axes": {"ok": True},
+                    "replication_leaks": {"ok": True}}
+        controls = [
+            {"name": n, "control": True, "ok": True, "expected_fail": f}
+            for n, f in (
+                ("control_resharded_carry", "sharding_contract"),
+                ("control_unnormalized_spec", "sharding_contract"),
+                ("control_unmatched_param", "sharding_contract"),
+                ("control_wrong_axis_psum", "collective_axes"),
+                ("control_replicated_wire", "replication_leaks"),
+            )]
+        return {"all_ok": True,
+                "rules": ["sharding_contract", "collective_axes",
+                          "replication_leaks"],
+                "rows": [{"name": "p1", "ok": True,
+                          "rules": dict(rules7_9)}] + controls}
+
+    path.write_text(json.dumps(artifact()))
+    assert _check_sharding_audit(str(root)) is None
+
+    # stale rule list (regenerated from a six-rule checkout)
+    art = artifact()
+    art["rules"] = ["constant_bloat"]
+    path.write_text(json.dumps(art))
+    assert "regenerate" in _check_sharding_audit(str(root))
+
+    # a program row without the rule-9 verdict
+    art = artifact()
+    del art["rows"][0]["rules"]["replication_leaks"]
+    path.write_text(json.dumps(art))
+    err = _check_sharding_audit(str(root))
+    assert "p1" in err and "replication_leaks" in err
+
+    # a red verdict on a program row names the rule
+    art = artifact()
+    art["rows"][0]["rules"]["collective_axes"] = {
+        "ok": False, "error": "psum over 'w' not in the manifest"}
+    path.write_text(json.dumps(art))
+    err = _check_sharding_audit(str(root))
+    assert "p1" in err and "collective_axes" in err
+
+    # a live control silently going green (blunted defect) fails
+    art = artifact()
+    ctrl = next(r for r in art["rows"]
+                if r["name"] == "control_replicated_wire")
+    ctrl["ok"] = False
+    path.write_text(json.dumps(art))
+    assert "control_replicated_wire" in _check_sharding_audit(str(root))
+
+    # ...and a missing control fails by name
+    art = artifact()
+    art["rows"] = [r for r in art["rows"]
+                   if r["name"] != "control_wrong_axis_psum"]
+    path.write_text(json.dumps(art))
+    assert "control_wrong_axis_psum" in _check_sharding_audit(str(root))
+
+    # lint config: absent fails; present-with-line-length passes; a
+    # config that pins no line budget fails
+    assert "no ruff.toml" in _check_lint_config(str(root))
+    (root / "ruff.toml").write_text("line-length = 79\n")
+    assert _check_lint_config(str(root)) is None
+    (root / "ruff.toml").write_text("[lint]\nselect = ['E']\n")
+    assert "line-length" in _check_lint_config(str(root))

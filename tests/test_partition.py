@@ -1,0 +1,200 @@
+"""Partition-rule table unit tests (the static sharding auditor's base
+layer, draco_tpu/parallel/partition.py): the canonical normalizer is
+idempotent and strips exactly trailing Nones (the PR 6 retrace bug's
+fix, now deduped), the regex matcher is first-match-wins with scalar
+short-circuit and raise-on-uncovered, and every committed route table is
+DISJOINT and normalized — the properties lint rule 7 (sharding_contract)
+leans on for its exactly-one-match check."""
+
+import re
+
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from draco_tpu.parallel.partition import (
+    CNN_STEP_RULES,
+    EP_STEP_RULES,
+    PP_STEP_RULES,
+    REPLICATED,
+    SEQ_TOKENS,
+    SP_STEP_RULES,
+    TP_STEP_RULES,
+    WORKER_ROWS,
+    match_partition_rules,
+    match_report,
+    norm_spec,
+    override,
+    spec_axes,
+    tree_combine_rules,
+    tree_rows,
+)
+
+pytestmark = pytest.mark.core
+
+
+class TestNormSpec:
+    def test_strips_trailing_nones(self):
+        assert norm_spec(P("tp", None)) == P("tp")
+        assert norm_spec(P("tp", None, None)) == P("tp")
+        assert norm_spec(P(None, "tp", None)) == P(None, "tp")
+
+    def test_none_and_empty_normalize_to_p(self):
+        assert norm_spec(None) == P()
+        assert norm_spec(P()) == P()
+        assert norm_spec(P(None, None)) == P()
+
+    def test_interior_nones_survive(self):
+        # P(None, 'tp') is already XLA-normal: dim 0 replicated, dim 1
+        # sharded — stripping it would change meaning
+        assert norm_spec(P(None, "tp")) == P(None, "tp")
+
+    def test_idempotent(self):
+        for spec in (None, P(), P("w"), P("w", None), P(None, "tp"),
+                     P(("tl2", "tl1")), SEQ_TOKENS):
+            once = norm_spec(spec)
+            assert norm_spec(once) == once
+
+    def test_committed_tables_declare_normalized_specs(self):
+        # rule 7 rejects unnormalized table specs; the committed tables
+        # must never trip their own auditor
+        for table in (CNN_STEP_RULES, SP_STEP_RULES, TP_STEP_RULES,
+                      EP_STEP_RULES, PP_STEP_RULES,
+                      tree_combine_rules(("tl1", "tl2"))):
+            for pat, spec in table:
+                assert spec == norm_spec(spec), (pat, spec)
+
+
+class TestSpecAxes:
+    def test_flattens_tuple_entries(self):
+        assert spec_axes(P(("tl2", "tl1"))) == {"tl2", "tl1"}
+        assert spec_axes(P("w", None, "sp")) == {"w", "sp"}
+        assert spec_axes(P()) == frozenset()
+        assert spec_axes(None) == frozenset()
+
+
+class TestMatcher:
+    RULES = (
+        (r"^state/.*qkv/kernel$", P(None, "tp")),
+        (r"^state/", REPLICATED),
+        (r"^tokens$", WORKER_ROWS),
+    )
+
+    def test_first_match_wins(self):
+        tree = {"state": {"qkv": {"kernel": np.zeros((4, 4))},
+                          "bias": np.zeros(4)}}
+        specs = match_partition_rules(self.RULES, tree)
+        assert specs["state"]["qkv"]["kernel"] == P(None, "tp")
+        assert specs["state"]["bias"] == REPLICATED
+
+    def test_scalars_bypass_the_table(self):
+        # scalar and size-1 leaves are replicated by construction — they
+        # map to P() even when no rule covers their path
+        tree = {"uncovered_scalar": np.float32(3.0),
+                "size_one": np.zeros((1, 1)),
+                "tokens": np.zeros((8, 2), np.int32)}
+        specs = match_partition_rules(self.RULES, tree)
+        assert specs["uncovered_scalar"] == P()
+        assert specs["size_one"] == P()
+        assert specs["tokens"] == WORKER_ROWS
+
+    def test_unmatched_array_leaf_raises(self):
+        with pytest.raises(ValueError, match="mystery"):
+            match_partition_rules(self.RULES, {"mystery": np.zeros(8)})
+
+    def test_prefix_joins_paths(self):
+        specs = match_partition_rules(
+            self.RULES, {"qkv": {"kernel": np.zeros((4, 4))}},
+            prefix="state")
+        assert specs["qkv"]["kernel"] == P(None, "tp")
+
+    def test_match_report_counts_and_normalization(self):
+        rules = (
+            (r"^a$", P("w")),
+            (r"a", REPLICATED),           # overlaps ^a$ -> n_matches 2
+            (r"^b$", P("tp", None)),      # unnormalized on purpose
+        )
+        rows = {r["path"]: r for r in match_report(
+            rules, [("a", np.zeros(4)), ("b", np.zeros(4)),
+                    ("c", np.zeros(4)), ("s", np.float32(0))])}
+        assert rows["a"]["n_matches"] == 2
+        assert rows["a"]["spec"] == str(P("w"))  # first match reported
+        assert rows["b"]["normalized"] is False
+        assert rows["c"]["n_matches"] == 0 and rows["c"]["spec"] is None
+        assert "s" not in rows  # scalars excluded
+
+
+class TestOverride:
+    def test_override_drops_the_original_row(self):
+        new = override(SP_STEP_RULES, (r"^tokens$", REPLICATED))
+        assert sum(1 for p, _ in new if p == r"^tokens$") == 1
+        assert dict(new)[r"^tokens$"] == REPLICATED
+        # untouched rows survive in order
+        assert dict(new)[r"^adv_mask$"] == WORKER_ROWS
+
+
+ROUTE_PATHS = {
+    "cnn": (CNN_STEP_RULES,
+            ["state/params/conv1/kernel", "state/step",
+             "state/opt_state/0/momentum_buf/conv1/kernel",
+             "state/batch_stats/bn1/mean", "x", "y", "adv_mask"]),
+    "sp": (SP_STEP_RULES,
+           ["state/params/block0/qkv/kernel",
+            "state/opt_state/0/momentum_buf/block0/qkv/kernel",
+            "tokens", "adv_mask"]),
+    "tp": (TP_STEP_RULES,
+           ["state/params/block0/qkv/kernel",
+            "state/params/block0/proj/kernel",
+            "state/params/block0/mlp_in/kernel",
+            "state/params/block0/mlp_in/bias",
+            "state/params/block0/mlp_out/kernel",
+            "state/params/block0/mlp_out/bias",
+            "state/params/embed/embedding",
+            "state/opt_state/0/momentum_buf/block0/qkv/kernel",
+            "tokens", "adv_mask"]),
+    "ep": (EP_STEP_RULES,
+           ["state/params/block0/moe/w1",
+            "state/params/block0/moe/b2",
+            "state/params/block0/moe/router/kernel",
+            "state/opt_state/0/momentum_buf/block0/moe/w1",
+            "tokens", "adv_mask"]),
+    "pp": (PP_STEP_RULES,
+           ["state/params/blocks/loop/b/attn/qkv/kernel",
+            "state/params/embed/embedding",
+            "state/opt_state/0/momentum_buf/blocks/loop/b/attn/qkv/kernel",
+            "tokens", "adv_mask"]),
+    "tree": (tree_combine_rules(("tl1", "tl2")),
+             ["r_re", "r_im", "rand_factor", "present"]),
+}
+
+
+@pytest.mark.parametrize("route", sorted(ROUTE_PATHS))
+def test_route_tables_are_disjoint_on_representative_paths(route):
+    """Exactly-one-match is rule 7's coverage invariant: the negative
+    lookaheads keep each table's rows DISJOINT, so a leaf's spec never
+    depends on table order."""
+    rules, paths = ROUTE_PATHS[route]
+    for path in paths:
+        n = sum(1 for pat, _ in rules if re.search(pat, path))
+        assert n == 1, (route, path, n)
+
+
+def test_tp_table_matches_megatron_layout():
+    specs = dict(
+        (p, next(s for pat, s in TP_STEP_RULES if re.search(pat, p)))
+        for p in ROUTE_PATHS["tp"][1])
+    assert specs["state/params/block0/qkv/kernel"] == P(None, "tp")
+    assert specs["state/params/block0/proj/kernel"] == P("tp")
+    assert specs["state/params/block0/mlp_in/bias"] == P("tp")
+    assert specs["state/params/block0/mlp_out/bias"] == REPLICATED
+    assert specs["state/params/embed/embedding"] == REPLICATED
+    # momentum slots inherit the layout (prefix-insensitive patterns)
+    assert specs["state/opt_state/0/momentum_buf/block0/qkv/kernel"] \
+        == P(None, "tp")
+
+
+def test_tree_rows_reverses_level_axes():
+    # C-order folding: dim 0 over the REVERSED level axes so leaf group j
+    # lands at grid multi-index unravel(j) (coding/topology.tree_mesh)
+    assert tree_rows(("tl1", "tl2")) == P(("tl2", "tl1"))
+    assert spec_axes(tree_rows(("tl1", "tl2"))) == {"tl1", "tl2"}

@@ -93,7 +93,7 @@ def test_registry_covers_every_route():
 @pytest.mark.core
 def test_fast_subset_all_green(tmp_path):
     """The core-tier wiring of ``tools/program_lint.py --fast``: every fast
-    registered program passes all five rules, through the CLI's own main()
+    registered program passes all nine rules, through the CLI's own main()
     (controls skipped here — they have their own test above). Runtime is
     the bulk of this module's core budget: ~60 s on the 1-core CI host
     (PERF.md §6)."""

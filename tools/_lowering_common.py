@@ -55,20 +55,22 @@ def run_rows(out_path: str, method: str, named_rows, extra=None):
     return report
 
 
-def lint_row(program, extra_row=None):
-    """Run the six program-lint rules on a registered
+def lint_row(program, extra_row=None, only=None):
+    """Run the program-lint rules on a registered
     :class:`draco_tpu.analysis.LintProgram` and shape the result as a
     run_rows row: ``ok`` is the lint verdict, ``failed_rules``/``rules``
-    carry the per-rule detail. The three lowering-check tools build their
-    rows through this helper so a chip-scale audit row always carries the
-    same verdict fields as the CI artifact (baselines_out/program_lint.json)."""
+    carry the per-rule detail. ``only`` restricts to a subset of rule
+    names (tools/program_lint.py --only). The three lowering-check tools
+    build their rows through this helper so a chip-scale audit row always
+    carries the same verdict fields as the CI artifact
+    (baselines_out/program_lint.json)."""
     import time
 
     from draco_tpu.analysis import lint_program
 
     t0 = time.time()
     try:
-        row = lint_program(program)
+        row = lint_program(program, only=only)
     except Exception as e:  # build/trace crash: report as a failed row
         return {"ok": False, "seconds": round(time.time() - t0, 1),
                 "error": f"{type(e).__name__}: {str(e)[:400]}",

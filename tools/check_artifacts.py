@@ -29,6 +29,13 @@ exits nonzero NAMING THE FIRST FAILURE:
                       cells, tree crossover columns self-consistent
                       (ISSUE 17)
   program_lint        committed all_ok roll-up
+  sharding audit      every non-control lint row carries ok verdicts for
+                      sharding_contract / collective_axes /
+                      replication_leaks and the auditor's five live
+                      controls are present and tripped (ISSUE 18)
+  lint config         ruff.toml / pyproject.toml exists and pins the
+                      repo's line-length (declarative; no ruff binary in
+                      the image)
   chaos_matrix        committed all_ok roll-up
   straggler_study     committed all_ok roll-up
   chaos incident      every committed chaos cell carries an ``incident``
@@ -278,6 +285,78 @@ def _check_autopilot_study(root):
     return None
 
 
+def _check_sharding_audit(root):
+    """The static sharding audit (rules 7-9) must actually be IN the
+    committed lint artifact: every non-control program row carries
+    sharding_contract / collective_axes / replication_leaks verdicts with
+    ok true, and the auditor's live negative controls are present and
+    tripped. An artifact regenerated from a stale checkout (six-rule
+    linter) or with blunted controls fails here, jax-free."""
+    path = os.path.join(root, "baselines_out", "program_lint.json")
+    try:
+        with open(path) as fh:
+            data = json.load(fh)
+    except (OSError, ValueError) as e:
+        return f"cannot read program_lint.json: {e}"
+    new_rules = ("sharding_contract", "collective_axes",
+                 "replication_leaks")
+    missing = [r for r in new_rules if r not in (data.get("rules") or [])]
+    if missing:
+        return (f"artifact rule list lacks {missing} — regenerate with "
+                f"tools/program_lint.py")
+    controls = {}
+    for row in data.get("rows") or []:
+        name = row.get("name")
+        if row.get("control"):
+            controls[name] = row
+            continue
+        rules = row.get("rules") or {}
+        for rn in new_rules:
+            verdict = rules.get(rn)
+            if not isinstance(verdict, dict):
+                return (f"program row {name!r} carries no {rn} verdict — "
+                        f"stale artifact, regenerate")
+            if not verdict.get("ok"):
+                return (f"program row {name!r} fails {rn}: "
+                        f"{verdict.get('error', verdict)}")
+    expected_controls = {
+        "control_resharded_carry": "sharding_contract",
+        "control_unnormalized_spec": "sharding_contract",
+        "control_unmatched_param": "sharding_contract",
+        "control_wrong_axis_psum": "collective_axes",
+        "control_replicated_wire": "replication_leaks",
+    }
+    for cname, rule in expected_controls.items():
+        row = controls.get(cname)
+        if row is None:
+            return (f"sharding-audit control {cname!r} missing from the "
+                    f"artifact")
+        if row.get("expected_fail") != rule or not row.get("ok"):
+            return (f"control {cname!r} must trip exactly [{rule}] "
+                    f"(expected_fail={row.get('expected_fail')}, "
+                    f"ok={row.get('ok')})")
+    return None
+
+
+def _check_lint_config(root):
+    """Satellite of the static-auditor PR: the repo-wide lint config must
+    exist and pin the 79-column limit the codebase is written to (a text
+    presence check — the image has no ruff binary and py3.10 has no
+    tomllib, so this is deliberately declarative)."""
+    for rel in ("ruff.toml", "pyproject.toml"):
+        path = os.path.join(root, rel)
+        if os.path.exists(path):
+            try:
+                with open(path) as fh:
+                    text = fh.read()
+            except OSError as e:
+                return f"cannot read {rel}: {e}"
+            if "line-length" not in text:
+                return f"{rel} exists but pins no line-length"
+            return None
+    return "no ruff.toml / pyproject.toml lint config at the repo root"
+
+
 def _check_tree_study(root):
     from tools import tree_study
 
@@ -304,6 +383,8 @@ CHECKS = (
     ("decode_study --check", _check_decode_study),
     ("program_lint all_ok",
      _flag_check(os.path.join("baselines_out", "program_lint.json"))),
+    ("sharding audit coverage", _check_sharding_audit),
+    ("lint config present", _check_lint_config),
     ("chaos_matrix all_ok",
      _flag_check(os.path.join("baselines_out", "chaos_matrix.json"))),
     ("chaos incident coverage", _check_chaos_incidents),
@@ -333,7 +414,7 @@ def main(argv=None) -> int:
                 with contextlib.redirect_stdout(buf), \
                         contextlib.redirect_stderr(buf):
                     err = check(root)
-        except Exception as e:  # noqa: BLE001 — naming the failure IS the job
+        except Exception as e:  # noqa: BLE001 — naming failures IS the job
             err = f"{type(e).__name__}: {e}"
         if err is not None:
             sub = buf.getvalue().strip()

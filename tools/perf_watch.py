@@ -10,7 +10,7 @@ point — jax-free (pure artifact folding, runs on a laptop against scp'd
 files), so it can gate a round without touching a backend:
 
   python tools/perf_watch.py --snapshot        # (re)write the baseline
-                                               #   baselines_out/perf_watch.json
+                                               #  baselines_out/perf_watch.json
   python tools/perf_watch.py                   # diff current artifacts vs
                                                #   baseline; exit 1 on any
                                                #   out-of-tolerance regression
@@ -30,8 +30,11 @@ Folded sources (all optional — a missing artifact folds nothing):
                                 (compile_ms / timed-run builds per K)
   baselines_out/program_lint.json
                                 per-program module bytes (constant_bloat
-                                rule) and the memory/cost ledger columns
+                                rule), the memory/cost ledger columns
                                 (memory_budget rule: peak_bytes, flops)
+                                and the per-axis collective wire ledger
+                                (collective_axes rule: ops/bytes per mesh
+                                axis, pinned at tolerance 0)
   baselines_out/chaos_matrix.json
                                 the resilience fault × loop matrix
                                 (tools/chaos_run.py): per-cell ok flags —
@@ -285,6 +288,16 @@ def fold_program_lint(root: str, metrics: dict) -> None:
         if isinstance(flops, (int, float)):
             metrics[f"lint.{name}.flops"] = {
                 "value": float(flops), "kind": "flops", "source": src}
+        # the per-axis wire ledger (sharding auditor, rule 8): ops and
+        # bytes per mesh axis are structural — ANY drift is a topology
+        # change, so they ride pinned (tol 0) in both directions
+        ledger = (rules.get("collective_axes") or {}).get("axis_ledger")
+        for axis, led in sorted((ledger or {}).items()):
+            for col in ("ops", "bytes"):
+                if isinstance(led.get(col), (int, float)):
+                    metrics[f"lint.{name}.coll.{axis}.{col}"] = {
+                        "value": float(led[col]), "kind": "pinned",
+                        "source": src}
 
 
 def fold_chaos(root: str, metrics: dict) -> None:
