@@ -109,6 +109,10 @@ def add_fit_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                         "(their reference XLA lowering off-TPU)")
     p.add_argument("--eval-freq", type=int, default=50)
     p.add_argument("--train-dir", type=str, default="./train_out/")
+    p.add_argument("--job-name", type=str, default="",
+                   help="operator-facing job label stamped into "
+                        "status.json (schema 5) — the fleet observatory "
+                        "(tools/fleet_report.py) labels runs by it")
     p.add_argument("--checkpoint-step", type=int, default=0)
     p.add_argument("--compress-ckpt", action="store_true",
                    help="write compressed .dcg checkpoints (the reference's "
@@ -441,6 +445,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         remat=args.remat,
         eval_freq=args.eval_freq,
         train_dir=args.train_dir,
+        job_name=args.job_name,
         checkpoint_step=args.checkpoint_step,
         compress_ckpt=args.compress_ckpt,
         seed=args.seed,

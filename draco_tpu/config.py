@@ -166,6 +166,10 @@ class TrainConfig:
     # --- eval / checkpoint (reference: distributed_nn.py:56-75) ---
     eval_freq: int = 50
     train_dir: str = "./train_out/"
+    # operator-facing job label stamped into status.json (STATUS_SCHEMA
+    # 5, obs/heartbeat.py) — purely observational: the fleet registry
+    # (obs/fleet.py) groups/labels runs by it. "" omits the field.
+    job_name: str = ""
     # resume from this step if >0; -1 resumes from the NEWEST loadable
     # checkpoint in train_dir (corrupt ones are skipped — the automatic
     # walk-back of resilience/supervisor.restore_with_walkback)

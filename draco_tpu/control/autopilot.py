@@ -53,6 +53,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
+import time
 from typing import Dict, Optional
 
 # boundary-hysteresis policy knobs; every key overridable per run via
@@ -664,6 +665,11 @@ class Autopilot:
                    evidence=None, regime_from=None) -> None:
         rem = {
             "action": action, "step": int(step),
+            # wall-clock stamp (ISSUE 19): MTTR = remediation ts − onset
+            # ts, joined offline by obs/fleet — stamped here too so the
+            # ``control`` status block's ``last`` carries it even though
+            # the incidents stream stamps its own copy per line
+            "ts": time.time(),
             "effective_step": int(step) + 1,
             "worker": worker,
             "regime": regime.as_dict() if regime is not None else None,

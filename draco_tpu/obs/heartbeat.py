@@ -67,7 +67,14 @@ from draco_tpu.obs.forensics import AccusationLedger
 #      episodes, per-type totals, last onset — obs/incidents.py) on
 #      watch-enabled runs (``cfg.incident_watch="on"``), carried by the
 #      terminal crash/preempted write too.
-STATUS_SCHEMA = 4
+#   5: run identity (ISSUE 19): a ``run_id`` (stable per train_dir —
+#      re-read from the dir's existing status.json on construction so a
+#      resumed run keeps the id its first attempt minted) and an optional
+#      operator-facing ``job_name`` (cfg.job_name). Consumers tolerate
+#      both missing (pre-fleet runs); the fleet registry
+#      (obs/fleet.RunRegistry) uses run_id to fold a resumed run's
+#      attempts as ONE run.
+STATUS_SCHEMA = 5
 
 # The ONE schema contract table (ISSUE 13 satellite): optional status.json
 # block name -> the schema version that introduced it. Every jax-free
@@ -84,6 +91,10 @@ STATUS_BLOCKS = {
     # regime, swaps, quarantined workers, last remediation) is ADDITIVE
     # under schema 4: consumers tolerate it missing, assert when present
     "control": 4,
+    # run identity (ISSUE 19): both optional-on-read — every consumer
+    # tolerates their absence (pre-fleet files), asserts placement via
+    # this table when present
+    "run_id": 5, "job_name": 5,
 }
 KNOWN_STATUS_SCHEMAS = tuple(range(2, STATUS_SCHEMA + 1))
 
@@ -144,11 +155,18 @@ class RunHeartbeat:
     return immediately."""
 
     def __init__(self, train_dir: Optional[str], enabled: bool = True,
-                 num_workers: Optional[int] = None, incidents=None):
+                 num_workers: Optional[int] = None, incidents=None,
+                 job_name: Optional[str] = None):
         self.path = (os.path.join(train_dir, "status.json")
                      if (train_dir and enabled) else None)
         if self.path:
             os.makedirs(train_dir, exist_ok=True)
+        # run identity (ISSUE 19): stable per train_dir — a resume into
+        # the same dir re-reads the id the first attempt minted (torn or
+        # pre-fleet status files mint a fresh one); the fleet registry
+        # folds attempts sharing an id as ONE run
+        self.run_id = self._load_or_mint_run_id() if self.path else None
+        self.job_name = str(job_name) if job_name else None
         self._t0 = time.perf_counter()
         self._first_step: Optional[int] = None
         self._tp = 0.0
@@ -185,6 +203,22 @@ class RunHeartbeat:
         # incident engine (obs/incidents.py, ISSUE 13): rides the same
         # observer hook + the beat — zero extra fetches; None = watch off
         self.incidents = incidents if self.path else None
+
+    def _load_or_mint_run_id(self) -> str:
+        """Re-read the dir's existing run_id (resume keeps identity), else
+        mint a fresh one. Tolerates every partial state a killed run
+        leaves behind — identity must never take a run down."""
+        try:
+            with open(self.path) as fh:
+                prior = json.load(fh)
+            rid = prior.get("run_id") if isinstance(prior, dict) else None
+            if isinstance(rid, str) and rid:
+                return rid
+        except (OSError, ValueError):
+            pass
+        import uuid
+
+        return uuid.uuid4().hex[:12]
 
     # ---- accumulation ----------------------------------------------------
     def observe(self, record: dict) -> None:
@@ -334,6 +368,7 @@ class RunHeartbeat:
         payload = {
             "schema": STATUS_SCHEMA,
             "state": "running",
+            "run_id": self.run_id,
             "step": int(step),
             "total_steps": int(total_steps) if total_steps else None,
             "steps_per_s": round(rate, 4),
@@ -341,6 +376,8 @@ class RunHeartbeat:
                       if (total_steps and rate > 0) else None),
             "updated_at": time.time(),
         }
+        if self.job_name:
+            payload["job_name"] = self.job_name
         for k in ("loss", "prec1"):
             if k in self._last:
                 payload[k] = float(self._last[k])
@@ -399,6 +436,9 @@ class RunHeartbeat:
                    if k not in ("state", "cause", "resumable_step")}
         payload["schema"] = STATUS_SCHEMA  # present even with no prior beat
         payload["state"] = state
+        payload["run_id"] = self.run_id  # identity even with no prior beat
+        if self.job_name:
+            payload["job_name"] = self.job_name
         payload["updated_at"] = time.time()
         if self._device is not None:
             # a capture window that stops on the run's LAST work unit has

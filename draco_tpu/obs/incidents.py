@@ -638,7 +638,11 @@ class IncidentEngine:
             os.makedirs(os.path.dirname(self._out_path) or ".",
                         exist_ok=True)
             self._fh = open(self._out_path, "a")
-        line = {"v": INCIDENT_SCHEMA, "event": event, "seq": self._seq}
+        # wall-clock stamp (ISSUE 19): onset→remediation latency (MTTR)
+        # is only computable offline if every event carries real time —
+        # step indices alone cannot price a stalled run's response lag
+        line = {"v": INCIDENT_SCHEMA, "event": event, "seq": self._seq,
+                "ts": time.time()}
         self._seq += 1
         return line
 

@@ -23,7 +23,7 @@ from __future__ import annotations
 
 import json
 import os
-from typing import Iterator, List, Optional
+from typing import Iterator, List, NamedTuple, Optional
 
 
 def iter_jsonl(path: str) -> Iterator[dict]:
@@ -77,6 +77,33 @@ def metrics_path(path: str) -> str:
     if os.path.isdir(path):
         return os.path.join(path, "metrics.jsonl")
     return path
+
+
+class RunFiles(NamedTuple):
+    """The one run-dir layout contract (ISSUE 19 satellite): every
+    jax-free consumer that folds a run directory resolves its artifact
+    paths through :func:`find_run_files` instead of re-deriving the
+    joins inline — incident_report, forensics_report and the fleet
+    registry all read the same three files by construction. Any path
+    may point at a file that does not exist; existence is the READER's
+    concern (iter_jsonl tolerates absence)."""
+
+    root: str
+    status: str
+    metrics: str
+    incidents: str
+
+
+def find_run_files(path: str) -> RunFiles:
+    """Resolve a train_dir (or a direct metrics.jsonl path — the
+    historical CLI contract of the replay tools) to the run's artifact
+    paths. Never touches the filesystem beyond one ``isdir``."""
+    metrics = metrics_path(path)
+    root = path if os.path.isdir(path) else (os.path.dirname(path) or ".")
+    return RunFiles(root=root,
+                    status=os.path.join(root, "status.json"),
+                    metrics=metrics,
+                    incidents=os.path.join(root, "incidents.jsonl"))
 
 
 def infer_num_workers(records: List[dict], status_path: str,

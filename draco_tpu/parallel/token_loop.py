@@ -196,7 +196,8 @@ def run_token_loop(setup, cfg: TrainConfig, steps: Optional[int] = None,
     heartbeat = RunHeartbeat(cfg.train_dir or None, enabled=is_main,
                              num_workers=cfg.num_workers,
                              incidents=incidents_mod.make_engine(cfg,
-                                                                 is_main))
+                                                                 is_main),
+                             job_name=getattr(cfg, "job_name", "") or None)
     # static logical wire-bytes ledger (obs/numerics.wire_ledger, ISSUE
     # 10): the ``wire`` status block, from the route's flat-grad dimension
     from draco_tpu.obs import numerics as numerics_mod

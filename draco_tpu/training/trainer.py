@@ -85,7 +85,8 @@ class Trainer:
         self.heartbeat = RunHeartbeat(
             cfg.train_dir or None, enabled=self._is_main,
             num_workers=cfg.num_workers,
-            incidents=incidents_mod.make_engine(cfg, self._is_main))
+            incidents=incidents_mod.make_engine(cfg, self._is_main),
+            job_name=getattr(cfg, "job_name", "") or None)
         # static logical wire-bytes ledger (obs/numerics.wire_ledger,
         # ISSUE 10): the ``wire`` status block — derived from the program's
         # registered shapes, stamped once per run

@@ -227,7 +227,7 @@ def test_autopilot_lifecycle_cnn(tmp_path):
     # control block rides status.json (additive under schema 4) and the
     # run ends back in the base regime
     st = json.load(open(os.path.join(d, "status.json")))
-    assert st["state"] == "done" and st["schema"] == 4
+    assert st["state"] == "done" and st["schema"] == 5
     c = st["control"]
     assert c["autopilot"] == "on"
     assert c["regime"]["tag"] == "cyclic_r3" == c["base_regime"]

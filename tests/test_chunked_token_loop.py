@@ -200,7 +200,7 @@ def _assert_route_telemetry(route, kw, run_dir):
         fxb = status["forensics"]
         assert fxb["num_workers"] == n and fxb["accused_total"] > 0
         assert fxb["top_suspects"]
-        assert status["schema"] == 4
+        assert status["schema"] == 5
     elif kw.get("approach") == "approx":
         from draco_tpu.obs import forensics as fx
 
@@ -234,7 +234,7 @@ def _assert_route_telemetry(route, kw, run_dir):
         fxb = status["forensics"]
         assert fxb["accused_total"] == 0 and fxb["episodes_total"] == 0
         assert fxb["trust"] == [1.0] * n
-        assert status["schema"] == 4
+        assert status["schema"] == 5
     else:
         assert all("det_tp" not in r for r in train)
         assert all("wmask_accused0" not in r for r in train)

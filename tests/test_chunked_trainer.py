@@ -345,7 +345,7 @@ def _assert_telemetry_artifacts(run_dir, approach):
         assert fxb["accused_total"] == 0 and fxb["episodes_total"] == 0
         assert fxb["top_suspects"] == []
         assert fxb["trust"] == [1.0] * 8
-        assert status["schema"] == 4
+        assert status["schema"] == 5
     else:
         health = status["decode_health"]
         assert health["precision"] == 1.0 and health["recall"] == 1.0
@@ -357,7 +357,7 @@ def _assert_telemetry_artifacts(run_dir, approach):
         assert fxb["accused_total"] > 0 and fxb["episodes_total"] > 0
         assert fxb["top_suspects"] and all(
             t["trust"] < 1.0 for t in fxb["top_suspects"])
-        assert status["schema"] == 4
+        assert status["schema"] == 5
         # the folded numerics block (ISSUE 10): worst-case shadow error
         # bounded, flag agreement never dipped below 1.0
         nx = status["numerics"]

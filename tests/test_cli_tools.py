@@ -1546,3 +1546,178 @@ def test_check_artifacts_sharding_audit_and_lint_config(tmp_path):
     assert _check_lint_config(str(root)) is None
     (root / "ruff.toml").write_text("[lint]\nselect = ['E']\n")
     assert "line-length" in _check_lint_config(str(root))
+
+
+def test_fleet_report_and_study_check_on_committed_artifact(tmp_path):
+    """ISSUE 19: tools/fleet_report.py runs jax-free on a bare checkout
+    (empty root exits 0), and the committed fleet_slo.json passes its
+    own --check re-verification — the same gate check_artifacts runs."""
+    import json
+
+    from tools import fleet_report, fleet_study
+
+    empty = tmp_path / "none"
+    empty.mkdir()
+    assert fleet_report.main(["--runs-root", str(empty)]) == 0
+
+    payload = json.load(
+        open(os.path.join(REPO, "baselines_out", "fleet_slo.json")))
+    assert fleet_study.verify_payload(payload) == []
+    rows = payload["rows"]
+    assert len(rows) >= 6
+    assert {r["loop"] for r in rows} == {"cnn", "lm"}
+    kinds = {r["kind"] for r in rows}
+    assert {"clean", "adversary", "straggler", "autopilot"} <= kinds
+    assert all(r["budget_burned"] == 0.0 for r in rows)
+    for r in rows:
+        if r["kind"] in ("adversary", "autopilot"):
+            det = r["slo"]["detection_quality"]
+            assert det["precision"] == det["recall"] == 1.0
+            assert det["adv_total"] > 0  # live, not vacuous
+        if r["kind"] == "autopilot":
+            mttr = r["slo"]["incident_mttr"]
+            assert mttr["mttr_s"] is not None and mttr["mttr_s"] >= 0
+            assert mttr["unattributed"] == 0
+
+
+def test_fleet_study_check_gates_on_flipped_rows(tmp_path):
+    """The flipped-row controls: every certificate the committed fleet
+    artifact pins must FAIL verify_payload when hand-flipped — stale
+    status schema refused, budget burn, detection P/R, MTTR
+    attribution, and an ok bool disagreeing with its own row."""
+    import copy
+    import json
+
+    from tools import fleet_study
+
+    base = json.load(
+        open(os.path.join(REPO, "baselines_out", "fleet_slo.json")))
+
+    def flip(mut):
+        p = copy.deepcopy(base)
+        mut(p)
+        return "\n".join(fleet_study.verify_payload(p))
+
+    assert fleet_study.verify_payload(copy.deepcopy(base)) == []
+
+    def stale(p):
+        p["status_schema"] -= 1
+    assert "stale artifact" in flip(stale)
+
+    def burn(p):
+        p["rows"][0]["budget_burned"] = 2.0
+    assert "burned 2" in flip(burn)
+
+    def bad_precision(p):
+        row = next(r for r in p["rows"] if r["kind"] == "adversary")
+        row["slo"]["detection_quality"]["precision"] = 0.9
+    assert "P/R 0.9" in flip(bad_precision)
+
+    def vacuous(p):
+        row = next(r for r in p["rows"] if r["kind"] == "adversary")
+        row["slo"]["detection_quality"]["adv_total"] = 0
+    assert "vacuous" in flip(vacuous)
+
+    def unattributed(p):
+        row = next(r for r in p["rows"] if r["kind"] == "autopilot")
+        row["slo"]["incident_mttr"]["unattributed"] = 1
+    assert "unattributed" in flip(unattributed)
+
+    def ok_disagrees(p):
+        p["rows"][0]["ok"] = False
+    out = flip(ok_disagrees)
+    assert "disagrees" in out or "all_ok" in out
+
+    def crashed(p):
+        p["rows"][0]["state"] = "crashed"
+    assert "terminal state 'crashed'" in flip(crashed)
+
+    # ...and check_artifacts surfaces the same failure by check name
+    import io
+    from contextlib import redirect_stdout
+
+    from tools import check_artifacts
+
+    root = tmp_path / "root"
+    (root / "baselines_out").mkdir(parents=True)
+    stale_p = copy.deepcopy(base)
+    stale_p["status_schema"] -= 1
+    (root / "baselines_out" / "fleet_slo.json").write_text(
+        json.dumps(stale_p))
+    err = check_artifacts._check_fleet_slo(str(root))
+    assert err and "stale artifact" in err
+
+
+def test_perf_watch_gates_on_flipped_fleet_certificates(tmp_path):
+    """The fleet_slo gate at tolerance 0 in BOTH directions: an SLO
+    verdict flipping false, a clean cell starting to burn budget, and
+    the detection P/R certificate moving off 1.0 are regressions; a
+    burning row silently going quiet (the 'good' direction of a pinned
+    metric) must gate too, as must the cell count changing."""
+    import json
+
+    from tools import perf_watch
+
+    root = tmp_path
+    (root / "baselines_out").mkdir()
+
+    def artifact(ok=True, burned=0.0, precision=1.0, cells=2):
+        rows = [{
+            "cell": "cnn_adversary", "kind": "adversary",
+            "state": "done", "run_id": "rid1", "ok": ok,
+            "budget_burned": burned,
+            "slo": {
+                "detection_quality": {
+                    "evaluated": True, "ok": precision == 1.0,
+                    "verdict": "ok" if precision == 1.0 else "violated",
+                    "precision": precision, "recall": 1.0},
+                "incident_mttr": {
+                    "evaluated": True, "ok": True, "verdict": "ok",
+                    "mttr_s": 2.5, "unattributed": 0,
+                    "attributed": 1},
+            }}]
+        if cells > 1:
+            rows.append({"cell": "lm_clean", "kind": "clean",
+                         "state": "done", "run_id": "rid2", "ok": True,
+                         "budget_burned": 0.0, "slo": {}})
+        return {"all_ok": ok, "rows": rows[:cells]}
+
+    path = root / "baselines_out" / "fleet_slo.json"
+    path.write_text(json.dumps(artifact()))
+    assert perf_watch.main(["--root", str(root), "--snapshot"]) == 0
+    snap = json.loads(
+        (root / "baselines_out" / "perf_watch.json").read_text())
+    for key in ("fleet_slo.all_ok", "fleet_slo.cells",
+                "fleet_slo.cnn_adversary.ok",
+                "fleet_slo.cnn_adversary.budget_burned",
+                "fleet_slo.cnn_adversary.detection.precision",
+                "fleet_slo.cnn_adversary.mttr_s",
+                "fleet_slo.cnn_adversary.mttr_attributed",
+                "fleet_slo.lm_clean.budget_burned"):
+        assert key in snap["metrics"], key
+    assert perf_watch.main(["--root", str(root)]) == 0  # clean
+
+    out = root / "report.json"
+
+    def regs():
+        assert perf_watch.main(
+            ["--root", str(root), "--json", str(out)]) == 1
+        return {r["metric"]
+                for r in json.loads(out.read_text())["regressions"]}
+
+    # direction 1: a cell starts burning + its SLO verdict flips
+    path.write_text(json.dumps(artifact(ok=False, burned=3.0,
+                                        precision=0.9)))
+    assert {"fleet_slo.all_ok", "fleet_slo.cnn_adversary.ok",
+            "fleet_slo.cnn_adversary.budget_burned",
+            "fleet_slo.cnn_adversary.detection.precision",
+            "fleet_slo.cnn_adversary.detection_quality.ok"} <= regs()
+
+    # direction 2 (pinned): P/R drifting ABOVE the pinned value is a
+    # contract change, not an improvement — rebaseline consciously
+    path.write_text(json.dumps(artifact(precision=1.1)))
+    assert "fleet_slo.cnn_adversary.detection.precision" in regs()
+
+    # a cell disappearing gates on the pinned cell count
+    path.write_text(json.dumps(artifact(cells=1)))
+    assert "fleet_slo.cells" in regs()

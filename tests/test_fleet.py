@@ -1,0 +1,446 @@
+"""Fleet observatory (draco_tpu/obs/fleet.py, ISSUE 19).
+
+Registry layer: fold_run tolerates every partial-artifact state a killed
+or half-synced run leaves behind (torn incidents tail, missing metrics,
+pre-run_id status files, unknown future schemas) with a visible note,
+never a traceback; a crashed run folds as an SLO violation, not a parse
+error; a resumed run — incident seq reset inside one dir, or the same
+run_id across dirs — folds as ONE run. SLO layer: the declarative
+registry mirrors obs/incidents (enumerable table, '<slo>.<key>=<float>'
+threshold overrides rejected loudly on unknown names), each SLO returns
+the typed error-budget verdict, and the burn-window fold separates a
+spike from a slow leak. Roll-up layer: a worker accused in 3 of 4 runs
+outranks a one-run spike, and compute-to-target folds worker-steps.
+Run identity: status.json schema 5 carries a run_id that survives a
+resume into the same train_dir, and incident events carry wall-clock
+``ts`` without breaking the replay diff (tools/incident_report.py).
+
+Everything here is synthesized + jax-free — the same artifacts-only
+contract tools/fleet_report.py runs under on a bare checkout.
+"""
+
+import json
+import os
+
+import pytest
+
+from draco_tpu.obs import fleet, replay
+from draco_tpu.obs.heartbeat import (
+    STATUS_SCHEMA,
+    RunHeartbeat,
+    check_status_schema,
+)
+
+
+def write_status(d, **over):
+    payload = {"schema": STATUS_SCHEMA, "state": "running",
+               "run_id": "rid-" + os.path.basename(str(d)),
+               "step": 9, "total_steps": 10, "updated_at": 100.0}
+    payload.update(over)
+    payload = {k: v for k, v in payload.items() if v is not None}
+    with open(os.path.join(str(d), "status.json"), "w") as fh:
+        json.dump(payload, fh)
+    return payload
+
+
+def write_jsonl(d, name, rows, torn_tail=""):
+    with open(os.path.join(str(d), name), "w") as fh:
+        for r in rows:
+            fh.write(json.dumps(r) + "\n")
+        if torn_tail:
+            fh.write(torn_tail)
+
+
+def train_records(n=10, t0=0.0, dt=1.0, adv_steps=(), loss0=2.0):
+    recs = []
+    for step in range(n):
+        adv = step in adv_steps
+        recs.append({
+            "step": step, "loss": loss0 - 0.1 * step,
+            "time": t0 + dt * step,
+            "det_tp": 1 if adv else 0, "det_adv": 1 if adv else 0,
+            "located_errors": 1 if adv else 0,
+            "decode_residual": 1e-7, "decode_residual_bound": 1e-3,
+        })
+    return recs
+
+
+def test_fold_run_basic(tmp_path):
+    write_status(tmp_path, state="done", job_name="cellA", step=9,
+                 loss=1.1)
+    write_jsonl(tmp_path, "metrics.jsonl", train_records(10, adv_steps=(3,)))
+    write_jsonl(tmp_path, "incidents.jsonl", [
+        {"v": 1, "event": "onset", "seq": 0, "ts": 3.0,
+         "type": "trust", "onset_step": 3},
+        {"v": 1, "event": "offset", "seq": 1, "ts": 4.0,
+         "type": "trust", "onset_step": 3},
+    ])
+    run = fleet.fold_run(str(tmp_path))
+    assert run.run_id and run.job_name == "cellA"
+    assert run.state == "done" and run.schema == STATUS_SCHEMA
+    assert run.records == 10 and run.steps_observed == 10
+    assert run.detection == {"precision": 1.0, "recall": 1.0,
+                             "flagged_total": 1.0, "adv_total": 1.0}
+    assert len(run.events) == 2 and not run.remediations
+    assert not run.resumed and run.attempts == 1 and not run.notes
+    # the fold also resolves a direct metrics.jsonl path to the run dir
+    via_file = fleet.fold_run(os.path.join(str(tmp_path), "metrics.jsonl"))
+    assert via_file.run_id == run.run_id
+
+
+def test_fold_tolerates_torn_incidents_tail(tmp_path):
+    """A run killed mid-write leaves half a JSON line — the registry
+    folds the intact prefix and never raises (obs/replay rules)."""
+    write_status(tmp_path)
+    write_jsonl(tmp_path, "metrics.jsonl", train_records(5))
+    write_jsonl(tmp_path, "incidents.jsonl", [
+        {"v": 1, "event": "onset", "seq": 0, "ts": 1.0, "type": "trust",
+         "onset_step": 2}],
+        torn_tail='{"v": 1, "event": "offs')
+    run = fleet.fold_run(str(tmp_path))
+    assert len(run.events) == 1
+    results = fleet.evaluate_run(run)
+    assert results["step_availability"]["verdict"] == "ok"
+
+
+def test_fold_missing_metrics_degrades_with_note(tmp_path):
+    """status.json alone still folds: availability falls back to the
+    status step counter, record-tail SLOs report not_evaluated."""
+    write_status(tmp_path, step=50)
+    run = fleet.fold_run(str(tmp_path))
+    assert "metrics.jsonl missing or empty" in run.notes
+    assert run.steps_observed == 50
+    results = fleet.evaluate_run(run)
+    assert results["step_availability"]["verdict"] == "ok"
+    assert results["decode_health"]["verdict"] == "not_evaluated"
+    assert results["throughput"]["verdict"] == "not_evaluated"
+
+
+def test_fold_pre_run_id_status(tmp_path):
+    """A schema-4 (pre-fleet) status.json folds with run_id None and a
+    visible note — consumers must tolerate fleets of older runs."""
+    write_status(tmp_path, schema=4, run_id=None)
+    write_jsonl(tmp_path, "metrics.jsonl", train_records(5))
+    run = fleet.fold_run(str(tmp_path))
+    assert run.run_id is None and run.schema == 4
+    assert any("pre-run_id" in n for n in run.notes)
+
+
+def test_mixed_schema_fleet_never_crashes(tmp_path):
+    """One current run, one pre-run_id run, one UNKNOWN future schema:
+    the registry folds all three; the unknown one degrades to
+    metrics-only with the rejection note (check_status_schema wording),
+    and the fleet fold still produces a report."""
+    cur, old, future = (tmp_path / n for n in ("cur", "old", "future"))
+    for d in (cur, old, future):
+        d.mkdir()
+        write_jsonl(d, "metrics.jsonl", train_records(5))
+    write_status(cur)
+    write_status(old, schema=4, run_id=None)
+    write_status(future, schema=99)
+    reg = fleet.RunRegistry([str(cur), str(old), str(future)])
+    assert len(reg.summaries) == 3
+    by_dir = {os.path.basename(s.run_dir): s for s in reg.summaries}
+    assert by_dir["cur"].run_id
+    assert any("rejected" in n for n in by_dir["future"].notes)
+    assert by_dir["future"].state is None  # degraded, not trusted
+    report = fleet.fleet_fold(reg.summaries)
+    assert len(report["runs"]) == 3
+    assert report["status_schema"] == STATUS_SCHEMA
+
+
+def test_crashed_run_is_slo_violation_not_parse_error(tmp_path):
+    write_status(tmp_path, state="crashed", cause="boom at step 7",
+                 step=7)
+    write_jsonl(tmp_path, "metrics.jsonl", train_records(7))
+    run = fleet.fold_run(str(tmp_path))
+    assert run.state == "crashed" and not run.notes
+    res = fleet.evaluate_run(run)["step_availability"]
+    assert res["verdict"] == "violated" and res["crashed"]
+    assert "boom at step 7" in res["detail"]
+    report = fleet.fleet_fold([run])
+    assert not report["all_ok"]
+    assert report["slo_compliance"]["step_availability"]["violated"] == 1
+
+
+def test_incident_seq_reset_folds_as_one_resumed_run(tmp_path):
+    write_status(tmp_path)
+    write_jsonl(tmp_path, "metrics.jsonl", train_records(5))
+    write_jsonl(tmp_path, "incidents.jsonl", [
+        {"v": 1, "event": "onset", "seq": 0, "ts": 1.0},
+        {"v": 1, "event": "offset", "seq": 1, "ts": 2.0},
+        {"v": 1, "event": "onset", "seq": 0, "ts": 9.0},  # resume
+    ])
+    run = fleet.fold_run(str(tmp_path))
+    assert run.resumed and run.attempts == 2
+    assert len(run.events) == 3
+
+
+def test_registry_merges_attempts_sharing_run_id(tmp_path):
+    """Two dirs carrying the same run_id are ONE run in every roll-up;
+    the primary is the freshest attempt (updated_at, then records)."""
+    a, b, other = (tmp_path / n for n in ("a", "b", "other"))
+    for d in (a, b, other):
+        d.mkdir()
+    write_status(a, run_id="shared", updated_at=50.0, step=4)
+    write_jsonl(a, "metrics.jsonl", train_records(4))
+    write_status(b, run_id="shared", updated_at=90.0, step=9)
+    write_jsonl(b, "metrics.jsonl", train_records(9))
+    write_status(other, run_id="solo")
+    write_jsonl(other, "metrics.jsonl", train_records(5))
+    reg = fleet.RunRegistry(fleet.RunRegistry.discover(str(tmp_path)))
+    assert len(reg.summaries) == 2
+    merged = next(s for s in reg.summaries if s.run_id == "shared")
+    assert merged.resumed and merged.attempts == 2
+    assert merged.run_dir.endswith("b")  # freshest attempt won
+    assert any("2 dirs" in n for n in merged.notes)
+    solo = next(s for s in reg.summaries if s.run_id == "solo")
+    assert not solo.resumed
+
+
+def test_run_id_survives_resume_and_passes_schema(tmp_path):
+    """Satellite: the heartbeat mints a run_id once per train_dir, a
+    resume into the same dir re-reads it, and the beat payload passes
+    the central schema contract with the new blocks present."""
+    hb = RunHeartbeat(str(tmp_path), job_name="jobX")
+    payload = hb.beat(step=1)
+    assert payload["schema"] == STATUS_SCHEMA == 5
+    assert payload["run_id"] == hb.run_id and payload["job_name"] == "jobX"
+    check_status_schema(payload, tool="tests/test_fleet.py")
+    hb.terminal("preempted")
+    hb2 = RunHeartbeat(str(tmp_path))  # resume, no job_name this time
+    assert hb2.run_id == hb.run_id
+    p2 = hb2.beat(step=2)
+    assert p2["run_id"] == hb.run_id and "job_name" not in p2
+    # a fresh dir mints a DIFFERENT id
+    assert RunHeartbeat(str(tmp_path / "new")).run_id != hb.run_id
+
+
+def test_slo_registry_table_and_threshold_overrides():
+    names = [s["name"] for s in fleet.slo_table()]
+    assert names == ["step_availability", "detection_quality",
+                     "decode_health", "throughput", "incident_mttr",
+                     "wire_bytes"]
+    assert all(s["doc"] and s["thresholds"] for s in fleet.slo_table())
+    ov = fleet.parse_slo_thresholds(
+        "throughput.floor_frac=0.25, incident_mttr.mttr_max_s=60")
+    assert ov == {"throughput.floor_frac": 0.25,
+                  "incident_mttr.mttr_max_s": 60.0}
+    slos = fleet.make_slos(ov)
+    assert slos["throughput"].th["floor_frac"] == 0.25
+    assert slos["incident_mttr"].th["mttr_max_s"] == 60.0
+    # defaults untouched elsewhere
+    assert slos["throughput"].th["budget_frac"] == 0.1
+    with pytest.raises(ValueError, match="unknown SLO"):
+        fleet.parse_slo_thresholds("nope.x=1")
+    with pytest.raises(ValueError, match="no threshold"):
+        fleet.parse_slo_thresholds("throughput.nope=1")
+    with pytest.raises(ValueError, match="<float>"):
+        fleet.parse_slo_thresholds("throughput.floor_frac=abc")
+
+
+def test_detection_quality_slo_verdicts(tmp_path):
+    run = fleet.RunSummary(run_dir=str(tmp_path))
+    slo = fleet.make_slos()["detection_quality"]
+    # baseline route: no columns -> never evaluated, never violated
+    res = slo.evaluate(run)
+    assert res["verdict"] == "not_evaluated" and res["ok"] is None
+    run.detection = {"precision": 1.0, "recall": 1.0,
+                     "flagged_total": 8.0, "adv_total": 8.0}
+    res = slo.evaluate(run)
+    assert res["verdict"] == "ok" and res["burned"] == 0.0
+    # one false accusation: flagged 9, tp 8 -> burn 1, zero budget
+    run.detection = {"precision": 8.0 / 9.0, "recall": 1.0,
+                     "flagged_total": 9.0, "adv_total": 8.0}
+    res = slo.evaluate(run)
+    assert res["verdict"] == "violated" and res["burned"] == \
+        pytest.approx(1.0)
+    assert res["burn_frac"] is None  # zero budget burned -> JSON-clean
+
+
+def test_burn_windows_separates_spike_from_leak():
+    spike = [(10, 1.0), (11, 1.0), (12, 1.0)]
+    leak = [(10, 1.0), (40, 1.0), (70, 1.0)]
+    w = {"fast": 8, "slow": 100}
+    ws, wl = fleet.burn_windows(spike, w), fleet.burn_windows(leak, w)
+    assert ws["fast"]["max_burn"] == 3.0 and ws["fast"]["at_step"] == 12
+    assert wl["fast"]["max_burn"] == 1.0
+    assert ws["slow"]["max_burn"] == wl["slow"]["max_burn"] == 3.0
+    assert fleet.burn_windows([], w)["fast"]["max_burn"] == 0.0
+
+
+def test_wire_bytes_slo_self_consistency(tmp_path):
+    wire = {"wire_dtype": "bf16",
+            "bytes_per_worker": {"f32": 400, "bf16": 200, "int8": 100},
+            "physical_bytes_per_worker": 200,
+            "physical_bytes_per_step": 1600, "num_workers": 8,
+            "segments": {"count": 2,
+                         "physical_bytes_per_worker": [120, 80]}}
+    run = fleet.RunSummary(run_dir=str(tmp_path), wire=wire)
+    slo = fleet.make_slos()["wire_bytes"]
+    assert slo.evaluate(run)["verdict"] == "ok"
+    broken = dict(wire, physical_bytes_per_step=999)
+    run.wire = broken
+    res = slo.evaluate(run)
+    assert res["verdict"] == "violated" and "per_worker x 8" in \
+        res["detail"]
+    run.wire = dict(wire, segments={"count": 2,
+                                    "physical_bytes_per_worker": [120, 99]})
+    assert "segment bytes sum" in slo.evaluate(run)["detail"]
+    run.wire = None
+    assert slo.evaluate(run)["verdict"] == "not_evaluated"
+
+
+def test_incident_mttr_slo_join(tmp_path):
+    run = fleet.RunSummary(run_dir=str(tmp_path))
+    run.record_times = {5: 100.0}
+    onset = {"event": "onset", "type": "trust", "onset_step": 5,
+             "ts": 101.0}
+    rem = {"event": "remediation", "ts": 104.0,
+           "trigger": {"type": "trust", "onset_step": 5}}
+    run.events = [onset, rem]
+    run.remediations = [rem]
+    slo = fleet.make_slos()["incident_mttr"]
+    res = slo.evaluate(run)
+    assert res["verdict"] == "ok"
+    assert res["mttr_s"] == pytest.approx(3.0)
+    assert res["mttd_s"] == pytest.approx(1.0)
+    assert res["attributed"] == 1 and res["unattributed"] == 0
+    # a remediation pointing at an unseen onset is unattributed -> burn
+    run.remediations = [{"event": "remediation", "ts": 104.0,
+                         "trigger": {"type": "trust", "onset_step": 99}}]
+    res = slo.evaluate(run)
+    assert res["verdict"] == "violated" and res["unattributed"] == 1
+    # no remediations at all: nothing to measure, not a violation
+    run.remediations = []
+    assert slo.evaluate(run)["verdict"] == "not_evaluated"
+
+
+def test_worker_rollup_cross_run_ranking(tmp_path):
+    """A worker accused in 3 of 4 runs outranks a single-run spike with
+    more raw accusations."""
+    def summary(i, rows):
+        s = fleet.RunSummary(run_dir=str(tmp_path / str(i)))
+        s.worker_rows = rows
+        return s
+
+    def row(w, accused, trust=1.0):
+        return {"worker": w, "accused": accused, "trust": trust}
+
+    runs = [summary(i, [row(2, 2, 0.4), row(5, 0), row(0, 0)])
+            for i in range(3)]
+    runs.append(summary(3, [row(2, 0), row(5, 50, 0.1), row(0, 0)]))
+    top = fleet.worker_rollup(runs)
+    assert [w["worker"] for w in top[:2]] == [2, 5]
+    w2 = top[0]
+    assert w2["runs_accusing"] == 3 and w2["runs_seen"] == 4
+    assert w2["accused_total"] == 6 and w2["min_trust"] == \
+        pytest.approx(0.4)
+    # degraded path: no records to replay, status forensics block only
+    deg = fleet.RunSummary(run_dir=str(tmp_path / "deg"))
+    deg.forensics = {"trust": [1.0, 1.0, 0.2],
+                     "top_suspects": [{"worker": 2, "accused": 7}]}
+    top = fleet.worker_rollup([deg])
+    assert top[0]["worker"] == 2 and top[0]["accused_total"] == 7
+
+
+def test_compute_rollup_to_target(tmp_path):
+    s = fleet.RunSummary(run_dir=str(tmp_path))
+    s.num_workers = 8
+    s.first_step, s.last_step = 0, 9
+    s.losses = [(i, 2.0 - 0.2 * i) for i in range(10)]
+    roll = fleet.compute_rollup([s], target_loss=1.0)
+    assert roll["total_worker_steps"] == 80.0
+    assert roll["runs_reaching_target"] == 1
+    # loss 1.0 first reached at step 5 -> 6 steps * 8 workers
+    assert roll["worker_steps_to_target_total"] == 48.0
+    assert fleet.compute_rollup([s])["runs_reaching_target"] is None
+
+
+def test_incident_events_carry_ts_and_replay_diffs_clean(tmp_path):
+    """Satellite: every incidents.jsonl line now carries wall-clock
+    ``ts`` (MTTR joins need it), incident_report carries it through,
+    and the replayed-vs-committed ledger diff stays clean — ts is
+    attempt-local and excluded from episode identity."""
+    from draco_tpu.obs import incidents as incidents_mod
+    from tools import incident_report
+
+    recs = []
+    for step in range(1, 11):
+        accused = 0b0100 if step <= 6 else 0
+        recs.append({"step": step, "loss": 1.0, "time": float(step),
+                     "wmask_accused0": accused,
+                     "wmask_present0": 0b1111, "wmask_adv0": accused})
+    write_jsonl(tmp_path, "metrics.jsonl", recs)
+    engine = incidents_mod.IncidentEngine(
+        num_workers=4,
+        out_path=os.path.join(str(tmp_path), "incidents.jsonl"))
+    for r in recs:
+        engine.observe(r)
+    engine.finalize()
+    lines = list(replay.iter_jsonl(
+        os.path.join(str(tmp_path), "incidents.jsonl")))
+    assert lines and all(
+        isinstance(ev.get("ts"), float) for ev in lines)
+    assert incident_report.main([str(tmp_path),
+                                 "--num-workers", "4"]) == 0
+    rep = json.load(open(os.path.join(str(tmp_path),
+                                      "incidents_report.json")))
+    assert rep["diff"]["match"]
+    assert rep["ledger"] and all("ts" in ep for ep in rep["ledger"])
+    # and the fleet MTTD join sees the stamps
+    run = fleet.fold_run(str(tmp_path))
+    res = fleet.evaluate_run(run)["incident_mttr"]
+    assert res["mttd_s"] is not None and res["mttd_s"] >= 0.0
+
+
+def test_fleet_report_tool_bare_and_populated(tmp_path, capsys):
+    """tools/fleet_report.py: empty root prints a note and exits 0;
+    a populated root writes fleet.json; --strict exits 1 when a run
+    violates an SLO; threshold overrides reach the verdicts."""
+    from tools import fleet_report
+
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert fleet_report.main(["--runs-root", str(empty)]) == 0
+    assert "no run directories found" in capsys.readouterr().out
+    good, bad = tmp_path / "good", tmp_path / "bad"
+    good.mkdir(), bad.mkdir()
+    write_status(good, state="done", job_name="good")
+    write_jsonl(good, "metrics.jsonl", train_records(10))
+    write_status(bad, state="crashed", cause="oom", step=3)
+    write_jsonl(bad, "metrics.jsonl", train_records(3))
+    out = tmp_path / "fleet.json"
+    rc = fleet_report.main(["--runs-root", str(tmp_path),
+                            "--json", str(out), "--strict"])
+    assert rc == 1  # crashed run violates step_availability in CI mode
+    text = capsys.readouterr().out
+    assert "VIOL" in text and "terminal state 'crashed'" in text
+    payload = json.loads(out.read_text())
+    assert payload["fleet_schema"] == fleet.FLEET_SCHEMA
+    assert not payload["all_ok"] and len(payload["runs"]) == 2
+    states = {r["run"]: r["state"] for r in payload["runs"]}
+    assert states["good"] == "done"
+    # an override makes the detection floor lenient fleet-wide
+    assert fleet_report.main(
+        [str(good), "--strict", "--slo-thresholds",
+         "detection_quality.precision_floor=0.5"]) == 0
+
+
+def test_fleet_is_importable_without_jax():
+    """The obs contract: the registry/SLO fold must run on a bare
+    checkout (laptop, scp'd artifacts). Re-importing in a subprocess
+    with jax poisoned proves no transitive jax dependency."""
+    import subprocess
+    import sys
+
+    code = (
+        "import sys; sys.modules['jax'] = None\n"
+        "from draco_tpu.obs import fleet\n"
+        "assert len(fleet.slo_table()) == 6\n"
+        "print('ok')\n")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run([sys.executable, "-c", code], cwd=repo,
+                          capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr
+    assert proc.stdout.strip() == "ok"

@@ -417,7 +417,7 @@ def test_live_clean_run_zero_incidents_and_bitwise(tmp_path):
     v_off = _run(_cnn_cfg(train_dir=d_off, incident_watch="off"))
     np.testing.assert_array_equal(v_on, v_off)
     st = json.load(open(os.path.join(d_on, "status.json")))
-    assert st["schema"] == 4 and st["state"] == "done"
+    assert st["schema"] == 5 and st["state"] == "done"
     assert st["incidents"] == {"total": 0, "open": [], "by_type": {},
                                "thresholds": {}, "last": None}
     assert not os.path.exists(os.path.join(d_on, "incidents.jsonl"))
@@ -541,7 +541,7 @@ def test_terminal_write_carries_final_incidents_block(tmp_path):
     _run(_cnn_cfg(train_dir=d, eval_freq=0,
                   fault_spec="nan_grad@2:w4,sigterm@3"))
     st = json.load(open(os.path.join(d, "status.json")))
-    assert st["state"] == "preempted" and st["schema"] == 4
+    assert st["state"] == "preempted" and st["schema"] == 5
     inc_block = st["incidents"]
     assert inc_block["total"] == 2  # nonfinite + guard, post-last-beat
     assert {e["type"] for e in inc_block["open"]} <= {"guard", "nonfinite"}

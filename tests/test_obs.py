@@ -1107,7 +1107,7 @@ def test_heartbeat_numerics_and_wire_blocks(tmp_path):
                 "nx_grad_nonfinite": 0.0, "shadow_err": 0.002,
                 "shadow_flag_agree": 0.5})
     payload = hb.beat(2, 4)
-    assert payload["schema"] == STATUS_SCHEMA == 4
+    assert payload["schema"] == STATUS_SCHEMA == 5
     assert payload["wire"]["bytes_per_worker"]["bf16"] == 40
     nxb = payload["numerics"]
     assert nxb["nx_wire_absmax"] == 4.0  # last value

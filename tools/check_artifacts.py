@@ -373,6 +373,25 @@ def _check_decode_study(root):
     return None if rc == 0 else f"decode_study --check exited {rc}"
 
 
+def _check_fleet_slo(root):
+    """ISSUE 19: re-verify the committed fleet SLO matrix — every cell's
+    acceptance bools recomputed from the cell's own SLO results (clean
+    cells burned zero deterministic budget, adversary cells held P/R 1.0
+    on live adversaries, remediated cells carry a finite attributed
+    MTTR), both production loops covered, and a stale status schema
+    REFUSED rather than silently re-blessed."""
+    from tools import fleet_study
+
+    path = os.path.join(root, "baselines_out", "fleet_slo.json")
+    try:
+        with open(path) as fh:
+            payload = json.load(fh)
+    except (OSError, ValueError) as e:
+        return f"cannot read baselines_out/fleet_slo.json: {e}"
+    problems = fleet_study.verify_payload(payload)
+    return problems[0] if problems else None
+
+
 CHECKS = (
     ("perf_watch", _check_perf_watch),
     ("device_profile --check", _check_device_profile),
@@ -391,6 +410,7 @@ CHECKS = (
     ("straggler_study all_ok",
      _flag_check(os.path.join("baselines_out", "straggler_study.json"))),
     ("autopilot_study certificates", _check_autopilot_study),
+    ("fleet_slo certificates", _check_fleet_slo),
     ("trace_report smoke", _check_trace_report),
     ("forensics_report smoke", _check_forensics_report),
     ("incident_report smoke", _check_incident_report),

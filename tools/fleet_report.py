@@ -1,0 +1,174 @@
+#!/usr/bin/env python
+"""Fold a fleet of run directories into the SLO dashboard (ISSUE 19).
+
+The fleet observatory (draco_tpu/obs/fleet.py) rolls every run's
+status.json + incidents.jsonl + metrics.jsonl tail into per-run SLO
+verdicts with error budgets and a fleet-level roll-up: per-SLO
+compliance counts, the cross-run worker trust table (a worker accused
+in 3 of 4 runs outranks a one-run spike), and compute-to-target. This
+tool prints the text dashboard and (``--json``) writes ``fleet.json``:
+
+  python tools/fleet_report.py run_a/ run_b/            # explicit dirs
+  python tools/fleet_report.py --runs-root train_out/   # discover
+  python tools/fleet_report.py --runs-root . --watch 10 # poll forever
+
+No jax import — runs on a bare checkout, on a laptop, against
+artifacts scp'd from a chip job. Torn / empty / missing inputs degrade
+with a visible per-run note (obs/replay tolerance rules), never a
+traceback; a directory with no runs at all prints a note and exits 0.
+``--strict`` exits 1 when any SLO is violated (CI mode).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# draco_tpu.obs is importable without jax — the registry/SLO fold is the
+# ONE implementation this dashboard, fleet_study, and check_artifacts
+# share, so the committed artifact cannot drift from the live report
+from draco_tpu.obs import fleet  # noqa: E402
+
+_SLO_ABBREV = {
+    "step_availability": "avail",
+    "detection_quality": "detect",
+    "decode_health": "decode",
+    "throughput": "thru",
+    "incident_mttr": "mttr",
+    "wire_bytes": "wire",
+}
+_MARK = {"ok": "ok", "violated": "VIOL", "not_evaluated": "-"}
+
+
+def collect_run_dirs(paths, runs_root: str) -> list:
+    dirs = list(paths)
+    if runs_root:
+        dirs.extend(fleet.RunRegistry.discover(runs_root))
+    # stable order, no duplicates (a positional dir may also be under
+    # --runs-root)
+    seen, out = set(), []
+    for d in dirs:
+        key = os.path.normpath(d)
+        if key not in seen:
+            seen.add(key)
+            out.append(d)
+    return out
+
+
+def make_fleet(run_dirs, thresholds: str = "",
+               target_loss=None) -> dict:
+    registry = fleet.RunRegistry(run_dirs, tool="tools/fleet_report.py")
+    report = fleet.fleet_fold(registry.summaries, overrides=thresholds,
+                              target_loss=target_loss)
+    report["tool"] = "tools/fleet_report.py"
+    report["run_dirs"] = list(run_dirs)
+    return report
+
+
+def print_report(report: dict, out=None) -> None:
+    out = out if out is not None else sys.stdout  # resolve at call time
+    runs = report["runs"]
+    print(f"fleet: {len(runs)} run(s)   "
+          f"all_ok={report['all_ok']}", file=out)
+    if not runs:
+        print("no run directories found (nothing holding a status.json "
+              "or metrics.jsonl) — nothing to fold", file=out)
+        return
+    names = list(_SLO_ABBREV)
+    hdr = f"{'run':<18}{'state':<10}{'steps':>6}{'burn':>6}  " + \
+        "".join(f"{_SLO_ABBREV[n]:>8}" for n in names)
+    print(hdr, file=out)
+    print("-" * len(hdr), file=out)
+    for r in runs:
+        marks = "".join(
+            f"{_MARK[r['slo'][n]['verdict']] if n in r['slo'] else '?':>8}"
+            for n in names)
+        state = r.get("state") or "?"
+        print(f"{r['run'][:17]:<18}{state:<10}{r['steps']:>6}"
+              f"{r['budget_burned']:>6g}  {marks}", file=out)
+        for note in r["notes"]:
+            print(f"    note: {note}", file=out)
+        for n in names:
+            res = r["slo"].get(n)
+            if res and res["verdict"] == "violated":
+                print(f"    {n}: {res['detail']}", file=out)
+    comp = report["slo_compliance"]
+    print("slo compliance (ok/violated/not_evaluated):", file=out)
+    for n in names:
+        c = comp[n]
+        print(f"  {n:<20} {c['ok']}/{c['violated']}/"
+              f"{c['not_evaluated']}", file=out)
+    offenders = [w for w in report["workers"] if w["runs_accusing"]]
+    if offenders:
+        print("top offenders (cross-run):", file=out)
+        for w in offenders:
+            print(f"  worker {w['worker']}: accused in "
+                  f"{w['runs_accusing']}/{w['runs_seen']} runs "
+                  f"({w['accused_total']} accusations, min trust "
+                  f"{w['min_trust']:.2f})", file=out)
+    comp_roll = report["compute"]
+    print(f"compute: {comp_roll['total_worker_steps']:g} worker-steps "
+          f"across the fleet", file=out)
+    if comp_roll["target_loss"] is not None:
+        print(f"  to target loss {comp_roll['target_loss']:g}: "
+              f"{comp_roll['runs_reaching_target']}/{len(runs)} runs, "
+              f"{comp_roll['worker_steps_to_target_total'] or 0:g} "
+              f"worker-steps", file=out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("run_dirs", nargs="*",
+                    help="run directories (or metrics.jsonl paths)")
+    ap.add_argument("--runs-root", default="",
+                    help="discover every run dir under this root "
+                         "(anything holding status.json/metrics.jsonl)")
+    ap.add_argument("--slo-thresholds", default="",
+                    help="SLO threshold overrides, comma-separated "
+                         "'<slo>.<key>=<float>' (obs/fleet.slo_table "
+                         "names the keys)")
+    ap.add_argument("--target-loss", type=float, default=None,
+                    help="fold compute-to-target at this loss")
+    ap.add_argument("--json", default="",
+                    help="write fleet.json here ('' = don't write)")
+    ap.add_argument("--watch", type=float, default=0.0,
+                    help="poll every N seconds (dashboard mode)")
+    ap.add_argument("--watch-count", type=int, default=0,
+                    help="stop after N polls (0 = forever; for tests)")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 when any SLO is violated (CI mode)")
+    args = ap.parse_args(argv)
+
+    polls = 0
+    while True:
+        run_dirs = collect_run_dirs(args.run_dirs, args.runs_root)
+        report = make_fleet(run_dirs, args.slo_thresholds,
+                            args.target_loss)
+        if args.watch:
+            print(f"\n--- fleet poll {polls + 1} "
+                  f"@ {time.strftime('%H:%M:%S')} ---")
+        print_report(report)
+        if args.json:
+            os.makedirs(os.path.dirname(args.json) or ".", exist_ok=True)
+            tmp = args.json + ".tmp"
+            with open(tmp, "w") as fh:
+                json.dump(report, fh, indent=1)
+            os.replace(tmp, args.json)
+        polls += 1
+        if not args.watch or (args.watch_count
+                              and polls >= args.watch_count):
+            break
+        try:
+            time.sleep(args.watch)
+        except KeyboardInterrupt:
+            break
+    return 1 if (args.strict and not report["all_ok"]) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,307 @@
+#!/usr/bin/env python
+"""Fleet SLO study: the committed proof that the fleet observatory's
+gates are live (ISSUE 19) — a scenario matrix of short REAL runs on
+both production loops (coded-DP CNN Trainer, TransformerLM fold loop),
+each folded through the ONE obs/fleet implementation:
+
+  *_clean        no faults: every deterministic SLO must hold and the
+                 run must burn ZERO error budget
+  *_adversary    a live in-budget Byzantine episode: the detection SLO
+                 must hold at precision == recall == 1.0 WITH a
+                 nonzero adversary denominator (the Draco certificate
+                 under fire, not vacuously)
+  *_straggler    a sustained drop: the coded route rides through it
+                 (zero burn) while the incident stream records the
+                 straggle episode
+  *_autopilot    adversary + closed-loop autopilot: the remediation is
+                 attributed to its triggering incident and the run's
+                 MTTR is FINITE (onset→remediation wall-clock joined
+                 from the same incidents.jsonl stream)
+
+The committed ``baselines_out/fleet_slo.json`` carries the per-cell
+SLO verdicts + the fleet roll-up; ``tools/perf_watch.py`` pins the
+verdict bools and zero-burn cells at tolerance 0 (MTTR at time
+tolerance) and ``tools/check_artifacts.py`` re-verifies the artifact
+jax-free via ``--check`` semantics (stale status schema refused).
+Flipped-row control tests in tests/test_cli_tools.py prove every gate
+fires both directions.
+
+Usage (CPU, ~4 min):       python tools/fleet_study.py --cpu-mesh 8
+Re-verify committed file:  python tools/fleet_study.py --check
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# jax-free imports only at module level: --check must run on a bare
+# host (tools/check_artifacts.py re-uses verify_payload)
+from draco_tpu.obs import fleet  # noqa: E402
+
+NUM_WORKERS = 8
+ADV_WORKER = 2
+STRAGGLE_WORKER = 5
+ADV_SPEC = f"adversary@5-20:w{ADV_WORKER}"
+STRAGGLE_SPEC = f"straggle@10-30:w{STRAGGLE_WORKER}"
+# boundary hysteresis tuned to the 64-step cell (same rationale as
+# autopilot_study.POLICY); committed verbatim so the run is replayable
+POLICY = "readmit_boundaries=6,dial_up_boundaries=3"
+
+# cell -> (loop, scenario kind, extra TrainConfig kw)
+CELLS = {
+    "cnn_clean": ("cnn", "clean", {}),
+    "cnn_adversary": ("cnn", "adversary", {"fault_spec": ADV_SPEC}),
+    "cnn_straggler": ("cnn", "straggler",
+                      {"fault_spec": STRAGGLE_SPEC}),
+    "cnn_autopilot": ("cnn", "autopilot",
+                      {"fault_spec": ADV_SPEC, "autopilot": "on",
+                       "autopilot_policy": POLICY}),
+    "lm_clean": ("lm", "clean", {}),
+    "lm_adversary": ("lm", "adversary", {"fault_spec": ADV_SPEC}),
+    "lm_straggler": ("lm", "straggler",
+                     {"fault_spec": STRAGGLE_SPEC}),
+    "lm_autopilot": ("lm", "autopilot",
+                     {"fault_spec": ADV_SPEC, "autopilot": "on",
+                      "autopilot_policy": POLICY}),
+}
+
+
+def _make_cfg(loop: str, name: str, train_dir: str, args, **kw):
+    from draco_tpu.config import TrainConfig
+
+    base = dict(
+        approach="cyclic", worker_fail=1, adversary_count=0,
+        redundancy="shared", batch_size=4, num_workers=NUM_WORKERS,
+        max_steps=args.max_steps, eval_freq=8, train_dir=train_dir,
+        log_every=1, steps_per_call=args.steps_per_call,
+        step_guard="on", incident_watch="on", err_mode=args.err_mode,
+        job_name=name,
+    )
+    if loop == "cnn":
+        base.update(network="FC", dataset="synthetic-mnist", lr=0.012,
+                    momentum=0.9)
+    else:
+        base.update(network="TransformerLM", dataset="synthetic-text",
+                    seq_len=16, vocab=32, model_dim=32, model_heads=2,
+                    model_layers=1, lr=0.05)
+    base.update(kw)
+    return TrainConfig(**base)
+
+
+def run_cell(name: str, args, mesh, ds) -> "tuple[dict, object]":
+    """Run one cell on its production loop, fold the run dir through
+    obs/fleet, and return (row, RunSummary)."""
+    loop, kind, kw = CELLS[name]
+    d = tempfile.mkdtemp(prefix=f"fleet_{name}_")
+    try:
+        cfg = _make_cfg(loop, name, d, args, **kw)
+        cfg.validate()
+        t0 = time.perf_counter()
+        if loop == "cnn":
+            from draco_tpu.training.trainer import Trainer
+
+            tr = Trainer(cfg, mesh=mesh, dataset=ds, quiet=True)
+            try:
+                tr.run()
+            finally:
+                tr.close()
+        else:
+            from draco_tpu.parallel import make_mesh_2d
+            from draco_tpu.parallel.sp_step import train_sp
+
+            train_sp(cfg, make_mesh_2d(cfg.num_workers, 1),
+                     quiet=True)
+        wall_s = time.perf_counter() - t0
+
+        summary = fleet.fold_run(d, tool="tools/fleet_study.py")
+        results = fleet.evaluate_run(summary)
+        row = {
+            "cell": name, "loop": loop, "kind": kind,
+            "run_id": summary.run_id, "job_name": summary.job_name,
+            "state": summary.state, "steps": summary.steps_observed,
+            "wall_s": round(wall_s, 3),
+            "budget_burned": fleet.budget_burned(results),
+            "notes": list(summary.notes),
+            "slo": results,
+        }
+        row.update(_cell_verdict(row, kind))
+        return row, summary
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
+def _cell_verdict(row: dict, kind: str) -> dict:
+    """The cell's acceptance bools — recomputed verbatim by --check on
+    the committed artifact, so a hand-edited row cannot stay green."""
+    slo = row["slo"]
+    problems = []
+    if row.get("state") != "done":
+        problems.append(f"terminal state {row.get('state')!r}")
+    if row.get("run_id") in (None, ""):
+        problems.append("no run_id in status.json")
+    for name in fleet.DETERMINISTIC_SLOS:
+        res = slo.get(name)
+        if res and res["verdict"] == "violated":
+            problems.append(f"{name} violated: {res['detail']}")
+    if row["budget_burned"] != 0.0:
+        problems.append(
+            f"burned {row['budget_burned']:g} of the deterministic "
+            f"error budget")
+    det = slo.get("detection_quality") or {}
+    if kind in ("adversary", "autopilot"):
+        if not det.get("evaluated"):
+            problems.append("detection SLO not evaluated under a live "
+                            "adversary")
+        elif det.get("precision") != 1.0 or det.get("recall") != 1.0:
+            problems.append(
+                f"detection P/R {det.get('precision')}/"
+                f"{det.get('recall')} != 1.0/1.0")
+        elif not det.get("adv_total"):
+            problems.append("adversary cell saw no adversarial rows "
+                            "(vacuous certificate)")
+    mttr = slo.get("incident_mttr") or {}
+    if kind == "autopilot":
+        mttr_s = mttr.get("mttr_s")
+        if not mttr.get("evaluated") or mttr.get("verdict") != "ok":
+            problems.append(f"incident_mttr not ok: "
+                            f"{mttr.get('detail')}")
+        elif mttr_s is None or not math.isfinite(mttr_s) \
+                or mttr_s < 0:
+            problems.append(f"MTTR not finite: {mttr_s!r}")
+        elif mttr.get("unattributed"):
+            problems.append(f"{mttr['unattributed']} unattributed "
+                            f"remediation(s)")
+    return {"ok": not problems, "problems": problems}
+
+
+def verify_payload(payload: dict) -> list:
+    """Jax-free re-verification of a committed fleet_slo.json — the
+    same gate check_artifacts runs in CI. Returns problem strings
+    ([] = good). A stale status schema is REFUSED: the artifact must
+    be regenerated when the status contract moves."""
+    problems = []
+    if payload.get("status_schema") != fleet.STATUS_SCHEMA:
+        problems.append(
+            f"stale artifact: status_schema "
+            f"{payload.get('status_schema')!r} != current "
+            f"{fleet.STATUS_SCHEMA} — rerun tools/fleet_study.py")
+    if payload.get("fleet_schema") != fleet.FLEET_SCHEMA:
+        problems.append(
+            f"fleet_schema {payload.get('fleet_schema')!r} != "
+            f"{fleet.FLEET_SCHEMA}")
+    rows = payload.get("rows") or []
+    if len(rows) < 6:
+        problems.append(f"only {len(rows)} cells (need >= 6)")
+    loops = {r.get("loop") for r in rows}
+    if not {"cnn", "lm"} <= loops:
+        problems.append(f"cells cover loops {sorted(loops)} — need "
+                        f"both production loops")
+    for row in rows:
+        cell = row.get("cell", "?")
+        verdict = _cell_verdict(row, row.get("kind", "clean"))
+        if not verdict["ok"]:
+            problems.extend(f"{cell}: {p}" for p in verdict["problems"])
+        if bool(row.get("ok")) != verdict["ok"]:
+            problems.append(
+                f"{cell}: committed ok={row.get('ok')} disagrees with "
+                f"recomputed {verdict['ok']}")
+    if rows and not payload.get("all_ok"):
+        problems.append("all_ok is false")
+    elif payload.get("all_ok") and any(not r.get("ok") for r in rows):
+        problems.append("all_ok=true but some cell is not ok")
+    return problems
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", type=str,
+                    default=os.path.join("baselines_out",
+                                         "fleet_slo.json"))
+    ap.add_argument("--max-steps", type=int, default=64)
+    ap.add_argument("--steps-per-call", type=int, default=4)
+    ap.add_argument("--err-mode", type=str, default="rev_grad")
+    ap.add_argument("--cells", type=str, default="",
+                    help="comma-separated subset (default: all)")
+    ap.add_argument("--cpu-mesh", type=int, default=0, metavar="N",
+                    help="force an N-device virtual CPU mesh")
+    ap.add_argument("--check", action="store_true",
+                    help="re-verify the committed artifact (jax-free) "
+                         "instead of running the matrix")
+    args = ap.parse_args(argv)
+
+    if args.check:
+        try:
+            with open(args.out) as fh:
+                payload = json.load(fh)
+        except (OSError, ValueError) as e:
+            print(f"fleet_study --check: cannot read {args.out}: {e}")
+            return 1
+        problems = verify_payload(payload)
+        for p in problems:
+            print(f"fleet_study --check: {p}")
+        print(f"fleet_study --check: {args.out} "
+              f"{'FAILED' if problems else 'ok'} "
+              f"({len(payload.get('rows') or [])} cells)")
+        return 1 if problems else 0
+
+    from draco_tpu.cli import maybe_force_cpu_mesh
+
+    if args.cpu_mesh:
+        maybe_force_cpu_mesh(args)
+
+    from draco_tpu.data.datasets import load_dataset
+    from draco_tpu.runtime import make_mesh
+
+    cells = [c for c in args.cells.split(",") if c] or list(CELLS)
+    ds = load_dataset("synthetic-mnist", synthetic_train=512,
+                      synthetic_test=128)
+    mesh = make_mesh(NUM_WORKERS)
+    rows, summaries = [], []
+    for name in cells:
+        row, summary = run_cell(name, args, mesh, ds)
+        rows.append(row)
+        summaries.append(summary)
+        det = row["slo"].get("detection_quality") or {}
+        print(f"fleet_study: {name:14s} -> ok={row['ok']} "
+              f"burn={row['budget_burned']:g} "
+              f"P/R={det.get('precision')}/{det.get('recall')} "
+              f"({row['wall_s']}s)", flush=True)
+        for p in row["problems"]:
+            print(f"fleet_study:   problem: {p}", flush=True)
+
+    payload = {
+        "schema": 1,
+        "tool": "tools/fleet_study.py",
+        "fleet_schema": fleet.FLEET_SCHEMA,
+        "status_schema": fleet.STATUS_SCHEMA,
+        "num_workers": NUM_WORKERS,
+        "max_steps": args.max_steps,
+        "steps_per_call": args.steps_per_call,
+        "err_mode": args.err_mode,
+        "adv_spec": ADV_SPEC,
+        "straggle_spec": STRAGGLE_SPEC,
+        "policy": POLICY,
+        "slo_table": fleet.slo_table(),
+        "rows": rows,
+        "fleet": fleet.fleet_fold(summaries),
+        "all_ok": bool(rows) and all(r["ok"] for r in rows),
+    }
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+    with open(args.out, "w") as fh:
+        json.dump(payload, fh, indent=1, sort_keys=True)
+    print(f"fleet_study: {len(rows)} cells -> {args.out} "
+          f"(all_ok={payload['all_ok']})")
+    return 0 if payload["all_ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

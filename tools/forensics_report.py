@@ -64,7 +64,7 @@ def infer_num_workers(records: list, status_path: str) -> int:
 def make_report(metrics_path: str, num_workers: int = 0) -> dict:
     records = load_records(metrics_path)
     n = num_workers or infer_num_workers(
-        records, os.path.join(os.path.dirname(metrics_path), "status.json"))
+        records, replay.find_run_files(metrics_path).status)
     # n > MAX_WORKERS raises the ledger's named bound — an explicit
     # --num-workers above it must error, not silently truncate the table
     ledger = AccusationLedger(n)
@@ -125,9 +125,7 @@ def main(argv=None) -> int:
                          "to the metrics file)")
     args = ap.parse_args(argv)
 
-    metrics_path = args.path
-    if os.path.isdir(metrics_path):
-        metrics_path = os.path.join(metrics_path, "metrics.jsonl")
+    metrics_path = replay.find_run_files(args.path).metrics
     report = make_report(metrics_path, args.num_workers)
     print_table(report)
     out_path = args.json or os.path.join(os.path.dirname(metrics_path),

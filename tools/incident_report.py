@@ -87,9 +87,12 @@ def load_ledger_episodes(path: str) -> "tuple[list, bool]":
         kind, typ = ev.get("event"), ev.get("type")
         if typ is None:
             continue
+        # the wall-clock ``ts`` stamp (ISSUE 19) is carried through
+        # verbatim but is NOT part of _episode_key — replayed ledgers
+        # (which have no wall clock) still diff clean against it
         body = {k: ev.get(k) for k in
                 ("type", "severity", "source", "onset_step", "last_step",
-                 "steps", "workers", "evidence")}
+                 "steps", "workers", "evidence", "ts")}
         if kind == "onset":
             opens[(typ, ev.get("onset_step"))] = body
         elif kind == "offset":
@@ -104,7 +107,7 @@ def load_ledger_episodes(path: str) -> "tuple[list, bool]":
 def make_report(metrics_path: str, incidents_path: str,
                 num_workers: int = 0, thresholds: str = "") -> dict:
     records = replay.train_records(metrics_path, require_loss=True)
-    status_path = os.path.join(os.path.dirname(metrics_path), "status.json")
+    status_path = replay.find_run_files(metrics_path).status
     n = num_workers or infer_num_workers(records, status_path)
     # the run's own effective threshold overrides (the live engine stamps
     # its non-defaults into the status block — incl. make_engine's
@@ -254,9 +257,8 @@ def main(argv=None) -> int:
                          "incidents_report.json next to the metrics file)")
     args = ap.parse_args(argv)
 
-    metrics_path = replay.metrics_path(args.path)
-    incidents_path = os.path.join(os.path.dirname(metrics_path),
-                                  "incidents.jsonl")
+    files = replay.find_run_files(args.path)
+    metrics_path, incidents_path = files.metrics, files.incidents
     report = make_report(metrics_path, incidents_path, args.num_workers,
                          args.thresholds)
     print_table(report)

@@ -738,6 +738,70 @@ def fold_device_profile(root: str, metrics: dict) -> None:
                     "kind": "pinned", "source": src}
 
 
+def fold_fleet(root: str, metrics: dict) -> None:
+    """Fleet-SLO artifact (tools/fleet_study.py, ISSUE 19): the fleet
+    observatory's certificates gate at tolerance 0 — every cell's SLO
+    verdict bool, the deterministic error-budget burn PINNED at zero
+    (a clean or in-budget cell starting to burn is a regression; a
+    burning cell silently going quiet is a contract change that must
+    re-baseline consciously), and the detection SLO's P/R pinned at
+    the certificate 1.0 on the adversary cells. The remediated cells'
+    MTTR gates at the time tolerance (wall-clock measure)."""
+    path = os.path.join(root, "baselines_out", "fleet_slo.json")
+    data = _read_json(path)
+    if not isinstance(data, dict):
+        return
+    src = "baselines_out/fleet_slo.json"
+    if "all_ok" in data:
+        metrics["fleet_slo.all_ok"] = {
+            "value": float(bool(data["all_ok"])), "kind": "ok",
+            "source": src}
+    rows = data.get("rows", [])
+    metrics["fleet_slo.cells"] = {
+        "value": float(len(rows)), "kind": "pinned", "source": src}
+    for row in rows:
+        cell = row.get("cell")
+        if not cell:
+            continue
+        key = f"fleet_slo.{cell}"
+        metrics[f"{key}.ok"] = {
+            "value": float(bool(row.get("ok"))), "kind": "ok",
+            "source": src}
+        metrics[f"{key}.state_done"] = {
+            "value": float(row.get("state") == "done"), "kind": "ok",
+            "source": src}
+        metrics[f"{key}.run_id_present"] = {
+            "value": float(bool(row.get("run_id"))), "kind": "ok",
+            "source": src}
+        if "budget_burned" in row:
+            metrics[f"{key}.budget_burned"] = {
+                "value": float(row["budget_burned"]), "kind": "pinned",
+                "source": src}
+        slo = row.get("slo") or {}
+        for name, res in sorted(slo.items()):
+            if not isinstance(res, dict) or not res.get("evaluated"):
+                continue
+            metrics[f"{key}.{name}.ok"] = {
+                "value": float(bool(res.get("ok"))), "kind": "ok",
+                "source": src}
+        det = slo.get("detection_quality") or {}
+        if det.get("evaluated"):
+            for col in ("precision", "recall"):
+                if det.get(col) is not None:
+                    metrics[f"{key}.detection.{col}"] = {
+                        "value": float(det[col]), "kind": "pinned",
+                        "source": src}
+        mttr = slo.get("incident_mttr") or {}
+        if mttr.get("mttr_s") is not None:
+            metrics[f"{key}.mttr_s"] = {
+                "value": float(mttr["mttr_s"]), "kind": "time_ms",
+                "source": src}
+            metrics[f"{key}.mttr_attributed"] = {
+                "value": float(mttr.get("unattributed", 0) == 0
+                               and bool(mttr.get("attributed"))),
+                "kind": "ok", "source": src}
+
+
 def fold_all(root: str) -> dict:
     metrics: dict = {}
     fold_bench(root, metrics)
@@ -747,6 +811,7 @@ def fold_all(root: str) -> dict:
     fold_chaos(root, metrics)
     fold_straggler(root, metrics)
     fold_autopilot(root, metrics)
+    fold_fleet(root, metrics)
     fold_wire_study(root, metrics)
     fold_segment_study(root, metrics)
     fold_tree_study(root, metrics)
